@@ -1,0 +1,29 @@
+"""photon-ml-tpu: a TPU-native rebuild of Photon ML (GLM + GAME mixed-effect models).
+
+A from-scratch JAX/XLA framework with the capabilities of the reference
+``matthieubulte/photon-ml`` (a fork of LinkedIn Photon ML — see SURVEY.md;
+the read-only reference mount was empty this round, so citations point at
+SURVEY.md sections rather than file:line).
+
+Design stance (TPU-first, not a port):
+
+* Examples live in batched, device-resident arrays (``LabeledBatch``) instead
+  of per-row JVM objects; sparse features use a padded ELL layout that XLA
+  tiles well.
+* The reference's Spark ``treeAggregate`` of gradient partials becomes an
+  on-device sharded sum + ``psum`` over ICI (``photon_ml_tpu.parallel``).
+* The reference's per-entity random-effect solves (``mapValues`` of local
+  Breeze optimizers) become a ``vmap`` of fixed-shape local solves over
+  entity shards (``photon_ml_tpu.game`` — under construction; the GAME
+  layer is the next milestone after the GLM core).
+* Optimizers (L-BFGS / OWL-QN / TRON) are jitted ``lax.while_loop`` update
+  steps with on-device convergence tracking (``photon_ml_tpu.optimize``).
+"""
+
+__version__ = "0.1.0"
+
+from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.normalization import NormalizationContext, NormalizationType
+from photon_ml_tpu.ops.regularization import RegularizationContext, RegularizationType

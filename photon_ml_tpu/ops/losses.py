@@ -1,0 +1,105 @@
+"""Pointwise GLM losses as pure functions of (margin, label).
+
+TPU-native equivalent of the reference's ``PointwiseLossFunction`` family
+(``function.glm.{LogisticLossFunction, SquaredLossFunction,
+PoissonLossFunction, SmoothedHingeLossFunction}`` — SURVEY.md §3.1; reference
+mount empty, paths unverified). The reference hand-codes first/second
+derivatives w.r.t. the margin (``lossAndDzLoss`` / ``DzzLoss``); here autodiff
+supplies them, and we additionally expose closed-form ``d2`` for the diagonal
+Hessian / variance path where the second derivative is cheap and stable.
+
+Labels follow the reference's conventions: binary tasks use {0, 1} labels
+(internally mapped to ±1 where needed), regression uses real labels, Poisson
+uses non-negative counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss: per-example ``loss(margin, label)`` plus the inverse
+    link ``mean(margin)`` used for scoring, and the margin second derivative
+    ``d2`` used by diagonal-Hessian variance computation."""
+
+    name: str
+    loss: Callable[[jax.Array, jax.Array], jax.Array]
+    mean: Callable[[jax.Array], jax.Array]
+    d2: Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def _logistic_loss(margin, label):
+    # -log p(y|m) for y in {0,1}, p = sigmoid(m); stable via logaddexp.
+    return jnp.logaddexp(0.0, margin) - label * margin
+
+
+def _logistic_d2(margin, label):
+    p = jax.nn.sigmoid(margin)
+    return p * (1.0 - p)
+
+
+def _squared_loss(margin, label):
+    return 0.5 * (margin - label) ** 2
+
+
+def _poisson_loss(margin, label):
+    # NLL of Poisson with rate exp(m), dropping the label-only term log(y!).
+    return jnp.exp(margin) - label * margin
+
+
+def _smoothed_hinge_loss(margin, label):
+    # Rennie's smoothed hinge on z = (2y-1)*m:
+    #   1/2 - z      z <= 0
+    #   (1-z)^2 / 2  0 < z < 1
+    #   0            z >= 1
+    z = (2.0 * label - 1.0) * margin
+    return jnp.where(z <= 0.0, 0.5 - z, jnp.where(z < 1.0, 0.5 * (1.0 - z) ** 2, 0.0))
+
+
+def _smoothed_hinge_d2(margin, label):
+    z = (2.0 * label - 1.0) * margin
+    return jnp.where((z > 0.0) & (z < 1.0), 1.0, 0.0)
+
+
+LOGISTIC = PointwiseLoss("logistic", _logistic_loss, jax.nn.sigmoid, _logistic_d2)
+SQUARED = PointwiseLoss("squared", _squared_loss, lambda m: m, lambda m, y: jnp.ones_like(m))
+POISSON = PointwiseLoss("poisson", _poisson_loss, jnp.exp, lambda m, y: jnp.exp(m))
+SMOOTHED_HINGE = PointwiseLoss(
+    "smoothed_hinge",
+    _smoothed_hinge_loss,
+    lambda m: (m + 1.0) * 0.5,  # affine score->[~0,1] mapping for ranking metrics
+    _smoothed_hinge_d2,
+)
+
+_REGISTRY = {
+    "logistic": LOGISTIC,
+    "squared": SQUARED,
+    "linear": SQUARED,
+    "poisson": POISSON,
+    "smoothed_hinge": SMOOTHED_HINGE,
+    "hinge": SMOOTHED_HINGE,
+}
+
+# The reference's TaskType enum (LOGISTIC_REGRESSION, LINEAR_REGRESSION,
+# POISSON_REGRESSION, SMOOTHED_HINGE_LOSS_LINEAR_SVM — SURVEY.md §1).
+TASK_TO_LOSS = {
+    "logistic_regression": "logistic",
+    "linear_regression": "squared",
+    "poisson_regression": "poisson",
+    "smoothed_hinge_loss_linear_svm": "smoothed_hinge",
+}
+
+
+def get_loss(name: str) -> PointwiseLoss:
+    key = name.lower()
+    if key in TASK_TO_LOSS:
+        key = TASK_TO_LOSS[key]
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown loss '{name}'; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

@@ -1,0 +1,120 @@
+"""Feature normalization applied inside the objective, never materialized.
+
+Equivalent of the reference's ``normalization.{NormalizationContext,
+NormalizationType}`` (SURVEY.md §3.1; reference mount empty). The key trick is
+identical in spirit: for normalized features ``x'_j = (x_j - s_j) * f_j`` the
+margin factors as
+
+    x' . w = x . (f * w) - sum_j s_j f_j w_j
+
+so instead of transforming the (huge, sparse) data we transform the (small,
+dense) coefficient vector once per optimizer iteration and fold the shift term
+into the intercept. ``to_model_space`` converts optimizer-space coefficients to
+raw-feature-space coefficients for saving; ``to_training_space`` is the inverse
+(warm start).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+class NormalizationType(str, enum.Enum):
+    NONE = "none"
+    SCALE_WITH_STANDARD_DEVIATION = "scale_with_standard_deviation"
+    SCALE_WITH_MAX_MAGNITUDE = "scale_with_max_magnitude"
+    STANDARDIZATION = "standardization"
+
+
+@struct.dataclass
+class NormalizationContext:
+    """factors/shifts over the feature axis; ``intercept_index`` is the column
+    holding the constant-1 intercept feature (-1 if none). STANDARDIZATION
+    requires an intercept (the shift term must land somewhere)."""
+
+    factors: Optional[jax.Array]  # [d] or None
+    shifts: Optional[jax.Array]  # [d] or None
+    intercept_index: int = struct.field(pytree_node=False, default=-1)
+
+    def model_coefficients(self, w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Map optimizer-space w to (w_eff, margin_adjust) applied to RAW x:
+        margin_normalized(x) = x . w_eff + margin_adjust."""
+        w_eff = w
+        adjust = jnp.zeros((), w.dtype)
+        if self.factors is not None:
+            f = self.factors
+            if self.intercept_index >= 0:
+                f = f.at[self.intercept_index].set(1.0)
+            w_eff = w_eff * f
+        if self.shifts is not None:
+            s = self.shifts
+            if self.intercept_index >= 0:
+                s = s.at[self.intercept_index].set(0.0)
+            adjust = -jnp.sum(s * w_eff)
+        return w_eff, adjust
+
+    def to_model_space(self, w: jax.Array) -> jax.Array:
+        """Optimizer-space coefficients -> raw-feature-space model."""
+        if self.shifts is not None and self.intercept_index < 0:
+            # with no intercept to absorb it, the shift adjustment would be
+            # silently dropped and every saved-model prediction off by it
+            raise ValueError("shift normalization requires an intercept feature")
+        w_eff, adjust = self.model_coefficients(w)
+        if self.intercept_index >= 0:
+            w_eff = w_eff.at[self.intercept_index].add(adjust)
+        return w_eff
+
+    def to_training_space(self, w_model: jax.Array) -> jax.Array:
+        """Inverse of to_model_space (for warm starts)."""
+        w = w_model
+        if self.shifts is not None:
+            s = self.shifts
+            if self.intercept_index >= 0:
+                s = s.at[self.intercept_index].set(0.0)
+            # undo the intercept fold: adjust was -sum(s * w_eff_nonint)
+            if self.intercept_index >= 0:
+                w_no_int = w.at[self.intercept_index].set(0.0)
+                w = w.at[self.intercept_index].add(jnp.sum(s * w_no_int))
+        if self.factors is not None:
+            f = self.factors
+            if self.intercept_index >= 0:
+                f = f.at[self.intercept_index].set(1.0)
+            w = w / f
+        return w
+
+
+def no_normalization() -> Optional[NormalizationContext]:
+    return None
+
+
+def build_normalization_context(
+    norm_type: NormalizationType | str,
+    summary,
+    intercept_index: int = -1,
+) -> Optional[NormalizationContext]:
+    """Build from a per-feature :class:`~photon_ml_tpu.ops.statistics.FeatureSummary`
+    (mirrors the reference's NormalizationContext factory — SURVEY.md §3.1)."""
+    norm_type = NormalizationType(norm_type)
+    if norm_type == NormalizationType.NONE:
+        return None
+    std = np.asarray(summary.std)
+    safe_std = np.where(std > 0, std, 1.0)
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        return NormalizationContext(jnp.asarray(1.0 / safe_std), None, intercept_index)
+    if norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        mx = np.maximum(np.abs(np.asarray(summary.max)), np.abs(np.asarray(summary.min)))
+        mx = np.where(mx > 0, mx, 1.0)
+        return NormalizationContext(jnp.asarray(1.0 / mx), None, intercept_index)
+    if norm_type == NormalizationType.STANDARDIZATION:
+        if intercept_index < 0:
+            raise ValueError("STANDARDIZATION requires an intercept feature")
+        return NormalizationContext(
+            jnp.asarray(1.0 / safe_std), jnp.asarray(np.asarray(summary.mean)), intercept_index
+        )
+    raise ValueError(f"unhandled normalization type {norm_type}")

@@ -1,0 +1,51 @@
+"""L1 / L2 / elastic-net regularization contexts.
+
+Equivalent of the reference's ``optimization.{RegularizationContext,
+RegularizationType}`` (SURVEY.md §3.1; reference mount empty). Semantics match
+the reference: the L2 part is folded analytically into the smooth objective
+(value/gradient/Hessian); the L1 part is NOT part of the smooth objective and
+is handled by the OWL-QN optimizer. Elastic net splits the regularization
+weight by ``alpha``: L1 gets ``alpha * lambda``, L2 gets ``(1-alpha) * lambda``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class RegularizationType(str, enum.Enum):
+    NONE = "none"
+    L1 = "l1"
+    L2 = "l2"
+    ELASTIC_NET = "elastic_net"
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    reg_type: RegularizationType = RegularizationType.NONE
+    # elastic-net mixing in [0,1]: fraction of the weight that is L1.
+    alpha: float = 0.5
+
+    def __post_init__(self):
+        object.__setattr__(self, "reg_type", RegularizationType(self.reg_type))
+        if not (0.0 <= self.alpha <= 1.0):
+            raise ValueError(f"elastic-net alpha must be in [0,1], got {self.alpha}")
+
+    def l1_weight(self, reg_weight: float) -> float:
+        if self.reg_type == RegularizationType.L1:
+            return reg_weight
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            return self.alpha * reg_weight
+        return 0.0
+
+    def l2_weight(self, reg_weight: float) -> float:
+        if self.reg_type == RegularizationType.L2:
+            return reg_weight
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            return (1.0 - self.alpha) * reg_weight
+        return 0.0
+
+    @property
+    def needs_owlqn(self) -> bool:
+        return self.reg_type in (RegularizationType.L1, RegularizationType.ELASTIC_NET)

@@ -1,0 +1,14 @@
+from photon_ml_tpu.optimize.common import OptimizationResult, OptimizerConfig
+from photon_ml_tpu.optimize.lbfgs import lbfgs
+from photon_ml_tpu.optimize.owlqn import owlqn
+from photon_ml_tpu.optimize.tron import tron
+
+
+OPTIMIZERS = {"lbfgs": lbfgs, "owlqn": owlqn, "tron": tron}
+
+
+def get_optimizer(name: str):
+    key = name.lower()
+    if key not in OPTIMIZERS:
+        raise ValueError(f"unknown optimizer '{name}'; known: {sorted(OPTIMIZERS)}")
+    return OPTIMIZERS[key]

@@ -1,0 +1,64 @@
+"""Shared optimizer machinery: config, convergence, state tracking.
+
+Equivalent of the reference's abstract ``optimization.Optimizer`` +
+``OptimizationStatesTracker`` (SURVEY.md §3.1; reference mount empty):
+convergence on relative-loss change and normalized gradient norm with a max
+iteration cap, and a per-iteration (loss, gradient-norm) history. The tracker
+here is a pair of fixed-length device arrays filled inside the jitted
+``lax.while_loop`` — readable after the fact without host round-trips per
+iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Mirrors the reference's per-coordinate optimizer config surface
+    (optimizer type, max iters, tolerance — SURVEY.md §5.6)."""
+
+    max_iters: int = 100
+    tolerance: float = 1e-7
+    # L-BFGS/OWL-QN history length (Breeze default is 10 ranks).
+    history: int = 10
+    # line-search evaluation cap per iteration
+    max_line_search_steps: int = 25
+
+
+class OptimizationResult(NamedTuple):
+    """Final point + convergence record (OptimizationStatesTracker role)."""
+
+    w: jax.Array
+    value: jax.Array
+    grad_norm: jax.Array
+    iterations: jax.Array  # i32 scalar
+    converged: jax.Array  # bool scalar
+    loss_history: jax.Array  # [max_iters] padded with NaN past `iterations`
+    grad_norm_history: jax.Array  # [max_iters] same padding
+
+
+def converged_check(f_prev, f, g_norm, g0_norm, tol):
+    """Reference-style stopping rule: relative loss change below tol OR
+    gradient norm below tol * max(1, ||g0||). The tolerance is clamped to a
+    few ulps of the working dtype so a tol tuned for f64 (e.g. 1e-9) still
+    terminates in f32/bf16 instead of spinning to max_iters."""
+    eps = jnp.finfo(jnp.asarray(f).dtype).eps
+    tol = jnp.maximum(jnp.asarray(tol, jnp.asarray(f).dtype), 4 * eps)
+    rel_loss = jnp.abs(f_prev - f) <= tol * jnp.maximum(jnp.abs(f_prev), 1.0)
+    grad_small = g_norm <= tol * jnp.maximum(g0_norm, 1.0)
+    return rel_loss | grad_small
+
+
+def init_history(max_iters: int, dtype) -> tuple[jax.Array, jax.Array]:
+    nan = jnp.full((max_iters,), jnp.nan, dtype)
+    return nan, nan
+
+
+def l2_norm(a):
+    return jnp.sqrt(jnp.sum(a * a))

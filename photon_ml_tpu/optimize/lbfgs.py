@@ -1,0 +1,138 @@
+"""Jitted L-BFGS with strong-Wolfe line search.
+
+Equivalent of the reference's ``optimization.LBFGS`` (which wraps Breeze
+L-BFGS with a strong-Wolfe search — SURVEY.md §3.1; reference mount empty),
+rebuilt as a single ``lax.while_loop`` whose carry holds the circular
+(s, y) history, so the whole optimization is one XLA computation: no
+per-iteration host round-trip, and under sharded batches the gradient's
+all-reduce rides ICI inside the same program (the ``treeAggregate``
+replacement, SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optimize.common import (
+    OptimizationResult,
+    OptimizerConfig,
+    converged_check,
+    init_history,
+    l2_norm,
+)
+from photon_ml_tpu.optimize.linesearch import strong_wolfe
+
+
+class _State(NamedTuple):
+    it: jax.Array  # iteration counter
+    k: jax.Array  # number of (s,y) pairs ever stored (head of circular buffer)
+    w: jax.Array
+    f: jax.Array
+    g: jax.Array
+    s_hist: jax.Array  # [m, d]
+    y_hist: jax.Array  # [m, d]
+    rho: jax.Array  # [m]
+    converged: jax.Array
+    stalled: jax.Array
+    loss_hist: jax.Array
+    gnorm_hist: jax.Array
+
+
+def two_loop_direction(g, s_hist, y_hist, rho, k, m):
+    """Two-loop recursion over a circular buffer; slot (k-1-i) mod m is the
+    i-th most recent pair, masked out when i >= min(k, m)."""
+    dtype = g.dtype
+    n_valid = jnp.minimum(k, m)
+
+    def newest_to_oldest(i, carry):
+        q, alphas = carry
+        j = jnp.mod(k - 1 - i, m)
+        valid = i < n_valid
+        a = jnp.where(valid, rho[j] * jnp.sum(s_hist[j] * q), 0.0)
+        q = q - a * y_hist[j]
+        return q, alphas.at[j].set(a)
+
+    q, alphas = lax.fori_loop(0, m, newest_to_oldest, (g, jnp.zeros((m,), dtype)))
+
+    newest = jnp.mod(k - 1, m)
+    sy = jnp.sum(s_hist[newest] * y_hist[newest])
+    yy = jnp.sum(y_hist[newest] * y_hist[newest])
+    gamma = jnp.where((k > 0) & (yy > 0), sy / jnp.maximum(yy, jnp.finfo(dtype).tiny), 1.0)
+    r = gamma * q
+
+    def oldest_to_newest(i, r):
+        rank = n_valid - 1 - i  # recency rank, oldest first
+        j = jnp.mod(k - 1 - rank, m)
+        valid = rank >= 0
+        beta = rho[j] * jnp.sum(y_hist[j] * r)
+        upd = s_hist[j] * (alphas[j] - beta)
+        return r + jnp.where(valid, upd, 0.0)
+
+    r = lax.fori_loop(0, m, oldest_to_newest, r)
+    return -r
+
+
+def lbfgs(
+    fun_and_grad: Callable,
+    w0: jax.Array,
+    config: OptimizerConfig = OptimizerConfig(),
+) -> OptimizationResult:
+    """Minimize fun(w); fun_and_grad(w) -> (f, g). Fully jittable."""
+    m = config.history
+    d = w0.shape[0]
+    dtype = w0.dtype
+    f0, g0 = fun_and_grad(w0)
+    g0_norm = l2_norm(g0)
+    loss_hist, gnorm_hist = init_history(config.max_iters, f0.dtype)
+
+    def body(s: _State) -> _State:
+        p = two_loop_direction(s.g, s.s_hist, s.y_hist, s.rho, s.k, m)
+        # ensure descent; fall back to steepest descent if the metric degraded
+        dg = jnp.sum(p * s.g)
+        p = jnp.where(dg < 0, p, -s.g)
+        alpha0 = jnp.where(s.k > 0, 1.0, 1.0 / jnp.maximum(l2_norm(s.g), 1.0))
+        ls = strong_wolfe(
+            fun_and_grad, s.w, p, s.f, s.g, alpha0=alpha0,
+            max_evals=config.max_line_search_steps,
+        )
+        w_new = s.w + ls.alpha * p
+        step = ls.alpha * p
+        y = ls.g - s.g
+        sy = jnp.sum(step * y)
+        store = ls.ok & (
+            sy > 1e-10 * jnp.maximum(l2_norm(step) * l2_norm(y), jnp.finfo(dtype).tiny)
+        )
+        slot = jnp.mod(s.k, m)
+        s_hist = jnp.where(store, s.s_hist.at[slot].set(step), s.s_hist)
+        y_hist = jnp.where(store, s.y_hist.at[slot].set(y), s.y_hist)
+        rho = jnp.where(store, s.rho.at[slot].set(1.0 / jnp.where(sy == 0, 1.0, sy)), s.rho)
+        k_new = jnp.where(store, s.k + 1, s.k)
+        gnorm = l2_norm(ls.g)
+        conv = converged_check(s.f, ls.f, gnorm, g0_norm, config.tolerance)
+        return _State(
+            s.it + 1, k_new, w_new, ls.f, ls.g,
+            s_hist, y_hist, rho,
+            conv, ~ls.ok,
+            s.loss_hist.at[s.it].set(ls.f),
+            s.gnorm_hist.at[s.it].set(gnorm),
+        )
+
+    def cond(s: _State):
+        return (~s.converged) & (~s.stalled) & (s.it < config.max_iters)
+
+    init = _State(
+        it=jnp.asarray(0), k=jnp.asarray(0), w=w0, f=f0, g=g0,
+        s_hist=jnp.zeros((m, d), dtype), y_hist=jnp.zeros((m, d), dtype),
+        rho=jnp.zeros((m,), dtype),
+        converged=jnp.asarray(False), stalled=jnp.asarray(False),
+        loss_hist=loss_hist, gnorm_hist=gnorm_hist,
+    )
+    s = lax.while_loop(cond, body, init)
+    return OptimizationResult(
+        w=s.w, value=s.f, grad_norm=l2_norm(s.g), iterations=s.it,
+        converged=s.converged, loss_history=s.loss_hist, grad_norm_history=s.gnorm_hist,
+    )

@@ -1,0 +1,131 @@
+"""Jitted OWL-QN (Orthant-Wise Limited-memory Quasi-Newton) for L1 /
+elastic-net objectives.
+
+Equivalent of the reference's ``optimization.OWLQN`` (which wraps Breeze
+OWLQN — SURVEY.md §3.1; reference mount empty). Minimizes
+F(w) = f(w) + l1 * ||w * mask||_1 where f is smooth (the elastic net's L2 part
+lives inside f, matching the reference's split — SURVEY.md §3.1
+regularization row). Standard Andrew & Gao (2007) scheme: pseudo-gradient,
+L-BFGS direction from smooth-gradient history, orthant projection of both the
+direction and the line-search iterates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optimize.common import (
+    OptimizationResult,
+    OptimizerConfig,
+    converged_check,
+    init_history,
+    l2_norm,
+)
+from photon_ml_tpu.optimize.lbfgs import two_loop_direction
+from photon_ml_tpu.optimize.linesearch import backtracking
+
+
+def pseudo_gradient(w, g, l1):
+    """Directional-derivative-minimizing subgradient of f + l1*|w|_1."""
+    right = g + l1
+    left = g - l1
+    at_zero = jnp.where(right < 0, right, jnp.where(left > 0, left, 0.0))
+    return jnp.where(w > 0, right, jnp.where(w < 0, left, at_zero))
+
+
+class _State(NamedTuple):
+    it: jax.Array
+    k: jax.Array
+    w: jax.Array
+    F: jax.Array  # full objective incl. L1
+    g: jax.Array  # smooth gradient
+    s_hist: jax.Array
+    y_hist: jax.Array
+    rho: jax.Array
+    converged: jax.Array
+    stalled: jax.Array
+    loss_hist: jax.Array
+    gnorm_hist: jax.Array
+
+
+def owlqn(
+    fun_and_grad: Callable,
+    w0: jax.Array,
+    l1_weight,
+    config: OptimizerConfig = OptimizerConfig(),
+    l1_mask: Optional[jax.Array] = None,
+) -> OptimizationResult:
+    """Minimize f(w) + l1_weight * ||w * l1_mask||_1; fun_and_grad is the
+    smooth part. l1_mask defaults to all-ones (mask the intercept with 0)."""
+    m = config.history
+    d = w0.shape[0]
+    dtype = w0.dtype
+    mask = jnp.ones((d,), dtype) if l1_mask is None else l1_mask.astype(dtype)
+    lam = jnp.asarray(l1_weight, dtype) * mask
+
+    def full_value(w):
+        f, _ = fun_and_grad(w)
+        return f + jnp.sum(lam * jnp.abs(w))
+
+    f0, g0 = fun_and_grad(w0)
+    F0 = f0 + jnp.sum(lam * jnp.abs(w0))
+    pg0_norm = l2_norm(pseudo_gradient(w0, g0, lam))
+    loss_hist, gnorm_hist = init_history(config.max_iters, F0.dtype)
+
+    def body(s: _State) -> _State:
+        pg = pseudo_gradient(s.w, s.g, lam)
+        p = two_loop_direction(pg, s.s_hist, s.y_hist, s.rho, s.k, m)
+        # align the direction with -pg (orthant-wise projection of direction)
+        p = jnp.where(p * (-pg) > 0, p, 0.0)
+        dg = jnp.sum(p * pg)
+        p = jnp.where(dg < 0, p, -pg)
+        # orthant choice: sign(w), or sign(-pg) where w == 0
+        xi = jnp.where(s.w != 0, jnp.sign(s.w), jnp.sign(-pg))
+
+        def project(w_trial):
+            return jnp.where(w_trial * xi > 0, w_trial, 0.0)
+
+        alpha0 = jnp.where(s.k > 0, 1.0, 1.0 / jnp.maximum(l2_norm(pg), 1.0))
+        w_new, F_new, _, ok = backtracking(
+            full_value, s.w, p, s.F, pg, alpha0=alpha0,
+            max_evals=config.max_line_search_steps, project=project,
+        )
+        _, g_new = fun_and_grad(w_new)
+        step = w_new - s.w
+        y = g_new - s.g
+        sy = jnp.sum(step * y)
+        store = ok & (sy > 1e-10 * jnp.maximum(l2_norm(step) * l2_norm(y), jnp.finfo(dtype).tiny))
+        slot = jnp.mod(s.k, m)
+        s_hist = jnp.where(store, s.s_hist.at[slot].set(step), s.s_hist)
+        y_hist = jnp.where(store, s.y_hist.at[slot].set(y), s.y_hist)
+        rho = jnp.where(store, s.rho.at[slot].set(1.0 / jnp.where(sy == 0, 1.0, sy)), s.rho)
+        k_new = jnp.where(store, s.k + 1, s.k)
+        pg_new_norm = l2_norm(pseudo_gradient(w_new, g_new, lam))
+        conv = converged_check(s.F, F_new, pg_new_norm, pg0_norm, config.tolerance)
+        return _State(
+            s.it + 1, k_new, w_new, F_new, g_new,
+            s_hist, y_hist, rho, conv, ~ok,
+            s.loss_hist.at[s.it].set(F_new),
+            s.gnorm_hist.at[s.it].set(pg_new_norm),
+        )
+
+    def cond(s: _State):
+        return (~s.converged) & (~s.stalled) & (s.it < config.max_iters)
+
+    init = _State(
+        it=jnp.asarray(0), k=jnp.asarray(0), w=w0, F=F0, g=g0,
+        s_hist=jnp.zeros((m, d), dtype), y_hist=jnp.zeros((m, d), dtype),
+        rho=jnp.zeros((m,), dtype),
+        converged=jnp.asarray(False), stalled=jnp.asarray(False),
+        loss_hist=loss_hist, gnorm_hist=gnorm_hist,
+    )
+    s = lax.while_loop(cond, body, init)
+    final_pg = pseudo_gradient(s.w, s.g, lam)
+    return OptimizationResult(
+        w=s.w, value=s.F, grad_norm=l2_norm(final_pg), iterations=s.it,
+        converged=s.converged, loss_history=s.loss_hist, grad_norm_history=s.gnorm_hist,
+    )

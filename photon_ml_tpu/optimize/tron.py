@@ -1,0 +1,169 @@
+"""Jitted TRON: trust-region Newton with a conjugate-gradient inner loop.
+
+Equivalent of the reference's own ``optimization.TRON`` implementation (from
+LIBLINEAR's algorithm, Lin & Moré — SURVEY.md §3.1; reference mount empty).
+The decisive TPU difference (SURVEY.md §4.2): the reference pays one full
+cluster ``treeAggregate`` per CG step for each Hessian-vector product; here an
+HVP is forward-over-reverse autodiff inside the same XLA program — roughly two
+fused gradient passes, with any cross-device reduction riding ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optimize.common import (
+    OptimizationResult,
+    OptimizerConfig,
+    converged_check,
+    init_history,
+    l2_norm,
+)
+
+# Lin-Moré / LIBLINEAR constants
+_ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
+_SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
+
+
+class _CGState(NamedTuple):
+    s: jax.Array
+    r: jax.Array
+    d: jax.Array
+    rr: jax.Array
+    i: jax.Array
+    done: jax.Array
+
+
+def _steihaug_cg(hvp: Callable, g: jax.Array, delta, cg_tol, max_cg: int):
+    """Approximately minimize q(s) = g.s + 0.5 s.H.s within ||s|| <= delta."""
+
+    def boundary_tau(s, d):
+        sd = jnp.sum(s * d)
+        dd = jnp.sum(d * d)
+        ss = jnp.sum(s * s)
+        disc = jnp.sqrt(jnp.maximum(sd * sd + dd * (delta * delta - ss), 0.0))
+        return (-sd + disc) / jnp.maximum(dd, jnp.finfo(d.dtype).tiny)
+
+    def body(st: _CGState) -> _CGState:
+        Hd = hvp(st.d)
+        dHd = jnp.sum(st.d * Hd)
+        neg_curv = dHd <= 0
+        alpha = st.rr / jnp.where(neg_curv, 1.0, dHd)
+        outside = l2_norm(st.s + alpha * st.d) >= delta
+        hit = neg_curv | outside
+        # one uniform update keeps r == -(g + H s) exact even on the
+        # boundary step, so the caller can form prered from (s, r) alone
+        step = jnp.where(hit, boundary_tau(st.s, st.d), alpha)
+        s_new = st.s + step * st.d
+        r_new = st.r - step * Hd
+        rr_new = jnp.sum(r_new * r_new)
+        beta = rr_new / jnp.maximum(st.rr, jnp.finfo(st.rr.dtype).tiny)
+        d_new = r_new + beta * st.d
+        done = hit | (jnp.sqrt(rr_new) <= cg_tol)
+        return _CGState(s_new, r_new, d_new, rr_new, st.i + 1, done)
+
+    def cond(st: _CGState):
+        return (~st.done) & (st.i < max_cg)
+
+    r0 = -g
+    init = _CGState(jnp.zeros_like(g), r0, r0, jnp.sum(r0 * r0), jnp.asarray(0), jnp.asarray(False))
+    st = lax.while_loop(cond, body, init)
+    return st.s, st.r, st.i
+
+
+class _State(NamedTuple):
+    it: jax.Array
+    w: jax.Array
+    f: jax.Array
+    g: jax.Array
+    delta: jax.Array
+    converged: jax.Array
+    stalled: jax.Array
+    loss_hist: jax.Array
+    gnorm_hist: jax.Array
+
+
+def tron(
+    fun_and_grad: Callable,
+    w0: jax.Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    hvp: Callable | None = None,
+    max_cg_iters: int | None = None,
+) -> OptimizationResult:
+    """Minimize fun(w). ``hvp(w, v)`` defaults to forward-over-reverse autodiff
+    of the gradient part of ``fun_and_grad``."""
+    dtype = w0.dtype
+    if hvp is None:
+        grad_only = lambda w: fun_and_grad(w)[1]
+
+        def hvp(w, v):
+            return jax.jvp(grad_only, (w,), (v,))[1]
+
+    max_cg = max_cg_iters if max_cg_iters is not None else max(w0.shape[0], 20)
+    f0, g0 = fun_and_grad(w0)
+    g0_norm = l2_norm(g0)
+    loss_hist, gnorm_hist = init_history(config.max_iters, f0.dtype)
+
+    def body(s: _State) -> _State:
+        cg_tol = 0.1 * l2_norm(s.g)
+        step, r, _ = _steihaug_cg(lambda v: hvp(s.w, v), s.g, s.delta, cg_tol, max_cg)
+        w_try = s.w + step
+        f_try, g_try = fun_and_grad(w_try)
+        gs = jnp.sum(s.g * step)
+        # r == -(g + H step) from CG, so s.H.s = -g.s - r.s and
+        # prered = -(g.s + s.H.s/2) = 0.5*(r.s - g.s) — no extra HVP needed
+        prered = 0.5 * (jnp.sum(step * r) - gs)
+        actred = s.f - f_try
+        snorm = l2_norm(step)
+
+        # Lin-Moré radius update via quadratic interpolation
+        denom = f_try - s.f - gs
+        alpha = jnp.where(denom <= 0, _SIGMA3, jnp.maximum(_SIGMA1, -0.5 * (gs / jnp.where(denom == 0, 1.0, denom))))
+        delta = jnp.where(
+            actred < _ETA0 * prered,
+            jnp.minimum(jnp.maximum(alpha, _SIGMA1) * snorm, _SIGMA2 * s.delta),
+            jnp.where(
+                actred < _ETA1 * prered,
+                jnp.maximum(_SIGMA1 * s.delta, jnp.minimum(alpha * snorm, _SIGMA2 * s.delta)),
+                jnp.where(
+                    actred < _ETA2 * prered,
+                    jnp.maximum(_SIGMA1 * s.delta, jnp.minimum(alpha * snorm, _SIGMA3 * s.delta)),
+                    jnp.maximum(s.delta, jnp.minimum(alpha * snorm, _SIGMA3 * s.delta)),
+                ),
+            ),
+        )
+        accept = actred > _ETA0 * prered
+        w_new = jnp.where(accept, w_try, s.w)
+        f_new = jnp.where(accept, f_try, s.f)
+        g_new = jnp.where(accept, g_try, s.g)
+        gnorm = l2_norm(g_new)
+        conv = accept & converged_check(s.f, f_new, gnorm, g0_norm, config.tolerance)
+        # the quadratic model predicting no significant reduction IS
+        # convergence (nothing left to gain at this dtype's resolution)
+        eps = jnp.finfo(dtype).eps
+        conv = conv | (prered <= eps * jnp.maximum(jnp.abs(s.f), 1.0))
+        # radius below step resolution at w means further steps can't move w
+        stalled = delta < eps * jnp.maximum(l2_norm(w_new), 1.0)
+        return _State(
+            s.it + 1, w_new, f_new, g_new, delta, conv, stalled,
+            s.loss_hist.at[s.it].set(f_new),
+            s.gnorm_hist.at[s.it].set(gnorm),
+        )
+
+    def cond(s: _State):
+        return (~s.converged) & (~s.stalled) & (s.it < config.max_iters)
+
+    init = _State(
+        it=jnp.asarray(0), w=w0, f=f0, g=g0,
+        delta=g0_norm, converged=jnp.asarray(False), stalled=jnp.asarray(False),
+        loss_hist=loss_hist, gnorm_hist=gnorm_hist,
+    )
+    s = lax.while_loop(cond, body, init)
+    return OptimizationResult(
+        w=s.w, value=s.f, grad_norm=l2_norm(s.g), iterations=s.it,
+        converged=s.converged, loss_history=s.loss_hist, grad_norm_history=s.gnorm_hist,
+    )

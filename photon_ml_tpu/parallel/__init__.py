@@ -1,0 +1,6 @@
+from photon_ml_tpu.parallel.mesh import make_mesh, pad_batch, shard_batch
+from photon_ml_tpu.parallel.data_parallel import (
+    distributed_value_and_grad,
+    distributed_hvp,
+    fit_distributed,
+)

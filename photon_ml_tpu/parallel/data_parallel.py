@@ -1,0 +1,125 @@
+"""Data-parallel objective evaluation: the ``DistributedGLMLossFunction``
+equivalent (SURVEY.md §3.2/§4.2; reference mount empty).
+
+The reference broadcasts coefficients to executors and tree-aggregates
+per-partition (loss, gradient) partials back to the driver each optimizer
+iteration. Here the batch lives sharded over the mesh's ``data`` axis, the
+coefficient vector is replicated, and a ``shard_map`` computes per-shard
+partial sums joined by ``lax.psum`` over ICI — one XLA program, no host in
+the loop. The entire optimizer (L-BFGS/TRON/OWL-QN ``while_loop``) jits
+*around* this, so a whole fit is a single device computation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
+from photon_ml_tpu.optimize.common import OptimizationResult
+from photon_ml_tpu.parallel.mesh import shard_batch
+from photon_ml_tpu.types import LabeledBatch
+
+
+def distributed_value_and_grad(
+    objective: GLMObjective, mesh: Mesh, axis: str = "data"
+) -> Callable:
+    """Returns fg(w, batch, l2) -> (value, grad) with batch rows sharded over
+    ``axis``. The L2 term is added once globally (outside the psum), matching
+    the single-device objective exactly."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P()),
+        out_specs=(P(), P()),
+    )
+    def shard_fg(w, batch, l2):
+        # Per-shard data term only; L2 added globally afterwards. Only the
+        # value needs an explicit psum: under shard_map's varying-axis
+        # tracking (check_vma), the AD transpose of "replicated w touches
+        # sharded batch" inserts the gradient's all-reduce automatically —
+        # psumming g again would multiply it by the axis size.
+        f, g = objective.value_and_grad(w, batch, 0.0)
+        return lax.psum(f, axis), g
+
+    def fg(w, batch, l2=0.0):
+        l2 = jnp.asarray(l2, w.dtype)
+        f, g = shard_fg(w, batch, l2)
+        wr = objective._reg_mask(w)
+        return f + 0.5 * l2 * jnp.sum(wr * wr), g + l2 * wr
+
+    return fg
+
+
+def distributed_hvp(objective: GLMObjective, mesh: Mesh, axis: str = "data") -> Callable:
+    """Returns hvp(w, v, batch, l2) sharded like distributed_value_and_grad.
+    This is what the reference's HessianVectorAggregator treeAggregate does
+    per CG step (SURVEY.md §4.2), as one on-device collective."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis)),
+        out_specs=P(),
+    )
+    def shard_hvp(w, v, batch):
+        # Like the gradient, the HVP's all-reduce is inserted by the AD
+        # transpose (w and v are replicated, batch varies over `axis`).
+        grad_data = lambda x: objective.grad(x, batch, 0.0)
+        return jax.jvp(grad_data, (w,), (v,))[1]
+
+    def hvp(w, v, batch, l2=0.0):
+        l2 = jnp.asarray(l2, w.dtype)
+        hv = shard_hvp(w, v, batch)
+        vr = objective._reg_mask(v)
+        return hv + l2 * vr
+
+    return hvp
+
+
+def fit_distributed(
+    objective: GLMObjective,
+    batch: LabeledBatch,
+    mesh: Mesh,
+    w0: jax.Array,
+    l2=0.0,
+    l1=0.0,
+    optimizer: str = "lbfgs",
+    config: OptimizerConfig = OptimizerConfig(),
+    axis: str = "data",
+) -> OptimizationResult:
+    """Shard the batch over the mesh and run a full jitted fit — the
+    ``DistributedOptimizationProblem.run`` equivalent (SURVEY.md §3.2)."""
+    batch = shard_batch(batch, mesh, axis)
+    fg = distributed_value_and_grad(objective, mesh, axis)
+    opt = get_optimizer(optimizer)
+
+    if optimizer == "owlqn":
+        # keep L1 intercept handling consistent with the objective's L2 mask
+        l1_mask = None
+        if objective.intercept_index >= 0 and not objective.regularize_intercept:
+            l1_mask = jnp.ones_like(w0).at[objective.intercept_index].set(0.0)
+        run = jax.jit(
+            lambda w0, b, l2v, l1v: opt(
+                lambda w: fg(w, b, l2v), w0, l1v, config, l1_mask=l1_mask
+            )
+        )
+        return run(w0, batch, l2, l1)
+    if optimizer == "tron":
+        hvp = distributed_hvp(objective, mesh, axis)
+        run = jax.jit(
+            lambda w0, b, l2v: opt(
+                lambda w: fg(w, b, l2v), w0, config,
+                hvp=lambda w, v: hvp(w, v, b, l2v),
+            )
+        )
+        return run(w0, batch, l2)
+    run = jax.jit(lambda w0, b, l2v: opt(lambda w: fg(w, b, l2v), w0, config))
+    return run(w0, batch, l2)

@@ -1,0 +1,80 @@
+"""Device mesh + batch sharding helpers.
+
+The communication layer of the rebuild (SURVEY.md §5.8; reference mount
+empty): where the reference uses Spark primitives — ``treeAggregate`` for
+gradient reductions, torrent ``broadcast`` for coefficients, shuffles for
+entity grouping — this framework uses a ``jax.sharding.Mesh`` with XLA
+collectives over ICI/DCN: ``psum`` replaces ``treeAggregate``, replicated
+shardings replace broadcast, and device_put with entity-sharded layouts
+replaces the shuffle.
+
+Mesh axes used across the framework:
+  * ``data``   — examples (fixed-effect data parallelism)
+  * ``entity`` — random-effect entities (the reference's entity partitioning)
+Both can coexist in one mesh for a full GAME step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+
+def make_mesh(axis_sizes: Mapping[str, int] | None = None, devices=None) -> Mesh:
+    """Build a mesh, e.g. make_mesh({"data": 8}) or {"data": 4, "entity": 2}.
+
+    With no arguments, uses all local devices on a single "data" axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axis_sizes is None:
+        axis_sizes = {"data": len(devices)}
+    names = tuple(axis_sizes)
+    sizes = tuple(axis_sizes[n] for n in names)
+    total = math.prod(sizes)
+    if total > len(devices):
+        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def pad_batch(batch: LabeledBatch, multiple: int) -> LabeledBatch:
+    """Pad rows to a multiple of ``multiple`` with weight-0 rows, which are
+    exact no-ops under the sum-semantics objective."""
+    n = batch.num_examples
+    target = -(-n // multiple) * multiple
+    pad = target - n
+    if pad == 0:
+        return batch
+    pad0 = lambda a: jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], 0)
+    if isinstance(batch.features, SparseFeatures):
+        feats = SparseFeatures(
+            indices=pad0(batch.features.indices),
+            values=pad0(batch.features.values),
+            dim=batch.features.dim,
+        )
+    else:
+        feats = pad0(batch.features)
+    # padded labels of 1.0 keep poisson/logistic losses finite at any margin
+    labels = jnp.concatenate([batch.labels, jnp.ones((pad,), batch.labels.dtype)], 0)
+    return LabeledBatch(feats, labels, pad0(batch.offsets), pad0(batch.weights))
+
+
+def shard_batch(batch: LabeledBatch, mesh: Mesh, axis: str = "data") -> LabeledBatch:
+    """Pad rows to the axis size and lay the batch out shard-by-row on the
+    mesh (the device boundary the reference crosses by partitioning RDDs —
+    SURVEY.md §4.1)."""
+    batch = pad_batch(batch, mesh.shape[axis])
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+
+def replicate(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))

@@ -1,0 +1,229 @@
+"""Core batched data types.
+
+TPU-native equivalent of the reference's per-example data model
+(``data.LabeledPoint(label, features, offset, weight)`` — SURVEY.md §3.1;
+reference mount empty, paths unverified). Instead of one object per example we
+hold batched device-resident arrays: a :class:`LabeledBatch` is a pytree so it
+crosses ``jit``/``shard_map`` boundaries and can be sharded over a mesh axis.
+
+Sparse features use a row-padded ELL layout (``indices``/``values`` of shape
+``[n, k]``): every row is padded to the same nnz width with ``value == 0``
+entries, which contribute nothing to margins or gradients regardless of the
+padding index. This gives XLA static shapes (no CSR pointer chasing) and keeps
+the hot ops — margin gather and gradient scatter-add — vectorized.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class SparseFeatures:
+    """Row-padded sparse feature matrix (ELL layout).
+
+    Attributes:
+      indices: int32 ``[n, k]`` column ids; padding slots may hold any valid
+        index (conventionally 0) because their value is 0.
+      values: ``[n, k]`` feature values; 0.0 in padding slots.
+      dim: static number of feature columns (the dense width).
+    """
+
+    indices: jax.Array
+    values: jax.Array
+    dim: int = struct.field(pytree_node=False)
+
+    @property
+    def num_rows(self) -> int:
+        return self.values.shape[0]
+
+    def slice_rows(self, start: int, size: int) -> "SparseFeatures":
+        return SparseFeatures(
+            indices=jax.lax.dynamic_slice_in_dim(self.indices, start, size, 0),
+            values=jax.lax.dynamic_slice_in_dim(self.values, start, size, 0),
+            dim=self.dim,
+        )
+
+    def todense(self) -> jax.Array:
+        n, k = self.values.shape
+        out = jnp.zeros((n, self.dim), self.values.dtype)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
+        return out.at[rows, self.indices].add(self.values)
+
+
+Features = Union[jax.Array, SparseFeatures]
+
+
+def margins(features: Features, w: jax.Array) -> jax.Array:
+    """Per-row margin ``x_i . w`` for dense ``[n, d]`` or sparse features."""
+    if isinstance(features, SparseFeatures):
+        return jnp.sum(features.values * w[features.indices], axis=-1)
+    return features @ w
+
+
+def transpose_apply(features: Features, d: jax.Array) -> jax.Array:
+    """``X^T d`` — the gradient-side contraction.
+
+    Dense path is a plain matmul (MXU); sparse path is a scatter-add over the
+    padded layout (padding contributes 0 because its value is 0).
+    """
+    if isinstance(features, SparseFeatures):
+        contrib = features.values * d[:, None]
+        out = jnp.zeros((features.dim,), contrib.dtype)
+        return out.at[features.indices.reshape(-1)].add(contrib.reshape(-1))
+    return features.T @ d
+
+
+def feature_dim(features: Features) -> int:
+    if isinstance(features, SparseFeatures):
+        return features.dim
+    return features.shape[1]
+
+
+def num_rows(features: Features) -> int:
+    if isinstance(features, SparseFeatures):
+        return features.num_rows
+    return features.shape[0]
+
+
+def row_squares_apply(features: Features, d: jax.Array) -> jax.Array:
+    """``sum_i d_i * x_i^2`` (elementwise square) — used for diagonal Hessians
+    and per-feature second moments (variance computation, SURVEY.md §3.2)."""
+    if isinstance(features, SparseFeatures):
+        contrib = (features.values**2) * d[:, None]
+        out = jnp.zeros((features.dim,), contrib.dtype)
+        return out.at[features.indices.reshape(-1)].add(contrib.reshape(-1))
+    return (features**2).T @ d
+
+
+@struct.dataclass
+class LabeledBatch:
+    """A batch of weighted, offset labeled examples (the reference's
+    ``LabeledPoint`` batched — SURVEY.md §3.1).
+
+    ``offsets`` are added to margins before the loss (the residual-score /
+    GAME-coordinate mechanism rides on them); ``weights`` multiply per-example
+    losses. Objectives use *sum* (not mean) semantics to match the reference's
+    aggregation.
+    """
+
+    features: Features
+    labels: jax.Array
+    offsets: jax.Array
+    weights: jax.Array
+
+    @property
+    def num_examples(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return feature_dim(self.features)
+
+    def with_offsets(self, offsets: jax.Array) -> "LabeledBatch":
+        return self.replace(offsets=offsets)
+
+    def slice_rows(self, start: int, size: int) -> "LabeledBatch":
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, start, size, 0)
+        feats = (
+            self.features.slice_rows(start, size)
+            if isinstance(self.features, SparseFeatures)
+            else sl(self.features)
+        )
+        return LabeledBatch(feats, sl(self.labels), sl(self.offsets), sl(self.weights))
+
+
+def make_batch(
+    features,
+    labels,
+    offsets=None,
+    weights=None,
+    dtype=jnp.float32,
+) -> LabeledBatch:
+    """Build a LabeledBatch from host data (numpy / lists / scipy.sparse)."""
+    dtype = jax.dtypes.canonicalize_dtype(dtype)
+    labels = jnp.asarray(labels, dtype)
+    n = labels.shape[0]
+    if offsets is None:
+        offsets = jnp.zeros((n,), dtype)
+    else:
+        offsets = jnp.asarray(offsets, dtype)
+    if weights is None:
+        weights = jnp.ones((n,), dtype)
+    else:
+        weights = jnp.asarray(weights, dtype)
+    if not isinstance(features, (jax.Array, SparseFeatures)):
+        features = _coerce_features(features, dtype)
+    return LabeledBatch(features, labels, offsets, weights)
+
+
+def _coerce_features(features, dtype) -> Features:
+    try:
+        import scipy.sparse as sp
+
+        if sp.issparse(features):
+            return sparse_from_scipy(features, dtype=dtype)
+    except ImportError:  # pragma: no cover
+        pass
+    return jnp.asarray(np.asarray(features), dtype)
+
+
+def sparse_from_scipy(
+    mat, dtype=jnp.float32, pad_to: int | None = None, allow_truncate: bool = False
+) -> SparseFeatures:
+    """Convert a scipy.sparse matrix to the padded ELL layout (vectorized —
+    this sits on the bulk ingestion path). Raises if ``pad_to`` would drop
+    nonzeros, unless ``allow_truncate`` (deliberate feature capping)."""
+    import scipy.sparse as sp
+
+    csr = sp.csr_matrix(mat)
+    n, d = csr.shape
+    nnz_per_row = np.diff(csr.indptr)
+    max_nnz = int(nnz_per_row.max()) if n else 0
+    k = int(pad_to) if pad_to is not None else max_nnz
+    if k < max_nnz and not allow_truncate:
+        raise ValueError(
+            f"pad_to={k} < max row nnz {max_nnz}; pass allow_truncate=True to cap"
+        )
+    k = max(k, 1)
+    indices = np.zeros((n, k), np.int32)
+    values = np.zeros((n, k), np.float64)
+    # position of each nonzero within its row
+    rows = np.repeat(np.arange(n), nnz_per_row)
+    cols = np.arange(csr.nnz) - np.repeat(csr.indptr[:-1], nnz_per_row)
+    keep = cols < k
+    indices[rows[keep], cols[keep]] = csr.indices[keep]
+    values[rows[keep], cols[keep]] = csr.data[keep]
+    return SparseFeatures(jnp.asarray(indices), jnp.asarray(values, dtype), dim=d)
+
+
+def sparse_from_rows(
+    rows, dim, dtype=jnp.float32, pad_to: int | None = None, allow_truncate: bool = False
+) -> SparseFeatures:
+    """Build padded sparse features from per-row (index, value) pair lists."""
+    n = len(rows)
+    max_nnz = max((len(r) for r in rows), default=0)
+    k = int(pad_to) if pad_to is not None else max_nnz
+    if k < max_nnz and not allow_truncate:
+        raise ValueError(
+            f"pad_to={k} < max row nnz {max_nnz}; pass allow_truncate=True to cap"
+        )
+    k = max(k, 1)
+    indices = np.zeros((n, k), np.int32)
+    values = np.zeros((n, k), np.float64)
+    for i, row in enumerate(rows):
+        for j, (idx, val) in enumerate(row[:k]):
+            indices[i, j] = idx
+            values[i, j] = val
+    # XLA gather/scatter silently clamp out-of-range indices, which would
+    # train on the wrong feature — validate on host at construction instead.
+    if n and indices.max() >= dim:
+        raise ValueError(f"feature index {indices.max()} out of range for dim={dim}")
+    if n and indices.min() < 0:
+        raise ValueError(f"negative feature index {indices.min()}")
+    return SparseFeatures(jnp.asarray(indices), jnp.asarray(values, dtype), dim=dim)

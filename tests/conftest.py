@@ -1,0 +1,29 @@
+"""Test scaffolding: 8 virtual CPU devices, f64 enabled for math-parity tests.
+
+The moral equivalent of the reference's ``SparkTestUtils`` local-mode
+SparkSession (SURVEY.md §8): "distributed" code is exercised on
+``--xla_force_host_platform_device_count=8`` CPU devices without real TPUs.
+Must run before jax initializes, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-sets jax_platforms=axon,cpu at interpreter
+# startup (overriding JAX_PLATFORMS); override it back before first backend use.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

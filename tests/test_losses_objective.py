@@ -1,0 +1,154 @@
+"""Math-core tests: losses, sparse layout, objective value/grad/HVP vs numpy,
+normalization identity, summary statistics. Mirrors the reference's pure-math
+unit tier (SURVEY.md §8: losses/optimizers tested Spark-free)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.ops.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    build_normalization_context,
+)
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.ops.statistics import summarize_features
+from photon_ml_tpu.types import LabeledBatch, make_batch, margins, sparse_from_scipy
+
+
+def _rand_batch(rng, n=50, d=8, sparse=False, task="logistic"):
+    X = rng.normal(size=(n, d))
+    if sparse:
+        mask = rng.random((n, d)) < 0.4
+        X = X * mask
+    w_true = rng.normal(size=d)
+    m = X @ w_true
+    if task == "logistic":
+        y = (rng.random(n) < 1 / (1 + np.exp(-m))).astype(float)
+    elif task == "poisson":
+        y = rng.poisson(np.exp(np.clip(m, -5, 3))).astype(float)
+    else:
+        y = m + rng.normal(size=n)
+    feats = sparse_from_scipy(sp.csr_matrix(X), dtype=jnp.float64) if sparse else jnp.asarray(X)
+    return make_batch(feats, y, weights=rng.random(n) + 0.5, offsets=rng.normal(size=n) * 0.1,
+                      dtype=jnp.float64), X, y
+
+
+def test_logistic_loss_values():
+    loss = get_loss("logistic")
+    m = jnp.array([0.0, 100.0, -100.0])
+    y = jnp.array([1.0, 1.0, 0.0])
+    np.testing.assert_allclose(loss.loss(m, y), [np.log(2), 0.0, 0.0], atol=1e-6)
+    # matches -log sigmoid for y=1
+    np.testing.assert_allclose(loss.loss(jnp.array([1.3]), jnp.array([1.0])),
+                               [-np.log(1 / (1 + np.exp(-1.3)))], rtol=1e-6)
+
+
+def test_smoothed_hinge_piecewise():
+    loss = get_loss("smoothed_hinge")
+    y = jnp.ones(4)
+    m = jnp.array([-1.0, 0.5, 2.0, 0.0])
+    np.testing.assert_allclose(loss.loss(m, y), [1.5, 0.125, 0.0, 0.5], atol=1e-12)
+    # d2 continuity check via autodiff
+    g = jax.vmap(jax.grad(lambda mm: loss.loss(mm, 1.0)))(m)
+    np.testing.assert_allclose(g, [-1.0, -0.5, 0.0, -1.0], atol=1e-12)
+
+
+def test_poisson_squared_losses():
+    assert np.isclose(get_loss("poisson").loss(0.5, 2.0), np.exp(0.5) - 1.0)
+    assert np.isclose(get_loss("squared").loss(3.0, 1.0), 2.0)
+    assert get_loss("linear") is get_loss("squared")
+    assert get_loss("LOGISTIC_REGRESSION").name == "logistic"
+
+
+def test_sparse_dense_margin_agreement(rng):
+    X = rng.normal(size=(20, 7)) * (rng.random((20, 7)) < 0.5)
+    w = rng.normal(size=7)
+    sf = sparse_from_scipy(sp.csr_matrix(X), dtype=jnp.float64)
+    np.testing.assert_allclose(margins(sf, jnp.asarray(w)), X @ w, rtol=1e-10)
+    np.testing.assert_allclose(sf.todense(), X, rtol=1e-12)
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+@pytest.mark.parametrize("task", ["logistic", "poisson", "squared"])
+def test_objective_grad_matches_numpy(rng, sparse, task):
+    batch, X, y = _rand_batch(rng, sparse=sparse, task=task)
+    obj = make_objective(task if task != "squared" else "linear")
+    w = jnp.asarray(rng.normal(size=X.shape[1]) * 0.1)
+    l2 = 0.3
+    f, g = obj.value_and_grad(w, batch, l2)
+
+    m = X @ np.asarray(w) + np.asarray(batch.offsets)
+    wt = np.asarray(batch.weights)
+    if task == "logistic":
+        ell = np.logaddexp(0, m) - y * m
+        d1 = 1 / (1 + np.exp(-m)) - y
+    elif task == "poisson":
+        ell = np.exp(m) - y * m
+        d1 = np.exp(m) - y
+    else:
+        ell = 0.5 * (m - y) ** 2
+        d1 = m - y
+    f_np = np.sum(wt * ell) + 0.5 * l2 * np.sum(np.asarray(w) ** 2)
+    g_np = X.T @ (wt * d1) + l2 * np.asarray(w)
+    np.testing.assert_allclose(f, f_np, rtol=1e-8)
+    np.testing.assert_allclose(g, g_np, rtol=1e-7, atol=1e-9)
+
+
+def test_hvp_matches_finite_difference(rng):
+    batch, X, y = _rand_batch(rng)
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=X.shape[1]) * 0.1)
+    v = jnp.asarray(rng.normal(size=X.shape[1]))
+    hv = obj.hvp(w, v, batch, 0.1)
+    eps = 1e-6
+    fd = (obj.grad(w + eps * v, batch, 0.1) - obj.grad(w - eps * v, batch, 0.1)) / (2 * eps)
+    np.testing.assert_allclose(hv, fd, rtol=1e-4, atol=1e-6)
+
+
+def test_diagonal_hessian_matches_full(rng):
+    batch, X, y = _rand_batch(rng, n=30, d=5)
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=5) * 0.3)
+    H = jax.hessian(obj.value)(w, batch, 0.2)
+    diag = obj.diagonal_hessian(w, batch, 0.2)
+    np.testing.assert_allclose(diag, jnp.diagonal(H), rtol=1e-8)
+    var = obj.coefficient_variances(w, batch, 0.2)
+    np.testing.assert_allclose(var, 1.0 / np.diagonal(np.asarray(H)), rtol=1e-8)
+
+
+def test_normalization_margin_equivalence(rng):
+    # margin over transformed coefficients on raw X == margin of w on normalized X'
+    n, d = 40, 6
+    X = rng.normal(size=(n, d)) * 3 + 1.0
+    X[:, d - 1] = 1.0  # intercept column
+    batch = make_batch(jnp.asarray(X), np.zeros(n), dtype=jnp.float64)
+    summary = summarize_features(batch)
+    ctx = build_normalization_context(NormalizationType.STANDARDIZATION, summary,
+                                      intercept_index=d - 1)
+    w = jnp.asarray(rng.normal(size=d))
+    obj = make_objective("logistic", normalization=ctx, intercept_index=d - 1)
+    m = obj.margins(w, batch)
+    Xn = (X - summary.mean) / summary.std
+    Xn[:, d - 1] = 1.0
+    np.testing.assert_allclose(m, Xn @ np.asarray(w), rtol=1e-8, atol=1e-8)
+    # round trip model<->training space
+    w_model = ctx.to_model_space(w)
+    np.testing.assert_allclose(ctx.to_training_space(w_model), w, rtol=1e-10)
+    # model-space coefficients reproduce normalized margins on raw features
+    np.testing.assert_allclose(X @ np.asarray(w_model), Xn @ np.asarray(w), rtol=1e-8)
+
+
+def test_summary_statistics_sparse(rng):
+    X = rng.normal(size=(25, 6)) * (rng.random((25, 6)) < 0.5)
+    sf = sparse_from_scipy(sp.csr_matrix(X), dtype=jnp.float64)
+    batch = make_batch(sf, np.zeros(25), dtype=jnp.float64)
+    s = summarize_features(batch)
+    np.testing.assert_allclose(s.mean, X.mean(0), atol=1e-10)
+    np.testing.assert_allclose(s.variance, X.var(0), atol=1e-10)
+    np.testing.assert_allclose(s.max, X.max(0), atol=1e-12)
+    np.testing.assert_allclose(s.min, X.min(0), atol=1e-12)
+    np.testing.assert_allclose(s.num_nonzeros, (X != 0).sum(0), atol=0)

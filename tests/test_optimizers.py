@@ -1,0 +1,130 @@
+"""Optimizer tests vs scipy/sklearn ground truth on convex problems
+(the reference's optimizer unit tier: known convex problems, SURVEY.md §8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optimize import OptimizerConfig, lbfgs, owlqn, tron
+from photon_ml_tpu.types import make_batch
+
+
+def _logreg_problem(rng, n=200, d=10, l2=1.0):
+    X = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-X @ w_true))).astype(float)
+    batch = make_batch(jnp.asarray(X), y, dtype=jnp.float64)
+    obj = make_objective("logistic")
+    fg = lambda w: obj.value_and_grad(w, batch, l2)
+    # scipy reference solution
+    def f_np(w):
+        m = X @ w
+        return np.sum(np.logaddexp(0, m) - y * m) + 0.5 * l2 * w @ w
+    def g_np(w):
+        m = X @ w
+        return X.T @ (1 / (1 + np.exp(-m)) - y) + l2 * w
+    ref = scipy.optimize.minimize(f_np, np.zeros(d), jac=g_np, method="L-BFGS-B",
+                                  options={"ftol": 1e-14, "gtol": 1e-10})
+    return fg, obj, batch, X, y, ref, l2
+
+
+def test_lbfgs_matches_scipy(rng):
+    fg, obj, batch, X, y, ref, l2 = _logreg_problem(rng)
+    res = lbfgs(fg, jnp.zeros(X.shape[1]), OptimizerConfig(max_iters=200, tolerance=1e-10))
+    assert bool(res.converged)
+    np.testing.assert_allclose(res.value, ref.fun, rtol=1e-8)
+    np.testing.assert_allclose(res.w, ref.x, rtol=1e-4, atol=1e-5)
+    # history recorded, monotone-ish decreasing, NaN-padded after `iterations`
+    it = int(res.iterations)
+    hist = np.asarray(res.loss_history)
+    assert np.all(np.isfinite(hist[:it])) and np.all(np.isnan(hist[it:]))
+    assert hist[it - 1] <= hist[0] + 1e-12
+
+
+def test_lbfgs_jits_and_quadratic_exact(rng):
+    A = rng.normal(size=(12, 8))
+    Q = A.T @ A + 0.5 * np.eye(8)
+    b = rng.normal(size=8)
+    fun = lambda w: (0.5 * w @ jnp.asarray(Q) @ w - jnp.asarray(b) @ w,
+                     jnp.asarray(Q) @ w - jnp.asarray(b))
+    run = jax.jit(lambda w0: lbfgs(fun, w0, OptimizerConfig(max_iters=100, tolerance=1e-12)))
+    res = run(jnp.zeros(8))
+    np.testing.assert_allclose(res.w, np.linalg.solve(Q, b), rtol=1e-6, atol=1e-8)
+
+
+def test_tron_matches_scipy(rng):
+    fg, obj, batch, X, y, ref, l2 = _logreg_problem(rng)
+    res = tron(fg, jnp.zeros(X.shape[1]), OptimizerConfig(max_iters=100, tolerance=1e-10))
+    assert bool(res.converged)
+    np.testing.assert_allclose(res.value, ref.fun, rtol=1e-9)
+    np.testing.assert_allclose(res.w, ref.x, rtol=1e-4, atol=1e-6)
+
+
+def test_tron_poisson(rng):
+    n, d = 150, 6
+    X = rng.normal(size=(n, d)) * 0.5
+    w_true = rng.normal(size=d) * 0.5
+    y = rng.poisson(np.exp(X @ w_true)).astype(float)
+    batch = make_batch(jnp.asarray(X), y, dtype=jnp.float64)
+    obj = make_objective("poisson")
+    fg = lambda w: obj.value_and_grad(w, batch, 0.5)
+    res = tron(fg, jnp.zeros(d), OptimizerConfig(max_iters=100, tolerance=1e-10))
+    def f_np(w):
+        m = X @ w
+        return np.sum(np.exp(m) - y * m) + 0.25 * w @ w
+    ref = scipy.optimize.minimize(f_np, np.zeros(d), method="L-BFGS-B",
+                                  options={"ftol": 1e-14, "gtol": 1e-10})
+    np.testing.assert_allclose(res.value, ref.fun, rtol=1e-8)
+
+
+def test_owlqn_matches_sklearn_l1(rng):
+    from sklearn.linear_model import LogisticRegression
+
+    n, d = 300, 12
+    X = rng.normal(size=(n, d))
+    w_true = np.where(rng.random(d) < 0.5, 0.0, rng.normal(size=d))
+    y = (rng.random(n) < 1 / (1 + np.exp(-X @ w_true))).astype(float)
+    l1 = 3.0
+    batch = make_batch(jnp.asarray(X), y, dtype=jnp.float64)
+    obj = make_objective("logistic")
+    fg = lambda w: obj.value_and_grad(w, batch, 0.0)
+    res = owlqn(fg, jnp.zeros(d), l1, OptimizerConfig(max_iters=300, tolerance=1e-9))
+    # sklearn liblinear: C = 1/l1 (sum-loss convention), no intercept
+    sk = LogisticRegression(penalty="l1", C=1.0 / l1, solver="liblinear",
+                            fit_intercept=False, tol=1e-10, max_iter=5000)
+    sk.fit(X, y)
+    w_sk = sk.coef_.ravel()
+    F = lambda w: float(obj.value(jnp.asarray(w), batch, 0.0)) + l1 * np.abs(w).sum()
+    # objective value parity (coefficients may differ slightly at equal loss)
+    assert F(np.asarray(res.w)) <= F(w_sk) * (1 + 1e-5)
+    # sparsity: recovered support should be sparse like sklearn's
+    assert (np.abs(np.asarray(res.w)) < 1e-8).sum() > 0
+    np.testing.assert_allclose(np.asarray(res.w), w_sk, atol=5e-3)
+
+
+def test_owlqn_zero_l1_equals_lbfgs(rng):
+    fg, obj, batch, X, y, ref, l2 = _logreg_problem(rng)
+    res = owlqn(fg, jnp.zeros(X.shape[1]), 0.0, OptimizerConfig(max_iters=200, tolerance=1e-10))
+    np.testing.assert_allclose(res.value, ref.fun, rtol=1e-7)
+
+
+def test_elastic_net_via_owlqn_plus_l2(rng):
+    # elastic net = L2 folded into smooth objective + L1 via OWL-QN
+    from photon_ml_tpu.ops.regularization import RegularizationContext, RegularizationType
+
+    ctx = RegularizationContext(RegularizationType.ELASTIC_NET, alpha=0.4)
+    lam = 2.0
+    assert np.isclose(ctx.l1_weight(lam), 0.8)
+    assert np.isclose(ctx.l2_weight(lam), 1.2)
+    n, d = 100, 5
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) < 0.5).astype(float)
+    batch = make_batch(jnp.asarray(X), y, dtype=jnp.float64)
+    obj = make_objective("logistic")
+    fg = lambda w: obj.value_and_grad(w, batch, ctx.l2_weight(lam))
+    res = owlqn(fg, jnp.zeros(d), ctx.l1_weight(lam), OptimizerConfig(max_iters=200))
+    assert bool(res.converged)
+    assert np.isfinite(float(res.value))

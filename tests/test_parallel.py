@@ -1,0 +1,100 @@
+"""Distributed fixed-effect tests on 8 virtual CPU devices — the moral
+equivalent of the reference's local-mode-Spark integration tier
+(SURVEY.md §8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.parallel import (
+    distributed_hvp,
+    distributed_value_and_grad,
+    fit_distributed,
+    make_mesh,
+    pad_batch,
+    shard_batch,
+)
+from photon_ml_tpu.types import make_batch, sparse_from_scipy
+import scipy.sparse as sp
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() == 8, "conftest must force 8 cpu devices"
+    return make_mesh({"data": 8})
+
+
+def _problem(rng, n=203, d=12, sparse=False):  # n deliberately not divisible by 8
+    X = rng.normal(size=(n, d))
+    if sparse:
+        X = X * (rng.random((n, d)) < 0.4)
+    w_true = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-X @ w_true))).astype(float)
+    feats = sparse_from_scipy(sp.csr_matrix(X), dtype=jnp.float64) if sparse else jnp.asarray(X)
+    batch = make_batch(feats, y, weights=rng.random(n) + 0.5, dtype=jnp.float64)
+    return batch, X, y
+
+
+def test_pad_batch_noop_semantics(rng):
+    batch, X, y = _problem(rng)
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=X.shape[1]))
+    padded = pad_batch(batch, 8)
+    assert padded.num_examples % 8 == 0
+    f1, g1 = obj.value_and_grad(w, batch, 0.7)
+    f2, g2 = obj.value_and_grad(w, padded, 0.7)
+    np.testing.assert_allclose(f1, f2, rtol=1e-12)
+    np.testing.assert_allclose(g1, g2, rtol=1e-12)
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_distributed_grad_matches_single_device(rng, mesh, sparse):
+    batch, X, y = _problem(rng, sparse=sparse)
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=X.shape[1]) * 0.2)
+    sharded = shard_batch(batch, mesh)
+    fg = distributed_value_and_grad(obj, mesh)
+    f_d, g_d = jax.jit(fg)(w, sharded, 0.5)
+    f_s, g_s = obj.value_and_grad(w, pad_batch(batch, 8), 0.5)
+    np.testing.assert_allclose(f_d, f_s, rtol=1e-10)
+    np.testing.assert_allclose(g_d, g_s, rtol=1e-10)
+
+
+def test_distributed_hvp_matches_single_device(rng, mesh):
+    batch, X, y = _problem(rng)
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=X.shape[1]) * 0.2)
+    v = jnp.asarray(rng.normal(size=X.shape[1]))
+    sharded = shard_batch(batch, mesh)
+    hvp = distributed_hvp(obj, mesh)
+    hv_d = jax.jit(hvp)(w, v, sharded, 0.5)
+    hv_s = obj.hvp(w, v, pad_batch(batch, 8), 0.5)
+    np.testing.assert_allclose(hv_d, hv_s, rtol=1e-9)
+
+
+@pytest.mark.parametrize("optimizer", ["lbfgs", "tron", "owlqn"])
+def test_fit_distributed_matches_single_device_fit(rng, mesh, optimizer):
+    from photon_ml_tpu.optimize import get_optimizer
+
+    batch, X, y = _problem(rng)
+    d = X.shape[1]
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=150, tolerance=1e-10)
+    l2, l1 = 0.5, (0.3 if optimizer == "owlqn" else 0.0)
+    res_d = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=l2, l1=l1,
+                            optimizer=optimizer, config=cfg)
+    fg = lambda w: obj.value_and_grad(w, batch, l2)
+    if optimizer == "owlqn":
+        res_s = get_optimizer(optimizer)(fg, jnp.zeros(d), l1, cfg)
+    else:
+        res_s = get_optimizer(optimizer)(fg, jnp.zeros(d), cfg)
+    np.testing.assert_allclose(res_d.value, res_s.value, rtol=1e-8)
+    np.testing.assert_allclose(res_d.w, res_s.w, rtol=1e-5, atol=1e-7)
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh({"data": 64})
