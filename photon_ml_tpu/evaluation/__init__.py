@@ -1,0 +1,11 @@
+from photon_ml_tpu.evaluation.evaluators import (
+    Evaluator,
+    EvaluationResults,
+    get_evaluator,
+    auc,
+    rmse,
+    logistic_loss_metric,
+    poisson_loss_metric,
+    squared_loss_metric,
+    precision_at_k,
+)

@@ -1,0 +1,159 @@
+"""Evaluation metrics on scored data.
+
+Equivalent of the reference's ``evaluation.{Evaluator, EvaluatorType,
+AreaUnderROCCurveEvaluator, RMSEEvaluator, MultiEvaluator, ...}``
+(SURVEY.md §3.2; reference mount empty). Pointwise metrics (AUC, RMSE,
+logistic/Poisson/squared loss) plus grouped "Multi" variants that compute the
+metric per group (e.g. per-query AUC) and average — the reference's
+MultiEvaluator family. Metrics are computed on host in f64: they sit outside
+the jitted training loop and parity (tie handling in AUC especially —
+SURVEY.md §7 "hard parts") matters more than speed here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Evaluator:
+    name: str
+    fn: Callable  # (scores, labels, weights) -> float
+    higher_is_better: bool
+    grouped: bool = False  # average the metric over groups (Multi- variant)
+
+    def evaluate(self, scores, labels, weights=None, group_ids=None) -> float:
+        scores = np.asarray(scores, np.float64)
+        labels = np.asarray(labels, np.float64)
+        weights = (
+            np.ones_like(labels) if weights is None else np.asarray(weights, np.float64)
+        )
+        if not self.grouped:
+            v = self.fn(scores, labels, weights)
+            return float("nan") if v is None else float(v)
+        if group_ids is None:
+            raise ValueError(f"evaluator '{self.name}' needs group_ids")
+        group_ids = np.asarray(group_ids)
+        vals = []
+        for g in np.unique(group_ids):
+            m = group_ids == g
+            v = self.fn(scores[m], labels[m], weights[m])
+            if v is not None and np.isfinite(v):
+                vals.append(v)
+        return float(np.mean(vals)) if vals else float("nan")
+
+    def better(self, a: float, b: float) -> bool:
+        """True if metric value a is better than b."""
+        return a > b if self.higher_is_better else a < b
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaluationResults:
+    """Per-evaluator metric values; first evaluator is primary for model
+    selection (the reference's EvaluationResults — SURVEY.md §3.2)."""
+
+    metrics: Dict[str, float]
+    primary: str
+
+    @property
+    def primary_value(self) -> float:
+        return self.metrics[self.primary]
+
+
+def auc(scores, labels, weights):
+    """Area under the ROC curve with average-rank tie handling (matches
+    sklearn.roc_auc_score on unweighted data); weighted generalization uses
+    weighted ranks. Returns None for degenerate single-class groups."""
+    pos = labels > 0.5
+    w_pos = weights[pos].sum()
+    w_neg = weights[~pos].sum()
+    if w_pos == 0 or w_neg == 0:
+        return None
+    order = np.argsort(scores, kind="mergesort")
+    s, w, p = scores[order], weights[order], pos[order]
+    # weighted mid-ranks with ties sharing the average rank
+    cw = np.cumsum(w)
+    ranks = cw - w / 2.0  # midpoint rank of each item
+    # collapse ties: average rank within each tied score block
+    block_start = np.concatenate(([True], s[1:] != s[:-1]))
+    block_id = np.cumsum(block_start) - 1
+    block_w = np.zeros(block_id[-1] + 1)
+    block_rw = np.zeros_like(block_w)
+    np.add.at(block_w, block_id, w)
+    np.add.at(block_rw, block_id, ranks * w)
+    ranks = (block_rw / block_w)[block_id]
+    r_pos = np.sum(w[p] * ranks[p])
+    return (r_pos - w_pos * w_pos / 2.0) / (w_pos * w_neg)
+
+
+def rmse(scores, labels, weights):
+    return np.sqrt(np.sum(weights * (scores - labels) ** 2) / weights.sum())
+
+
+def logistic_loss_metric(scores, labels, weights):
+    """Mean weighted logistic loss of raw margins."""
+    return np.sum(weights * (np.logaddexp(0.0, scores) - labels * scores)) / weights.sum()
+
+
+def poisson_loss_metric(scores, labels, weights):
+    return np.sum(weights * (np.exp(scores) - labels * scores)) / weights.sum()
+
+
+def squared_loss_metric(scores, labels, weights):
+    return np.sum(weights * 0.5 * (scores - labels) ** 2) / weights.sum()
+
+
+def smoothed_hinge_loss_metric(scores, labels, weights):
+    z = (2.0 * labels - 1.0) * scores
+    loss = np.where(z <= 0, 0.5 - z, np.where(z < 1, 0.5 * (1 - z) ** 2, 0.0))
+    return np.sum(weights * loss) / weights.sum()
+
+
+def precision_at_k(k: int):
+    def fn(scores, labels, weights):
+        if len(scores) == 0:
+            return None
+        top = np.argsort(-scores, kind="mergesort")[:k]
+        return float(np.mean(labels[top] > 0.5))
+
+    return fn
+
+
+_BASE = {
+    "auc": Evaluator("auc", auc, higher_is_better=True),
+    "rmse": Evaluator("rmse", rmse, higher_is_better=False),
+    "logistic_loss": Evaluator("logistic_loss", logistic_loss_metric, higher_is_better=False),
+    "poisson_loss": Evaluator("poisson_loss", poisson_loss_metric, higher_is_better=False),
+    "squared_loss": Evaluator("squared_loss", squared_loss_metric, higher_is_better=False),
+    "smoothed_hinge_loss": Evaluator(
+        "smoothed_hinge_loss", smoothed_hinge_loss_metric, higher_is_better=False
+    ),
+}
+
+# default evaluator per task (the reference ties it to TaskType)
+TASK_DEFAULT_EVALUATOR = {
+    "logistic": "auc",
+    "squared": "rmse",
+    "linear": "rmse",
+    "poisson": "poisson_loss",
+    "smoothed_hinge": "auc",
+}
+
+
+def get_evaluator(name: str) -> Evaluator:
+    """Resolve an evaluator by name. Grouped variants: "per_group_auc" (the
+    reference's MultiAUCEvaluator), "precision_at_K" / "per_group_precision_at_K"."""
+    key = name.lower()
+    if key in _BASE:
+        return _BASE[key]
+    if key.startswith("per_group_"):
+        inner = get_evaluator(key[len("per_group_") :])
+        return dataclasses.replace(inner, name=key, grouped=True)
+    if key.startswith("precision_at_"):
+        k = int(key[len("precision_at_") :])
+        return Evaluator(key, precision_at_k(k), higher_is_better=True)
+    raise ValueError(f"unknown evaluator '{name}'; known: {sorted(_BASE)}, "
+                     "per_group_<name>, precision_at_<k>")

@@ -1,0 +1,13 @@
+from photon_ml_tpu.game.data import (
+    HostSparse,
+    RandomEffectTrainData,
+    build_random_effect_data,
+    build_score_view,
+    host_sparse_from_dense,
+)
+from photon_ml_tpu.game.random_effect import train_random_effect, score_random_effect
+from photon_ml_tpu.game.descent import (
+    CoordinateConfig,
+    CoordinateDescent,
+    GameDataset,
+)

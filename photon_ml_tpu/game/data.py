@@ -1,0 +1,262 @@
+"""GAME random-effect data layer: entity grouping, active/passive split,
+per-entity feature-subspace projection, and size-bucketing into padded
+arrays.
+
+Equivalent of the reference's ``data.{RandomEffectDataset, LocalDataset,
+RandomEffectDatasetPartitioner}`` + ``projector.LinearSubspaceProjector``
+(SURVEY.md §3.2; reference mount empty). The reference groups samples by
+entity id into an RDD of per-entity local datasets; each entity's features
+are projected onto the subspace it has actually seen. TPU-native rebuild:
+
+* entities are *bucketed by size* and padded to per-bucket shapes
+  ``[E, N, k]`` so the per-entity solves run as one ``vmap`` per bucket with
+  static shapes (SURVEY.md §7 "ragged entity data" hard part);
+* **active** data (up to ``active_cap`` rows per entity, seeded random
+  subset) trains the entity model; **passive** rows only receive scores —
+  via a *score view* built over any dataset with the training-time
+  projections (``build_score_view``);
+* projections are built from active data, so features first seen in passive
+  or validation rows contribute zero score, matching the projector
+  semantics.
+
+This is host-side preprocessing (the reference does it as a Spark shuffle
+stage); it runs in vectorized numpy. The per-entity feature remapping is the
+candidate for a native C++ kernel if it shows up in profiles at scale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.types import SparseFeatures
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSparse:
+    """Host-side padded sparse matrix (numpy twin of SparseFeatures)."""
+
+    indices: np.ndarray  # [n, k] int32
+    values: np.ndarray  # [n, k]
+    dim: int
+
+    @property
+    def num_rows(self) -> int:
+        return self.values.shape[0]
+
+
+def host_sparse_from_dense(X: np.ndarray) -> HostSparse:
+    n, d = X.shape
+    k = max(int((X != 0).sum(axis=1).max()) if n else 0, 1)
+    indices = np.zeros((n, k), np.int32)
+    values = np.zeros((n, k))
+    for i in range(n):
+        nz = np.nonzero(X[i])[0]
+        indices[i, : len(nz)] = nz
+        values[i, : len(nz)] = X[i, nz]
+    return HostSparse(indices, values, d)
+
+
+def host_sparse_from_features(features) -> HostSparse:
+    """Accept SparseFeatures / HostSparse / dense numpy or jax array."""
+    if isinstance(features, HostSparse):
+        return features
+    if isinstance(features, SparseFeatures):
+        return HostSparse(
+            np.asarray(features.indices), np.asarray(features.values), features.dim
+        )
+    return host_sparse_from_dense(np.asarray(features))
+
+
+@dataclasses.dataclass(frozen=True)
+class REBucket:
+    """One size bucket of entities, padded to common shapes.
+
+    Training arrays (active data):
+      indices/values: [E, N, k] local-subspace sparse rows (pad value 0).
+      labels/weights: [E, N] (pad weight 0).
+      sample_idx: int32 [E, N] row index into the source dataset, -1 pad.
+    Projection:
+      projection: int32 [E, D] global feature id per local slot, -1 pad.
+      local_maps: per-entity dict global id -> local slot (host side, reused
+        to build score views for other datasets).
+    """
+
+    entity_ids: Sequence
+    indices: np.ndarray
+    values: np.ndarray
+    labels: np.ndarray
+    weights: np.ndarray
+    sample_idx: np.ndarray
+    projection: np.ndarray
+    local_maps: List[Dict[int, int]]
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.entity_ids)
+
+    @property
+    def local_dim(self) -> int:
+        return self.projection.shape[1]
+
+
+@dataclasses.dataclass(frozen=True)
+class REScoreBucket:
+    """Score view of one bucket over some dataset: every row of every entity
+    (active + passive), features projected to the entity's local subspace."""
+
+    indices: np.ndarray  # [E, M, k] local
+    values: np.ndarray  # [E, M, k]
+    sample_idx: np.ndarray  # [E, M], -1 pad
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectTrainData:
+    effect_name: str
+    buckets: List[REBucket]
+    num_samples: int  # rows in the source dataset
+    # entity id -> (bucket, row) for score-view building
+    entity_to_slot: Dict
+
+    @property
+    def num_entities(self) -> int:
+        return sum(b.num_entities for b in self.buckets)
+
+
+def build_random_effect_data(
+    features,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    entity_ids: Sequence,
+    effect_name: str = "random",
+    num_buckets: int = 4,
+    active_cap: Optional[int] = None,
+    seed: int = 0,
+) -> RandomEffectTrainData:
+    """Group rows by entity, split active/passive, project, bucket, pad."""
+    sp = host_sparse_from_features(features)
+    labels = np.asarray(labels, np.float64)
+    weights = np.asarray(weights, np.float64)
+    n = sp.num_rows
+    ent = np.asarray(entity_ids)
+    uniq, codes = np.unique(ent, return_inverse=True)
+    rng = np.random.default_rng(seed)
+
+    # rows per entity (stable order)
+    order = np.argsort(codes, kind="mergesort")
+    sorted_codes = codes[order]
+    boundaries = np.searchsorted(sorted_codes, np.arange(len(uniq) + 1))
+
+    active_rows: List[np.ndarray] = []
+    for e in range(len(uniq)):
+        rows = order[boundaries[e] : boundaries[e + 1]]
+        if active_cap is not None and len(rows) > active_cap:
+            rows = rng.choice(rows, size=active_cap, replace=False)
+            rows.sort()
+        active_rows.append(rows)
+
+    # per-entity local feature maps from active data
+    local_maps: List[Dict[int, int]] = []
+    for e in range(len(uniq)):
+        rows = active_rows[e]
+        feats = sp.indices[rows][sp.values[rows] != 0]
+        ids = np.unique(feats)
+        local_maps.append({int(g): i for i, g in enumerate(ids)})
+
+    # bucket entities by active-row count
+    counts = np.array([len(r) for r in active_rows])
+    ent_order = np.argsort(counts, kind="mergesort")
+    num_buckets = max(1, min(num_buckets, len(uniq)))
+    splits = np.array_split(ent_order, num_buckets)
+    splits = [s for s in splits if len(s)]
+
+    buckets: List[REBucket] = []
+    entity_to_slot: Dict = {}
+    for b, members in enumerate(splits):
+        E = len(members)
+        N = max(int(counts[members].max()), 1)
+        D = max(max(len(local_maps[e]) for e in members), 1)
+        k = sp.indices.shape[1]
+        indices = np.zeros((E, N, k), np.int32)
+        values = np.zeros((E, N, k))
+        lab = np.zeros((E, N))
+        wts = np.zeros((E, N))
+        sidx = np.full((E, N), -1, np.int32)
+        proj = np.full((E, D), -1, np.int32)
+        eids = []
+        for r, e in enumerate(members):
+            rows = active_rows[e]
+            m = len(rows)
+            lm = local_maps[e]
+            row_idx = sp.indices[rows]
+            row_val = sp.values[rows].copy()
+            loc = np.zeros_like(row_idx)
+            for gid, slot in lm.items():
+                loc[row_idx == gid] = slot
+            # zero-value padding entries keep local slot 0 harmlessly
+            loc[row_val == 0] = 0
+            indices[r, :m] = loc
+            values[r, :m] = row_val
+            lab[r, :m] = labels[rows]
+            wts[r, :m] = weights[rows]
+            sidx[r, :m] = rows
+            for gid, slot in lm.items():
+                proj[r, slot] = gid
+            eids.append(uniq[e])
+            entity_to_slot[uniq[e]] = (b, r)
+        buckets.append(
+            REBucket(eids, indices, values, lab, wts, sidx, proj,
+                     [local_maps[e] for e in members])
+        )
+    return RandomEffectTrainData(effect_name, buckets, n, entity_to_slot)
+
+
+def build_score_view(
+    train_data: RandomEffectTrainData, features, entity_ids: Sequence
+) -> List[REScoreBucket]:
+    """Project any dataset onto the training-time entity subspaces for
+    device-side scoring. Rows of entities unseen in training contribute no
+    score; features outside an entity's subspace are dropped (their
+    coefficient is structurally zero — projector semantics)."""
+    sp = host_sparse_from_features(features)
+    ent = np.asarray(entity_ids)
+    out: List[REScoreBucket] = []
+    # rows grouped by (bucket, entity-row)
+    per_bucket_rows: List[List[List[int]]] = [
+        [[] for _ in range(b.num_entities)] for b in train_data.buckets
+    ]
+    for i, eid in enumerate(ent):
+        slot = train_data.entity_to_slot.get(eid)
+        if slot is None:
+            continue
+        b, r = slot
+        per_bucket_rows[b][r].append(i)
+    for b, bucket in enumerate(train_data.buckets):
+        rows_per_entity = per_bucket_rows[b]
+        E = bucket.num_entities
+        M = max(max((len(r) for r in rows_per_entity), default=0), 1)
+        k = sp.indices.shape[1]
+        indices = np.zeros((E, M, k), np.int32)
+        values = np.zeros((E, M, k))
+        sidx = np.full((E, M), -1, np.int32)
+        for r in range(E):
+            rows = rows_per_entity[r]
+            if not rows:
+                continue
+            lm = bucket.local_maps[r]
+            rfeat = sp.indices[rows]
+            rval = sp.values[rows].copy()
+            loc = np.zeros_like(rfeat)
+            known = np.zeros(rfeat.shape, bool)
+            for gid, slot in lm.items():
+                hit = rfeat == gid
+                loc[hit] = slot
+                known |= hit
+            rval[~known] = 0.0  # outside the entity's subspace
+            indices[r, : len(rows)] = loc
+            values[r, : len(rows)] = rval
+            sidx[r, : len(rows)] = rows
+        out.append(REScoreBucket(indices, values, sidx))
+    return out

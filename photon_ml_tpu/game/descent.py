@@ -1,0 +1,480 @@
+"""GAME coordinate descent: the training algorithm.
+
+Equivalent of the reference's ``algorithm.{CoordinateDescent, Coordinate,
+FixedEffectCoordinate, RandomEffectCoordinate, CoordinateFactory}``
+(SURVEY.md §3.2/§4.1; reference mount empty). Same structure as the
+reference: an outer loop over iterations x coordinates (sequential by
+design — SURVEY.md §3.8 block-coordinate row); per coordinate, the offsets
+fed to training are ``base + total_scores - this coordinate's scores`` (the
+residual trick), the coordinate retrains warm-started from its previous
+model, then its scores are recomputed and validation metrics tracked.
+
+TPU mapping: the outer loop is host-side Python (coarse-grained, a handful
+of steps); each coordinate's training is one jitted device computation built
+ONCE per coordinate (shapes are stable across CD steps, so XLA compiles
+once) — data-parallel ``shard_map`` over the mesh ``data`` axis for the
+fixed effect, ``vmap``-of-solvers (optionally over the ``entity`` axis) for
+random effects.
+
+Coefficient spaces: optimizer-space coefficients (normalization folded into
+the objective) stay internal; scoring and saved models use model-space
+coefficients via ``NormalizationContext.to_model_space`` so scores computed
+on raw features match the normalized-training margins exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.evaluation import get_evaluator
+from photon_ml_tpu.game.data import (
+    HostSparse,
+    RandomEffectTrainData,
+    build_random_effect_data,
+    build_score_view,
+    host_sparse_from_features,
+)
+from photon_ml_tpu.game.random_effect import (
+    score_random_effect,
+    train_random_effect,
+)
+from photon_ml_tpu.game.sampling import down_sample
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    GeneralizedLinearModel,
+    RandomEffectBucket,
+    RandomEffectModel,
+)
+from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.ops.regularization import RegularizationContext, RegularizationType
+from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
+from photon_ml_tpu.parallel.data_parallel import (
+    distributed_hvp,
+    distributed_value_and_grad,
+)
+from photon_ml_tpu.types import LabeledBatch, SparseFeatures, margins as _margins
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateConfig:
+    """Per-coordinate optimization configuration — the reference's
+    ``FixedEffectOptimizationConfiguration`` / ``RandomEffectOptimization-
+    Configuration`` parameter surface (SURVEY.md §3.2/§5.6)."""
+
+    name: str
+    coordinate_type: str = "fixed"  # "fixed" | "random"
+    feature_shard: str = "global"
+    entity_column: Optional[str] = None  # required for random
+    optimizer: str = "lbfgs"
+    max_iters: int = 100
+    tolerance: float = 1e-8
+    reg_type: str | RegularizationType = RegularizationType.NONE
+    reg_weight: float = 0.0
+    elastic_net_alpha: float = 0.5
+    down_sampling_rate: float = 1.0  # fixed-effect only
+    active_cap: Optional[int] = None  # random-effect only
+    num_buckets: int = 4  # random-effect entity size buckets
+    compute_variance: bool = False
+    normalization: Optional[NormalizationContext] = None
+    intercept_index: int = -1
+
+    def reg_context(self) -> RegularizationContext:
+        return RegularizationContext(RegularizationType(self.reg_type),
+                                     self.elastic_net_alpha)
+
+    def opt_config(self) -> OptimizerConfig:
+        return OptimizerConfig(max_iters=self.max_iters, tolerance=self.tolerance)
+
+    def __post_init__(self):
+        if self.coordinate_type not in ("fixed", "random"):
+            raise ValueError(f"coordinate_type must be fixed|random, got "
+                             f"{self.coordinate_type}")
+        if self.coordinate_type == "random" and self.entity_column is None:
+            raise ValueError(f"random coordinate '{self.name}' needs entity_column")
+
+
+@dataclasses.dataclass
+class GameDataset:
+    """Host-resident GAME dataset: shared labels/weights/offsets plus one
+    feature matrix per shard and one id column per entity type
+    (the reference's GameDatum/DataFrame — SURVEY.md §3.2)."""
+
+    features: Dict[str, HostSparse]
+    labels: np.ndarray
+    weights: np.ndarray
+    offsets: np.ndarray
+    entity_ids: Dict[str, np.ndarray]
+    group_ids: Optional[np.ndarray] = None  # for per_group_* evaluators
+
+    def __post_init__(self):
+        self.labels = np.asarray(self.labels, np.float64)
+        n = len(self.labels)
+        self.weights = (
+            np.ones(n) if self.weights is None else np.asarray(self.weights, np.float64)
+        )
+        self.offsets = (
+            np.zeros(n) if self.offsets is None else np.asarray(self.offsets, np.float64)
+        )
+        self.features = {k: host_sparse_from_features(v) for k, v in self.features.items()}
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.labels)
+
+
+def make_game_dataset(features, labels, weights=None, offsets=None,
+                      entity_ids=None, group_ids=None) -> GameDataset:
+    if not isinstance(features, dict):
+        features = {"global": features}
+    return GameDataset(features, labels, weights, offsets, entity_ids or {}, group_ids)
+
+
+def _device_features(sp: HostSparse, dtype) -> SparseFeatures:
+    return SparseFeatures(
+        jnp.asarray(sp.indices), jnp.asarray(sp.values, dtype), dim=sp.dim
+    )
+
+
+class _FixedState:
+    """Per-coordinate fixed-effect state with a jit-compiled fit function
+    built once (the reference's FixedEffectCoordinate role)."""
+
+    def __init__(self, cfg: CoordinateConfig, data: GameDataset, dtype,
+                 task: str, mesh: Optional[Mesh]):
+        sp = data.features[cfg.feature_shard]
+        self.cfg = cfg
+        self.dtype = dtype
+        self.full_features = _device_features(sp, dtype)
+        if cfg.down_sampling_rate < 1.0:
+            rows, w = down_sample(data.labels, data.weights,
+                                  cfg.down_sampling_rate, task=task, seed=0)
+        else:
+            rows, w = np.arange(data.num_samples), data.weights
+        self.train_rows = jnp.asarray(rows)
+        self.w: Optional[jax.Array] = None  # optimizer (training) space
+        self.variances = None
+
+        reg = cfg.reg_context()
+        self.l2 = reg.l2_weight(cfg.reg_weight)
+        self.l1 = reg.l1_weight(cfg.reg_weight)
+        optimizer = cfg.optimizer
+        if self.l1 > 0 and optimizer != "owlqn":
+            optimizer = "owlqn"  # the reference routes L1 to OWLQN
+        self.obj = make_objective(task, normalization=cfg.normalization,
+                                  intercept_index=cfg.intercept_index)
+        opt = get_optimizer(optimizer)
+        cfg_opt = cfg.opt_config()
+        d = sp.dim
+
+        use_mesh = mesh is not None and "data" in mesh.shape
+        n_rows = len(rows)
+        pad = (-n_rows) % mesh.shape["data"] if use_mesh else 0
+        self._offset_pad = pad
+
+        feats = SparseFeatures(
+            jnp.asarray(np.concatenate([sp.indices[rows],
+                                        np.zeros((pad,) + sp.indices.shape[1:], np.int32)])),
+            jnp.asarray(np.concatenate([sp.values[rows],
+                                        np.zeros((pad,) + sp.values.shape[1:])]), dtype),
+            dim=sp.dim,
+        )
+        labels = jnp.asarray(np.concatenate([data.labels[rows], np.ones(pad)]), dtype)
+        weights = jnp.asarray(np.concatenate([w, np.zeros(pad)]), dtype)
+
+        l1_mask = None
+        if cfg.intercept_index >= 0:
+            l1_mask = jnp.ones((d,), dtype).at[cfg.intercept_index].set(0.0)
+
+        if use_mesh:
+            sharding = NamedSharding(mesh, P("data"))
+            feats = jax.tree.map(lambda a: jax.device_put(a, sharding), feats)
+            labels = jax.device_put(labels, sharding)
+            weights = jax.device_put(weights, sharding)
+            self._offset_sharding = sharding
+            fg_dist = distributed_value_and_grad(self.obj, mesh)
+            hvp_dist = distributed_hvp(self.obj, mesh) if optimizer == "tron" else None
+
+            def _fit(w0, offs, l2, l1):
+                batch = LabeledBatch(feats, labels, offs, weights)
+                fg = lambda w: fg_dist(w, batch, l2)
+                if optimizer == "owlqn":
+                    return opt(fg, w0, l1, cfg_opt, l1_mask=l1_mask)
+                if optimizer == "tron":
+                    return opt(fg, w0, cfg_opt,
+                               hvp=lambda w, v: hvp_dist(w, v, batch, l2))
+                return opt(fg, w0, cfg_opt)
+        else:
+            self._offset_sharding = None
+
+            def _fit(w0, offs, l2, l1):
+                batch = LabeledBatch(feats, labels, offs, weights)
+                fg = lambda w: self.obj.value_and_grad(w, batch, l2)
+                if optimizer == "owlqn":
+                    return opt(fg, w0, l1, cfg_opt, l1_mask=l1_mask)
+                return opt(fg, w0, cfg_opt)
+
+        self._batch_parts = (feats, labels, weights)
+        self._fit_jit = jax.jit(_fit)
+
+    def fit(self, offsets_full: jax.Array):
+        offs = jnp.take(offsets_full, self.train_rows, axis=0).astype(self.dtype)
+        if self._offset_pad:
+            offs = jnp.concatenate(
+                [offs, jnp.zeros((self._offset_pad,), self.dtype)]
+            )
+        if self._offset_sharding is not None:
+            offs = jax.device_put(offs, self._offset_sharding)
+        w0 = self.w if self.w is not None else jnp.zeros(
+            (self.full_features.dim,), self.dtype
+        )
+        res = self._fit_jit(w0, offs, jnp.asarray(self.l2, self.dtype),
+                            jnp.asarray(self.l1, self.dtype))
+        self.w = res.w
+        if self.cfg.compute_variance:
+            feats, labels, weights = self._batch_parts
+            batch = LabeledBatch(feats, labels, offs, weights)
+            self.variances = np.asarray(
+                self.obj.coefficient_variances(res.w, batch, self.l2)
+            )
+        return res
+
+    def model_space_w(self) -> jax.Array:
+        """Raw-feature-space coefficients for scoring/saving."""
+        if self.cfg.normalization is not None:
+            return self.cfg.normalization.to_model_space(self.w)
+        return self.w
+
+
+class _RandomState:
+    def __init__(self, cfg: CoordinateConfig, data: GameDataset, dtype):
+        sp = data.features[cfg.feature_shard]
+        ids = data.entity_ids[cfg.entity_column]
+        self.train_data: RandomEffectTrainData = build_random_effect_data(
+            sp, data.labels, data.weights, ids,
+            effect_name=cfg.name, num_buckets=cfg.num_buckets,
+            active_cap=cfg.active_cap,
+        )
+        self.train_view = build_score_view(self.train_data, sp, ids)
+        self.coeffs: Optional[List[np.ndarray]] = None
+        self.variances = None
+
+
+class CoordinateDescent:
+    """Run the GAME block-coordinate loop over a list of coordinates."""
+
+    def __init__(
+        self,
+        configs: Sequence[CoordinateConfig],
+        task: str = "logistic",
+        n_iterations: int = 1,
+        mesh: Optional[Mesh] = None,
+        evaluators: Sequence[str] = (),
+        dtype=jnp.float32,
+        verbose: bool = False,
+    ):
+        names = [c.name for c in configs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate coordinate names: {names}")
+        self.configs = list(configs)
+        self.task = task
+        self.n_iterations = n_iterations
+        self.mesh = mesh
+        self.evaluator_names = list(evaluators)
+        self.dtype = dtype
+        self.verbose = verbose
+
+    # -- main loop -------------------------------------------------------
+    def run(
+        self,
+        train: GameDataset,
+        validation: Optional[GameDataset] = None,
+        warm_start: Optional[GameModel] = None,
+        locked: Sequence[str] = (),
+    ) -> Tuple[GameModel, List[dict]]:
+        dtype = self.dtype
+        n = train.num_samples
+        locked = set(locked)
+        unknown_locked = locked - {c.name for c in self.configs}
+        if unknown_locked:
+            raise ValueError(f"locked coordinates not in configs: {unknown_locked}")
+
+        states: Dict[str, object] = {}
+        for cfg in self.configs:
+            if cfg.coordinate_type == "fixed":
+                states[cfg.name] = _FixedState(cfg, train, dtype, self.task, self.mesh)
+            else:
+                states[cfg.name] = _RandomState(cfg, train, dtype)
+
+        val_states: Dict[str, object] = {}
+        val_feats: Dict[str, SparseFeatures] = {}
+        if validation is not None:
+            for cfg in self.configs:
+                if cfg.coordinate_type == "random":
+                    st: _RandomState = states[cfg.name]
+                    sp = validation.features[cfg.feature_shard]
+                    ids = validation.entity_ids[cfg.entity_column]
+                    val_states[cfg.name] = build_score_view(st.train_data, sp, ids)
+                else:
+                    val_feats[cfg.name] = _device_features(
+                        validation.features[cfg.feature_shard], dtype
+                    )
+
+        # initialize scores (zeros, or from warm-start model)
+        scores = {c.name: jnp.zeros((n,), dtype) for c in self.configs}
+        val_n = validation.num_samples if validation is not None else 0
+        val_scores = {c.name: jnp.zeros((val_n,), dtype) for c in self.configs}
+        if warm_start is not None:
+            self._load_warm_start(warm_start, states, scores, val_scores,
+                                  train, validation, val_states, val_feats)
+
+        base = jnp.asarray(train.offsets, dtype)
+        history: List[dict] = []
+        evaluators = [get_evaluator(e) for e in self.evaluator_names]
+        entity_mesh = (self.mesh if self.mesh is not None
+                       and "entity" in self.mesh.shape else None)
+
+        for it in range(self.n_iterations):
+            for cfg in self.configs:
+                st = states[cfg.name]
+                t0 = time.time()
+                total = base + sum(scores.values())
+                offs = total - scores[cfg.name]
+                record = {"iteration": it, "coordinate": cfg.name}
+                if cfg.name not in locked:
+                    if cfg.coordinate_type == "fixed":
+                        res = st.fit(offs)
+                        record.update(
+                            loss=float(res.value), converged=bool(res.converged),
+                            optimizer_iterations=int(res.iterations),
+                        )
+                        w_model = st.model_space_w()
+                        scores[cfg.name] = _margins(st.full_features, w_model)
+                        if validation is not None:
+                            val_scores[cfg.name] = _margins(
+                                val_feats[cfg.name], w_model
+                            )
+                    else:
+                        reg = cfg.reg_context()
+                        fit = train_random_effect(
+                            st.train_data, offs, task=self.task,
+                            l2=reg.l2_weight(cfg.reg_weight),
+                            optimizer=cfg.optimizer, config=cfg.opt_config(),
+                            w0=st.coeffs, mesh=entity_mesh,
+                            compute_variance=cfg.compute_variance, dtype=dtype,
+                        )
+                        st.coeffs = fit.coefficients
+                        st.variances = fit.variances
+                        record.update(
+                            converged_fraction=fit.converged_fraction,
+                            mean_optimizer_iterations=fit.mean_iterations,
+                        )
+                        scores[cfg.name] = score_random_effect(
+                            st.train_view, st.coeffs, n, dtype
+                        )
+                        if validation is not None:
+                            val_scores[cfg.name] = score_random_effect(
+                                val_states[cfg.name], st.coeffs, val_n, dtype
+                            )
+                record["seconds"] = time.time() - t0
+                if validation is not None and evaluators:
+                    v_total = np.asarray(
+                        jnp.asarray(validation.offsets, dtype) + sum(val_scores.values())
+                    )
+                    for ev in evaluators:
+                        record[ev.name] = ev.evaluate(
+                            v_total, validation.labels, validation.weights,
+                            validation.group_ids,
+                        )
+                if self.verbose:
+                    print(f"[CD] {record}")
+                history.append(record)
+
+        model = self._build_model(states)
+        return model, history
+
+    # -- helpers ---------------------------------------------------------
+    def _build_model(self, states) -> GameModel:
+        coords = {}
+        for cfg in self.configs:
+            st = states[cfg.name]
+            if cfg.coordinate_type == "fixed":
+                coef = Coefficients(
+                    jnp.asarray(st.model_space_w()),
+                    None if st.variances is None else jnp.asarray(st.variances),
+                )
+                coords[cfg.name] = FixedEffectModel(
+                    GeneralizedLinearModel(coef, self.task), cfg.feature_shard
+                )
+            else:
+                buckets = []
+                for b, bucket in enumerate(st.train_data.buckets):
+                    buckets.append(
+                        RandomEffectBucket(
+                            entity_ids=bucket.entity_ids,
+                            coefficients=st.coeffs[b],
+                            projection=bucket.projection,
+                            variances=None if st.variances is None else st.variances[b],
+                        )
+                    )
+                coords[cfg.name] = RandomEffectModel(
+                    cfg.name, buckets, self.task, cfg.feature_shard
+                )
+        return GameModel(coords, self.task)
+
+    def _load_warm_start(self, model, states, scores, val_scores,
+                         train, validation, val_states, val_feats):
+        """Initialize coordinate states and scores from a previous GameModel
+        (the reference's warm-start / partial-retrain path, SURVEY.md §5.4).
+        Saved coefficients are model-space; internal state is optimizer
+        space, so convert through the normalization context."""
+        for cfg in self.configs:
+            prev = model.coordinates.get(cfg.name)
+            if prev is None:
+                continue
+            st = states[cfg.name]
+            if cfg.coordinate_type == "fixed":
+                w_model = jnp.asarray(prev.model.coefficients.means, self.dtype)
+                if cfg.normalization is not None:
+                    st.w = cfg.normalization.to_training_space(w_model)
+                else:
+                    st.w = w_model
+                scores[cfg.name] = _margins(st.full_features, w_model)
+                if validation is not None:
+                    val_scores[cfg.name] = _margins(val_feats[cfg.name], w_model)
+            else:
+                prev_index = prev.entity_index()
+                coeffs = []
+                for bucket in st.train_data.buckets:
+                    W = np.zeros((bucket.num_entities, bucket.local_dim))
+                    for r, eid in enumerate(bucket.entity_ids):
+                        slot = prev_index.get(eid)
+                        if slot is None:
+                            continue
+                        pb, pr = slot
+                        prev_bucket = prev.buckets[pb]
+                        prev_proj = np.asarray(prev_bucket.projection[pr])
+                        prev_coef = np.asarray(prev_bucket.coefficients[pr])
+                        lm = bucket.local_maps[r]
+                        for slot_local, gid in enumerate(prev_proj):
+                            if gid >= 0 and int(gid) in lm:
+                                W[r, lm[int(gid)]] = prev_coef[slot_local]
+                    coeffs.append(W)
+                st.coeffs = coeffs
+                scores[cfg.name] = score_random_effect(
+                    st.train_view, coeffs, train.num_samples, self.dtype
+                )
+                if validation is not None and cfg.name in val_states:
+                    val_scores[cfg.name] = score_random_effect(
+                        val_states[cfg.name], coeffs, validation.num_samples, self.dtype
+                    )

@@ -1,0 +1,169 @@
+"""Per-entity random-effect training and scoring.
+
+Equivalent of the reference's ``RandomEffectCoordinate.trainModel`` /
+``RandomEffectOptimizationProblem`` (SURVEY.md §4.3; reference mount empty):
+the reference runs ``mapValues`` of local Breeze solves over an entity-keyed
+RDD — thousands of small independent optimizations, executor-local. Here
+each size bucket solves ALL its entities at once with ``vmap`` of the jitted
+optimizer (one XLA program per bucket shape), optionally sharded over a mesh
+``entity`` axis with ``shard_map`` — embarrassingly parallel, no collectives,
+exactly like the reference's no-comm local solves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from photon_ml_tpu.game.data import RandomEffectTrainData, REScoreBucket
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
+from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectFitResult:
+    coefficients: List[np.ndarray]  # per bucket [E, D]
+    variances: Optional[List[np.ndarray]]
+    converged_fraction: float
+    mean_iterations: float
+
+
+def _solver_for_bucket(local_dim: int, task: str, optimizer: str,
+                       config: OptimizerConfig, compute_variance: bool):
+    """Build the vmapped per-bucket solve function."""
+    obj = make_objective(task)
+    opt = get_optimizer(optimizer)
+
+    def solve_one(indices, values, labels, weights, offs, w0, l2):
+        batch = LabeledBatch(
+            SparseFeatures(indices, values, dim=local_dim), labels, offs, weights
+        )
+        fg = lambda w: obj.value_and_grad(w, batch, l2)
+        if optimizer == "owlqn":
+            res = opt(fg, w0, 0.0, config)
+        else:
+            res = opt(fg, w0, config)
+        var = (
+            obj.coefficient_variances(res.w, batch, l2)
+            if compute_variance
+            else jnp.zeros((0,), res.w.dtype)
+        )
+        return res.w, var, res.converged, res.iterations
+
+    return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None))
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_solver(local_dim, task, optimizer, config, compute_variance):
+    """Cache the jitted per-bucket solver so repeated coordinate-descent
+    steps with identical shapes reuse one XLA compilation."""
+    return jax.jit(_solver_for_bucket(local_dim, task, optimizer, config,
+                                      compute_variance))
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_sharded_solver(local_dim, task, optimizer, config, compute_variance,
+                           mesh, axis):
+    solver = _solver_for_bucket(local_dim, task, optimizer, config, compute_variance)
+    spec = (P(axis),) * 6 + (P(),)
+    sharded = jax.shard_map(
+        solver, mesh=mesh, in_specs=spec,
+        out_specs=(P(axis), P(axis), P(axis), P(axis)),
+    )
+    return jax.jit(sharded)
+
+
+def train_random_effect(
+    data: RandomEffectTrainData,
+    offsets: jax.Array,
+    task: str = "logistic",
+    l2=0.0,
+    optimizer: str = "lbfgs",
+    config: OptimizerConfig = OptimizerConfig(max_iters=50, history=5),
+    w0: Optional[List[np.ndarray]] = None,
+    mesh: Optional[Mesh] = None,
+    axis: str = "entity",
+    compute_variance: bool = False,
+    dtype=jnp.float32,
+) -> RandomEffectFitResult:
+    """Solve every entity's local GLM. ``offsets`` is the full-dataset
+    residual-offset vector [n] from the coordinate-descent loop."""
+    offsets = jnp.asarray(offsets, dtype)
+    coeffs, variances = [], []
+    conv_sum, iter_sum, total = 0.0, 0.0, 0
+    for b, bucket in enumerate(data.buckets):
+        E, D = bucket.num_entities, bucket.local_dim
+        sidx = jnp.asarray(bucket.sample_idx)
+        # padding rows (sidx == -1) carry weight 0, offset value irrelevant
+        off = jnp.take(offsets, jnp.maximum(sidx, 0), axis=0) * (sidx >= 0)
+        args = (
+            jnp.asarray(bucket.indices),
+            jnp.asarray(bucket.values, dtype),
+            jnp.asarray(bucket.labels, dtype),
+            jnp.asarray(bucket.weights, dtype),
+            off.astype(dtype),
+            jnp.asarray(w0[b], dtype) if w0 is not None else jnp.zeros((E, D), dtype),
+            jnp.asarray(l2, dtype),
+        )
+        if mesh is not None:
+            n_dev = mesh.shape[axis]
+            pad = (-E) % n_dev
+            if pad:
+                args = tuple(
+                    jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
+                    if i < 6
+                    else a
+                    for i, a in enumerate(args)
+                )
+            run = _jitted_sharded_solver(D, task, optimizer, config,
+                                         compute_variance, mesh, axis)
+            W, V, conv, iters = run(*args)
+            W, V, conv, iters = W[:E], V[:E], conv[:E], iters[:E]
+        else:
+            run = _jitted_solver(D, task, optimizer, config, compute_variance)
+            W, V, conv, iters = run(*args)
+        coeffs.append(np.asarray(W))
+        variances.append(np.asarray(V) if compute_variance else None)
+        conv_sum += float(jnp.sum(conv))
+        iter_sum += float(jnp.sum(iters))
+        total += E
+    return RandomEffectFitResult(
+        coefficients=coeffs,
+        variances=variances if compute_variance else None,
+        converged_fraction=conv_sum / max(total, 1),
+        mean_iterations=iter_sum / max(total, 1),
+    )
+
+
+def score_random_effect(
+    score_view: Sequence[REScoreBucket],
+    coefficients: Sequence[np.ndarray],
+    num_samples: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Margins of every sample under its entity's model, scattered into a
+    full-dataset score vector (the reference's CoordinateDataScores role,
+    SURVEY.md §3.2). Samples with no entity model score 0."""
+    scores = jnp.zeros((num_samples + 1,), dtype)  # slot n swallows padding
+    for view, W in zip(score_view, coefficients):
+        Wd = jnp.asarray(W, dtype)
+        idx = jnp.asarray(view.indices)
+        val = jnp.asarray(view.values, dtype)
+        sidx = jnp.asarray(view.sample_idx)
+
+        def margins_one(w_e, idx_e, val_e):
+            return jnp.sum(val_e * w_e[idx_e], axis=-1)  # [M]
+
+        m = jax.vmap(margins_one)(Wd, idx, val)  # [E, M]
+        target = jnp.where(sidx >= 0, sidx, num_samples)
+        scores = scores.at[target.reshape(-1)].add(
+            jnp.where(sidx >= 0, m, 0.0).reshape(-1)
+        )
+    return scores[:num_samples]

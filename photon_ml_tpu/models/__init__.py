@@ -1,0 +1,8 @@
+from photon_ml_tpu.models.glm import (
+    Coefficients,
+    GeneralizedLinearModel,
+    FixedEffectModel,
+    RandomEffectBucket,
+    RandomEffectModel,
+    GameModel,
+)

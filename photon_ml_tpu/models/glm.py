@@ -1,0 +1,148 @@
+"""Model types: coefficients, GLMs, fixed/random-effect and GAME composites.
+
+Equivalents of the reference's ``model.{Coefficients, GeneralizedLinearModel,
+LogisticRegressionModel, ...}`` and the distributed ``model.{FixedEffectModel,
+RandomEffectModel, GameModel}`` (SURVEY.md §3.1/§3.2; reference mount empty).
+TPU-native differences:
+
+* A random-effect model is not an RDD of per-entity model objects but a set
+  of dense coefficient *matrices* — one ``[num_entities, local_dim]`` array
+  per size bucket — plus host-side entity-id indexes and per-entity
+  projections into the global feature space (the ``LinearSubspaceProjector``
+  role). This keeps per-entity scoring a gather + batched dot, not a join.
+* Task type is carried as the loss name; the inverse link for scoring comes
+  from the loss definition (``PointwiseLoss.mean``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.types import Features, LabeledBatch, margins as _margins
+
+
+@struct.dataclass
+class Coefficients:
+    """Means + optional variances (the Bayesian-linear-model payload the
+    reference saves as BayesianLinearModelAvro — SURVEY.md §3.4)."""
+
+    means: jax.Array
+    variances: Optional[jax.Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """A single GLM: score = margin = x.w (+ offset); mean = inv_link(margin)."""
+
+    coefficients: Coefficients
+    task: str = "logistic"
+
+    @property
+    def loss(self):
+        return get_loss(self.task)
+
+    def score(self, features: Features, offsets=0.0) -> jax.Array:
+        return _margins(features, self.coefficients.means) + offsets
+
+    def predict_mean(self, features: Features, offsets=0.0) -> jax.Array:
+        return self.loss.mean(self.score(features, offsets))
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Global coefficients over one feature shard (replicated across the
+    mesh at train/score time — the broadcast replacement)."""
+
+    model: GeneralizedLinearModel
+    feature_shard: str = "global"
+
+    def score(self, features: Features, offsets=0.0) -> jax.Array:
+        return self.model.score(features, offsets)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectBucket:
+    """Per-entity coefficients for one size bucket.
+
+    Attributes:
+      entity_ids: host-side sequence of entity keys, length E.
+      coefficients: [E, D_local] per-entity coefficients in local subspace.
+      variances: optional [E, D_local].
+      projection: int32 [E, D_local] — global feature id of each local slot,
+        -1 for padding slots.
+    """
+
+    entity_ids: Sequence
+    coefficients: np.ndarray | jax.Array
+    projection: np.ndarray | jax.Array
+    variances: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """All per-entity GLMs for one random effect (e.g. per-user).
+
+    The reference holds RDD[(REId, GeneralizedLinearModel)]; here the models
+    live in bucketed dense matrices plus an entity->-(bucket, row) index.
+    """
+
+    effect_name: str
+    buckets: Sequence[RandomEffectBucket]
+    task: str = "logistic"
+    feature_shard: str = "global"
+
+    def entity_index(self) -> Dict:
+        """entity id -> (bucket_idx, row) mapping (host side)."""
+        out = {}
+        for b, bucket in enumerate(self.buckets):
+            for r, eid in enumerate(bucket.entity_ids):
+                out[eid] = (b, r)
+        return out
+
+    @property
+    def num_entities(self) -> int:
+        return sum(len(b.entity_ids) for b in self.buckets)
+
+    def coefficients_for(self, entity_id) -> Optional[np.ndarray]:
+        """Dense global-space coefficient vector for one entity (host-side
+        convenience; bulk scoring uses the bucketed arrays directly)."""
+        for bucket in self.buckets:
+            try:
+                row = list(bucket.entity_ids).index(entity_id)
+            except ValueError:
+                continue
+            proj = np.asarray(bucket.projection[row])
+            coef = np.asarray(bucket.coefficients[row])
+            dim = int(proj.max()) + 1 if (proj >= 0).any() else 0
+            out = np.zeros(max(dim, 0))
+            valid = proj >= 0
+            out[proj[valid]] = coef[valid]
+            return out
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Composite additive model: total score = sum of coordinate scores
+    (SURVEY.md §4.4). Keys are coordinate names in training order."""
+
+    coordinates: Mapping[str, FixedEffectModel | RandomEffectModel]
+    task: str = "logistic"
+
+    def __getitem__(self, name):
+        return self.coordinates[name]
+
+    @property
+    def loss(self):
+        return get_loss(self.task)

@@ -62,3 +62,24 @@ def init_history(max_iters: int, dtype) -> tuple[jax.Array, jax.Array]:
 
 def l2_norm(a):
     return jnp.sqrt(jnp.sum(a * a))
+
+
+def match_vma(x, ref):
+    """Give ``x`` the varying-manual-axes (vma) type of ``ref``.
+
+    Inside ``shard_map`` (manual mode), freshly created constants (zeros,
+    counters, False flags) are "unvarying" while values derived from sharded
+    inputs are "varying over the mesh axis"; ``lax.while_loop`` requires carry
+    input/output types to match exactly, so optimizer loop state initialized
+    from constants must be cast to the gradient's vma. Outside shard_map this
+    is a no-op."""
+    vma = frozenset(getattr(jax.typeof(ref), "vma", frozenset()))
+    cur = frozenset(getattr(jax.typeof(x), "vma", frozenset()))
+    missing = tuple(sorted(vma - cur))
+    if missing:
+        x = jax.lax.pcast(x, missing, to="varying")
+    return x
+
+
+def match_vma_tree(tree, ref):
+    return jax.tree.map(lambda x: match_vma(x, ref), tree)

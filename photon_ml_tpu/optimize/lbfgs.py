@@ -23,6 +23,7 @@ from photon_ml_tpu.optimize.common import (
     converged_check,
     init_history,
     l2_norm,
+    match_vma_tree,
 )
 from photon_ml_tpu.optimize.linesearch import strong_wolfe
 
@@ -56,7 +57,9 @@ def two_loop_direction(g, s_hist, y_hist, rho, k, m):
         q = q - a * y_hist[j]
         return q, alphas.at[j].set(a)
 
-    q, alphas = lax.fori_loop(0, m, newest_to_oldest, (g, jnp.zeros((m,), dtype)))
+    q, alphas = lax.fori_loop(
+        0, m, newest_to_oldest, match_vma_tree((g, jnp.zeros((m,), dtype)), g)
+    )
 
     newest = jnp.mod(k - 1, m)
     sy = jnp.sum(s_hist[newest] * y_hist[newest])
@@ -131,7 +134,7 @@ def lbfgs(
         converged=jnp.asarray(False), stalled=jnp.asarray(False),
         loss_hist=loss_hist, gnorm_hist=gnorm_hist,
     )
-    s = lax.while_loop(cond, body, init)
+    s = lax.while_loop(cond, body, match_vma_tree(init, g0))
     return OptimizationResult(
         w=s.w, value=s.f, grad_norm=l2_norm(s.g), iterations=s.it,
         converged=s.converged, loss_history=s.loss_hist, grad_norm_history=s.gnorm_hist,

@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from photon_ml_tpu.optimize.common import match_vma_tree
+
 _BRACKET, _ZOOM, _DONE = 0, 1, 2
 
 
@@ -146,7 +148,7 @@ def strong_wolfe(
         ok=jnp.asarray(False),
     )
     bad_direction = dphi0 >= 0
-    s = lax.while_loop(cond, body, init)
+    s = lax.while_loop(cond, body, match_vma_tree(init, f0))
 
     # On exhaustion fall back to the best bracket point (a_lo satisfies Armijo
     # by construction once zoom is entered); if nothing worked, take no step.
@@ -195,7 +197,11 @@ def backtracking(
         return (~ok) & (i < max_evals)
 
     _, w_new, f_new, i, ok = lax.while_loop(
-        cond, body, (jnp.asarray(alpha0, f0.dtype), w, f0, jnp.asarray(0), jnp.asarray(False))
+        cond, body,
+        match_vma_tree(
+            (jnp.asarray(alpha0, f0.dtype), w, f0, jnp.asarray(0), jnp.asarray(False)),
+            f0,
+        ),
     )
     w_new = jax.tree.map(lambda a, b: jnp.where(ok, b, a), w, w_new)
     f_new = jnp.where(ok, f_new, f0)

@@ -24,6 +24,7 @@ from photon_ml_tpu.optimize.common import (
     converged_check,
     init_history,
     l2_norm,
+    match_vma_tree,
 )
 from photon_ml_tpu.optimize.lbfgs import two_loop_direction
 from photon_ml_tpu.optimize.linesearch import backtracking
@@ -123,7 +124,7 @@ def owlqn(
         converged=jnp.asarray(False), stalled=jnp.asarray(False),
         loss_hist=loss_hist, gnorm_hist=gnorm_hist,
     )
-    s = lax.while_loop(cond, body, init)
+    s = lax.while_loop(cond, body, match_vma_tree(init, g0))
     final_pg = pseudo_gradient(s.w, s.g, lam)
     return OptimizationResult(
         w=s.w, value=s.F, grad_norm=l2_norm(final_pg), iterations=s.it,

