@@ -1,0 +1,42 @@
+"""Feature indexing driver: build a feature index map from Avro data.
+
+Equivalent of the reference's ``index.FeatureIndexingDriver`` (the dedicated
+Spark job that builds PalDB index maps — SURVEY.md §3.3; reference mount
+empty). Output is a JSON index map loadable by the training/scoring drivers.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Sequence
+
+from photon_ml_tpu.io.avro import iter_avro_records
+from photon_ml_tpu.io.index_map import build_index_map
+from photon_ml_tpu.utils import PhotonLogger
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Feature indexing driver (TPU-native)")
+    p.add_argument("--data", required=True, nargs="+")
+    p.add_argument("--output", required=True, help="index map JSON path")
+    p.add_argument("--min-feature-count", type=int, default=1)
+    p.add_argument("--add-intercept", action="store_true", default=True)
+    p.add_argument("--no-intercept", dest="add_intercept", action="store_false")
+    return p
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    logger = PhotonLogger(None)
+    imap = build_index_map(
+        iter_avro_records(args.data),
+        add_intercept=args.add_intercept,
+        min_count=args.min_feature_count,
+    )
+    imap.save(args.output)
+    logger.log("index_map_built", num_features=imap.size, output=args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,107 @@
+"""GAME scoring driver: batch inference with a saved model.
+
+Equivalent of the reference's ``cli.game.scoring.GameScoringDriver``
+(SURVEY.md §4.4; reference mount empty): load a saved GAME model + Avro
+data, score every row (fixed-effect margins + per-entity random-effect
+margins + offsets), write ``ScoringResultAvro`` records and optionally
+evaluate against labels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.descent import GameDataset
+from photon_ml_tpu.game.scoring import score_game_model
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.data_reader import read_training_examples
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.model_io import load_game_model
+from photon_ml_tpu.io.schemas import SCORING_RESULT_SCHEMA
+from photon_ml_tpu.evaluation import get_evaluator
+from photon_ml_tpu.models import RandomEffectModel
+from photon_ml_tpu.utils import PhotonLogger, Timed
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="GAME scoring driver (TPU-native)")
+    p.add_argument("--data", required=True, nargs="+")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--evaluators", nargs="*", default=())
+    p.add_argument("--per-coordinate-scores", action="store_true",
+                   help="include a per-coordinate score breakdown")
+    p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    return p
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger = PhotonLogger(os.path.join(args.output_dir, "photon.log.jsonl"))
+    logger.log("driver_start", driver="game_scoring", args=vars(args))
+    dtype = jnp.float64 if args.dtype == "float64" else jnp.float32
+
+    with Timed(logger, "load_model"):
+        model = load_game_model(args.model_dir)
+    shards = sorted({c.feature_shard for c in model.coordinates.values()})
+    index_maps = {
+        s: IndexMap.load(os.path.join(args.model_dir, f"index-map.{s}.json"))
+        for s in shards
+    }
+    entity_columns = [
+        c.entity_column for c in model.coordinates.values()
+        if isinstance(c, RandomEffectModel) and c.entity_column
+    ]
+
+    with Timed(logger, "read_data"):
+        feats, labels, offsets, weights, ents, uids = read_training_examples(
+            args.data, index_maps, entity_columns=entity_columns
+        )
+    logger.log("data_read", num_rows=len(labels))
+
+    with Timed(logger, "score"):
+        result = score_game_model(
+            model, feats, ents, offsets=offsets, dtype=dtype,
+            per_coordinate=args.per_coordinate_scores,
+        )
+        if args.per_coordinate_scores:
+            scores, parts = result
+            parts = {k: np.asarray(v) for k, v in parts.items()}
+        else:
+            scores, parts = result, {}
+        scores = np.asarray(scores)
+
+    with Timed(logger, "write_scores"):
+        def records():
+            for i, uid in enumerate(uids):
+                yield {
+                    "uid": uid,
+                    "predictionScore": float(scores[i]),
+                    "label": float(labels[i]),
+                    "scoreComponents": {
+                        k: float(v[i]) for k, v in parts.items()
+                    },
+                }
+
+        write_avro_file(os.path.join(args.output_dir, "scores.avro"),
+                        records(), SCORING_RESULT_SCHEMA)
+
+    metrics = {}
+    for name in args.evaluators:
+        ev = get_evaluator(name)
+        metrics[name] = ev.evaluate(scores, labels, weights)
+    if metrics:
+        logger.log("evaluation", **metrics)
+    logger.log("driver_done", num_scored=len(scores))
+    logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

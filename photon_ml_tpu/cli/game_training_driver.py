@@ -1,0 +1,291 @@
+"""GAME training driver: the end-to-end training entry point.
+
+Equivalent of the reference's ``cli.game.training.GameTrainingDriver``
+(SURVEY.md §4.1; reference mount empty): parse params, build/load feature
+index maps, read Avro training data, optionally normalize, train a GAME
+model per optimization-config grid point with validation tracking, select
+the best by the primary evaluator, save best + all models (Avro), and
+write a structured log. Warm start, locked coordinates (partial retrain),
+and per-iteration checkpoints are supported.
+
+Usage:
+    python -m photon_ml_tpu.cli.game_training_driver \
+        --train-data data/train.avro --validation-data data/val.avro \
+        --output-dir out/ --task logistic_regression \
+        --coordinates configs/coordinates.json --evaluators auc \
+        --n-iterations 3
+
+The coordinate config JSON is a list of dicts matching CoordinateConfig
+fields; ``reg_weight`` may be a list to define a grid (cross-product over
+coordinates is expanded).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+from typing import Dict, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.estimators import GameEstimator, GameFitResult
+from photon_ml_tpu.evaluation import EvaluationResults
+from photon_ml_tpu.evaluation.evaluators import TASK_DEFAULT_EVALUATOR
+from photon_ml_tpu.game.descent import CoordinateConfig, CoordinateDescent, GameDataset
+from photon_ml_tpu.io.avro import iter_avro_records
+from photon_ml_tpu.io.data_reader import read_training_examples
+from photon_ml_tpu.io.index_map import IndexMap, build_index_map, filter_index_map
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.io.schemas import FEATURE_SUMMARIZATION_SCHEMA
+from photon_ml_tpu.ops.losses import TASK_TO_LOSS
+from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization_context
+from photon_ml_tpu.ops.statistics import summarize_features
+from photon_ml_tpu.types import make_batch
+from photon_ml_tpu.utils import PhotonLogger, Timed
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="GAME training driver (TPU-native)")
+    p.add_argument("--train-data", required=True, nargs="+",
+                   help="Avro file(s)/dir(s) of TrainingExampleAvro records")
+    p.add_argument("--validation-data", nargs="+", default=None)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", default="logistic_regression",
+                   choices=sorted(TASK_TO_LOSS) + sorted(set(TASK_TO_LOSS.values())))
+    p.add_argument("--coordinates", required=True,
+                   help="path to coordinate-config JSON, or inline JSON")
+    p.add_argument("--evaluators", nargs="*", default=None)
+    p.add_argument("--n-iterations", type=int, default=1)
+    p.add_argument("--index-map", default=None,
+                   help="prebuilt index map JSON (else built from data)")
+    p.add_argument("--feature-shards", default=None,
+                   help="JSON (inline or path): shard name -> list of feature-"
+                        "name prefixes (per-shard feature bags); shards not "
+                        "listed get all features")
+    p.add_argument("--min-feature-count", type=int, default=1)
+    p.add_argument("--add-intercept", action="store_true", default=True)
+    p.add_argument("--no-intercept", dest="add_intercept", action="store_false")
+    p.add_argument("--normalization", default="none",
+                   choices=[t.value for t in NormalizationType])
+    p.add_argument("--warm-start-model", default=None,
+                   help="model dir to warm start from")
+    p.add_argument("--locked-coordinates", nargs="*", default=(),
+                   help="coordinates kept fixed (partial retrain)")
+    p.add_argument("--checkpoint", action="store_true",
+                   help="save the model after each outer CD iteration")
+    p.add_argument("--save-all-models", action="store_true")
+    p.add_argument("--summarize-features", action="store_true",
+                   help="write FeatureSummarizationResultAvro output")
+    p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    return p
+
+
+def _load_coordinate_grid(spec: str) -> List[List[CoordinateConfig]]:
+    if os.path.exists(spec):
+        with open(spec) as f:
+            raw = json.load(f)
+    else:
+        raw = json.loads(spec)
+    if not isinstance(raw, list) or not raw:
+        raise ValueError("coordinate config must be a non-empty JSON list")
+    # expand list-valued reg_weight into a grid (the reference's grid of
+    # GameOptimizationConfigurations — SURVEY.md §4.1)
+    per_coord_options: List[List[dict]] = []
+    for c in raw:
+        weights = c.get("reg_weight", 0.0)
+        if isinstance(weights, list):
+            per_coord_options.append([{**c, "reg_weight": w} for w in weights])
+        else:
+            per_coord_options.append([c])
+    grid = []
+    for combo in itertools.product(*per_coord_options):
+        grid.append([CoordinateConfig(**c) for c in combo])
+    return grid
+
+
+def _entity_columns(grid) -> List[str]:
+    cols = []
+    for cfg in grid[0]:
+        if cfg.coordinate_type == "random" and cfg.entity_column not in cols:
+            cols.append(cfg.entity_column)
+    return cols
+
+
+def _read_dataset(paths, index_maps, entity_columns) -> GameDataset:
+    feats, labels, offsets, weights, ents, uids = read_training_examples(
+        paths, index_maps, entity_columns=entity_columns
+    )
+    return GameDataset(feats, labels, weights, offsets, ents, None)
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    task = TASK_TO_LOSS.get(args.task, args.task)
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger = PhotonLogger(os.path.join(args.output_dir, "photon.log.jsonl"))
+    logger.log("driver_start", driver="game_training", args=vars(args))
+
+    grid = _load_coordinate_grid(args.coordinates)
+    shards = sorted({cfg.feature_shard for cfg in grid[0]})
+    entity_columns = _entity_columns(grid)
+
+    with Timed(logger, "feature_indexing"):
+        if args.index_map:
+            base_map = IndexMap.load(args.index_map)
+        else:
+            base_map = build_index_map(
+                iter_avro_records(args.train_data),
+                add_intercept=args.add_intercept,
+                min_count=args.min_feature_count,
+            )
+        shard_defs = {}
+        if args.feature_shards:
+            if os.path.exists(args.feature_shards):
+                shard_defs = json.load(open(args.feature_shards))
+            else:
+                shard_defs = json.loads(args.feature_shards)
+        index_maps: Dict[str, IndexMap] = {}
+        for s in shards:
+            if s in shard_defs:
+                index_maps[s] = filter_index_map(
+                    base_map, shard_defs[s], add_intercept=args.add_intercept
+                )
+            else:
+                index_maps[s] = base_map
+
+    with Timed(logger, "read_train_data"):
+        train = _read_dataset(args.train_data, index_maps, entity_columns)
+    validation = None
+    if args.validation_data:
+        with Timed(logger, "read_validation_data"):
+            validation = _read_dataset(args.validation_data, index_maps,
+                                       entity_columns)
+    logger.log("data_read", num_train=train.num_samples,
+               num_validation=0 if validation is None else validation.num_samples,
+               num_features={s: m.size for s, m in index_maps.items()})
+
+    norm_type = NormalizationType(args.normalization)
+    if norm_type != NormalizationType.NONE or args.summarize_features:
+        contexts = {}
+        with Timed(logger, "feature_summarization"):
+            for shard in shards:
+                sp = train.features[shard]
+                batch = make_batch(_to_sparse_features(sp), train.labels)
+                summary = summarize_features(batch)
+                if args.summarize_features:
+                    _write_summary(args.output_dir, summary, index_maps[shard],
+                                   suffix=shard)
+                if norm_type != NormalizationType.NONE:
+                    contexts[shard] = build_normalization_context(
+                        norm_type, summary,
+                        intercept_index=index_maps[shard].intercept_index,
+                    )
+        if norm_type != NormalizationType.NONE:
+            grid = [
+                [_with_normalization(cfg, contexts[cfg.feature_shard],
+                                     index_maps[cfg.feature_shard])
+                 for cfg in configs]
+                for configs in grid
+            ]
+
+    warm = load_game_model(args.warm_start_model) if args.warm_start_model else None
+
+    evaluators = args.evaluators
+    if evaluators is None:
+        evaluators = [TASK_DEFAULT_EVALUATOR[task]] if validation is not None else []
+
+    estimator = GameEstimator(
+        task=task, n_iterations=args.n_iterations, evaluators=evaluators,
+        dtype=jnp.float64 if args.dtype == "float64" else jnp.float32,
+    )
+    with Timed(logger, "training"):
+        results = []
+        for gi, configs in enumerate(grid):
+            ckpt = None
+            if args.checkpoint:
+                def ckpt(it, model, gi=gi):
+                    path = os.path.join(args.output_dir, "checkpoints",
+                                        f"config-{gi}-iter-{it}")
+                    save_game_model(model, path, index_maps)
+                    logger.log("checkpoint", config=gi, iteration=it, path=path)
+            cd = CoordinateDescent(configs, task=task,
+                                   n_iterations=args.n_iterations,
+                                   evaluators=evaluators,
+                                   dtype=estimator.dtype)
+            model, history = cd.run(train, validation, warm_start=warm,
+                                    locked=args.locked_coordinates,
+                                    checkpoint_callback=ckpt)
+            evaluation = None
+            if validation is not None and evaluators:
+                metrics = {e: history[-1][e] for e in evaluators if e in history[-1]}
+                evaluation = EvaluationResults(metrics, evaluators[0])
+            results.append(GameFitResult(model, evaluation, tuple(configs), history))
+            for rec in history:
+                logger.log("cd_iteration", config=gi, **rec)
+
+    best = estimator.select_best(results)
+    with Timed(logger, "save_models"):
+        save_game_model(best.model, os.path.join(args.output_dir, "best"), index_maps)
+        if args.save_all_models:
+            for gi, r in enumerate(results):
+                save_game_model(r.model,
+                                os.path.join(args.output_dir, "all", f"config-{gi}"),
+                                index_maps)
+    logger.log("driver_done",
+               best_config=[dataclasses_asdict(c) for c in best.configs],
+               best_metrics=None if best.evaluation is None else best.evaluation.metrics)
+    logger.close()
+    return 0
+
+
+def _to_sparse_features(sp):
+    from photon_ml_tpu.types import SparseFeatures
+
+    return SparseFeatures(jnp.asarray(sp.indices), jnp.asarray(sp.values),
+                          dim=sp.dim)
+
+
+def _with_normalization(cfg: CoordinateConfig, ctx, imap: IndexMap):
+    import dataclasses as _dc
+
+    return _dc.replace(cfg, normalization=ctx,
+                       intercept_index=imap.intercept_index)
+
+
+def dataclasses_asdict(cfg: CoordinateConfig) -> dict:
+    import dataclasses as _dc
+
+    d = _dc.asdict(cfg)
+    d.pop("normalization", None)  # device arrays aren't JSON
+    return d
+
+
+def _write_summary(output_dir, summary, imap: IndexMap, suffix: str = "global"):
+    from photon_ml_tpu.io.avro import write_avro_file
+    from photon_ml_tpu.io.schemas import split_feature_key
+
+    inverse = imap.inverse()
+
+    def records():
+        for i in range(summary.dim):
+            name, term = split_feature_key(inverse[i])
+            yield {
+                "name": name, "term": term,
+                "mean": float(summary.mean[i]),
+                "variance": float(summary.variance[i]),
+                "min": float(summary.min[i]), "max": float(summary.max[i]),
+                "numNonzeros": float(summary.num_nonzeros[i]),
+                "count": summary.count,
+            }
+
+    name = ("feature-summary.avro" if suffix == "global"
+            else f"feature-summary.{suffix}.avro")
+    write_avro_file(os.path.join(output_dir, name),
+                    records(), FEATURE_SUMMARIZATION_SCHEMA)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,135 @@
+"""GameEstimator / GameTransformer: the spark.ml-style entry points.
+
+Equivalent of the reference's ``estimators.GameEstimator`` and
+``transformers.GameTransformer`` (SURVEY.md §3.2 layer 5; reference mount
+empty): ``fit`` trains one GAME model per optimization configuration in a
+grid (coordinate datasets are built once and reused across configs, as in
+the reference), evaluates each on validation, and returns all
+(model, results, config) triples; ``select_best`` picks by the primary
+evaluator. ``GameTransformer.transform`` scores a dataset with a model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.evaluation import EvaluationResults, get_evaluator
+from photon_ml_tpu.game.descent import (
+    CoordinateConfig,
+    CoordinateDescent,
+    GameDataset,
+)
+from photon_ml_tpu.game.scoring import score_game_model
+from photon_ml_tpu.models import GameModel
+
+
+@dataclasses.dataclass(frozen=True)
+class GameFitResult:
+    model: GameModel
+    evaluation: Optional[EvaluationResults]
+    configs: Tuple[CoordinateConfig, ...]
+    history: List[dict]
+
+
+class GameEstimator:
+    """Train GAME models over a grid of per-coordinate configurations."""
+
+    def __init__(
+        self,
+        task: str = "logistic",
+        n_iterations: int = 1,
+        evaluators: Sequence[str] = (),
+        mesh=None,
+        dtype=jnp.float32,
+        verbose: bool = False,
+    ):
+        self.task = task
+        self.n_iterations = n_iterations
+        self.evaluator_names = list(evaluators)
+        self.mesh = mesh
+        self.dtype = dtype
+        self.verbose = verbose
+
+    def fit(
+        self,
+        train: GameDataset,
+        validation: Optional[GameDataset] = None,
+        config_grid: Sequence[Sequence[CoordinateConfig]] = (),
+        warm_start: Optional[GameModel] = None,
+        locked: Sequence[str] = (),
+    ) -> List[GameFitResult]:
+        if not config_grid:
+            raise ValueError("config_grid must contain at least one configuration")
+        results: List[GameFitResult] = []
+        for configs in config_grid:
+            cd = CoordinateDescent(
+                configs, task=self.task, n_iterations=self.n_iterations,
+                mesh=self.mesh, evaluators=self.evaluator_names,
+                dtype=self.dtype, verbose=self.verbose,
+            )
+            model, history = cd.run(train, validation, warm_start=warm_start,
+                                    locked=locked)
+            evaluation = None
+            if validation is not None and self.evaluator_names:
+                # final metrics from the last history record
+                metrics = {
+                    name: history[-1][name]
+                    for name in self.evaluator_names
+                    if name in history[-1]
+                }
+                evaluation = EvaluationResults(metrics, self.evaluator_names[0])
+            results.append(GameFitResult(model, evaluation, tuple(configs), history))
+        return results
+
+    def select_best(self, results: Sequence[GameFitResult]) -> GameFitResult:
+        """Pick the fit with the best primary validation metric (the model-
+        selection step of GameTrainingDriver — SURVEY.md §4.1)."""
+        if not results:
+            raise ValueError("no fit results to select from")
+        if results[0].evaluation is None:
+            return results[0]
+        ev = get_evaluator(results[0].evaluation.primary)
+        best = results[0]
+        for r in results[1:]:
+            if r.evaluation is not None and ev.better(
+                r.evaluation.primary_value, best.evaluation.primary_value
+            ):
+                best = r
+        return best
+
+
+class GameTransformer:
+    """Score datasets with a trained GAME model."""
+
+    def __init__(self, model: GameModel, dtype=jnp.float32):
+        self.model = model
+        self.dtype = dtype
+
+    def transform(
+        self,
+        dataset: GameDataset,
+        per_coordinate: bool = False,
+    ):
+        """Total scores (margins incl. offsets) for every row."""
+        return score_game_model(
+            self.model, dataset.features, dataset.entity_ids,
+            offsets=dataset.offsets, dtype=self.dtype,
+            per_coordinate=per_coordinate,
+        )
+
+    def predict_mean(self, dataset: GameDataset) -> np.ndarray:
+        """Inverse-link applied to total scores (probabilities / rates)."""
+        return np.asarray(self.model.loss.mean(self.transform(dataset)))
+
+    def evaluate(self, dataset: GameDataset, evaluators: Sequence[str]):
+        scores = np.asarray(self.transform(dataset))
+        out = {}
+        for name in evaluators:
+            ev = get_evaluator(name)
+            out[name] = ev.evaluate(scores, dataset.labels, dataset.weights,
+                                    dataset.group_ids)
+        return out

@@ -102,6 +102,31 @@ class REBucket:
         return self.projection.shape[1]
 
 
+def _local_map_arrays(lm: Dict[int, int]):
+    """Sorted global ids + their local slots, for vectorized remapping."""
+    if not lm:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    gids = np.fromiter(lm.keys(), np.int64, len(lm))
+    slots = np.fromiter(lm.values(), np.int64, len(lm))
+    order = np.argsort(gids)
+    return gids[order], slots[order]
+
+
+def _remap_to_local(row_idx: np.ndarray, row_val: np.ndarray, lm: Dict[int, int]):
+    """Map global feature ids to entity-local slots in one vectorized pass
+    (np.searchsorted); entries outside the local map are zeroed (projector
+    semantics: their coefficient is structurally 0)."""
+    gids, slots = _local_map_arrays(lm)
+    if len(gids) == 0:
+        return np.zeros_like(row_idx), np.zeros_like(row_val)
+    pos = np.searchsorted(gids, row_idx)
+    pos = np.minimum(pos, len(gids) - 1)
+    known = (gids[pos] == row_idx) & (row_val != 0)
+    loc = np.where(known, slots[pos], 0).astype(row_idx.dtype)
+    val = np.where(known, row_val, 0.0)
+    return loc, val
+
+
 @dataclasses.dataclass(frozen=True)
 class REScoreBucket:
     """Score view of one bucket over some dataset: every row of every entity
@@ -190,13 +215,7 @@ def build_random_effect_data(
             rows = active_rows[e]
             m = len(rows)
             lm = local_maps[e]
-            row_idx = sp.indices[rows]
-            row_val = sp.values[rows].copy()
-            loc = np.zeros_like(row_idx)
-            for gid, slot in lm.items():
-                loc[row_idx == gid] = slot
-            # zero-value padding entries keep local slot 0 harmlessly
-            loc[row_val == 0] = 0
+            loc, row_val = _remap_to_local(sp.indices[rows], sp.values[rows], lm)
             indices[r, :m] = loc
             values[r, :m] = row_val
             lab[r, :m] = labels[rows]
@@ -213,29 +232,33 @@ def build_random_effect_data(
     return RandomEffectTrainData(effect_name, buckets, n, entity_to_slot)
 
 
-def build_score_view(
-    train_data: RandomEffectTrainData, features, entity_ids: Sequence
-) -> List[REScoreBucket]:
-    """Project any dataset onto the training-time entity subspaces for
-    device-side scoring. Rows of entities unseen in training contribute no
-    score; features outside an entity's subspace are dropped (their
-    coefficient is structurally zero — projector semantics)."""
-    sp = host_sparse_from_features(features)
-    ent = np.asarray(entity_ids)
-    out: List[REScoreBucket] = []
-    # rows grouped by (bucket, entity-row)
+def group_rows_by_slot(entity_ids, entity_to_slot, num_entities_per_bucket):
+    """Group dataset row indices by (bucket, entity-row). Rows of unknown
+    entities are dropped (they get no random-effect score)."""
     per_bucket_rows: List[List[List[int]]] = [
-        [[] for _ in range(b.num_entities)] for b in train_data.buckets
+        [[] for _ in range(e)] for e in num_entities_per_bucket
     ]
-    for i, eid in enumerate(ent):
-        slot = train_data.entity_to_slot.get(eid)
+    for i, eid in enumerate(np.asarray(entity_ids)):
+        slot = entity_to_slot.get(eid)
+        if slot is None:
+            slot = entity_to_slot.get(str(eid))
         if slot is None:
             continue
         b, r = slot
         per_bucket_rows[b][r].append(i)
-    for b, bucket in enumerate(train_data.buckets):
-        rows_per_entity = per_bucket_rows[b]
-        E = bucket.num_entities
+    return per_bucket_rows
+
+
+def build_score_buckets(
+    sp: HostSparse,
+    per_bucket_rows: List[List[List[int]]],
+    local_maps_per_bucket: List[List[Dict[int, int]]],
+) -> List[REScoreBucket]:
+    """Shared score-view construction: project rows onto each entity's local
+    subspace (single code path for train-data views and model-based views)."""
+    out: List[REScoreBucket] = []
+    for rows_per_entity, local_maps in zip(per_bucket_rows, local_maps_per_bucket):
+        E = len(rows_per_entity)
         M = max(max((len(r) for r in rows_per_entity), default=0), 1)
         k = sp.indices.shape[1]
         indices = np.zeros((E, M, k), np.int32)
@@ -245,18 +268,27 @@ def build_score_view(
             rows = rows_per_entity[r]
             if not rows:
                 continue
-            lm = bucket.local_maps[r]
-            rfeat = sp.indices[rows]
-            rval = sp.values[rows].copy()
-            loc = np.zeros_like(rfeat)
-            known = np.zeros(rfeat.shape, bool)
-            for gid, slot in lm.items():
-                hit = rfeat == gid
-                loc[hit] = slot
-                known |= hit
-            rval[~known] = 0.0  # outside the entity's subspace
+            loc, rval = _remap_to_local(sp.indices[rows], sp.values[rows],
+                                        local_maps[r])
             indices[r, : len(rows)] = loc
             values[r, : len(rows)] = rval
             sidx[r, : len(rows)] = rows
         out.append(REScoreBucket(indices, values, sidx))
     return out
+
+
+def build_score_view(
+    train_data: RandomEffectTrainData, features, entity_ids: Sequence
+) -> List[REScoreBucket]:
+    """Project any dataset onto the training-time entity subspaces for
+    device-side scoring. Rows of entities unseen in training contribute no
+    score; features outside an entity's subspace are dropped (their
+    coefficient is structurally zero — projector semantics)."""
+    sp = host_sparse_from_features(features)
+    per_bucket_rows = group_rows_by_slot(
+        entity_ids, train_data.entity_to_slot,
+        [b.num_entities for b in train_data.buckets],
+    )
+    return build_score_buckets(
+        sp, per_bucket_rows, [b.local_maps for b in train_data.buckets]
+    )

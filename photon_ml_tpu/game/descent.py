@@ -102,6 +102,12 @@ class CoordinateConfig:
                              f"{self.coordinate_type}")
         if self.coordinate_type == "random" and self.entity_column is None:
             raise ValueError(f"random coordinate '{self.name}' needs entity_column")
+        if self.coordinate_type == "random" and self.normalization is not None:
+            raise ValueError(
+                f"random coordinate '{self.name}': normalization inside "
+                "per-entity solves is not supported yet; normalize the fixed "
+                "effect or pre-scale the shard's features"
+            )
 
 
 @dataclasses.dataclass
@@ -301,6 +307,7 @@ class CoordinateDescent:
         validation: Optional[GameDataset] = None,
         warm_start: Optional[GameModel] = None,
         locked: Sequence[str] = (),
+        checkpoint_callback=None,
     ) -> Tuple[GameModel, List[dict]]:
         dtype = self.dtype
         n = train.num_samples
@@ -308,6 +315,14 @@ class CoordinateDescent:
         unknown_locked = locked - {c.name for c in self.configs}
         if unknown_locked:
             raise ValueError(f"locked coordinates not in configs: {unknown_locked}")
+        if locked:
+            covered = set() if warm_start is None else set(warm_start.coordinates)
+            uncovered = locked - covered
+            if uncovered:
+                raise ValueError(
+                    f"locked coordinates {sorted(uncovered)} need a warm_start "
+                    "model providing their coefficients"
+                )
 
         states: Dict[str, object] = {}
         for cfg in self.configs:
@@ -369,6 +384,7 @@ class CoordinateDescent:
                         fit = train_random_effect(
                             st.train_data, offs, task=self.task,
                             l2=reg.l2_weight(cfg.reg_weight),
+                            l1=reg.l1_weight(cfg.reg_weight),
                             optimizer=cfg.optimizer, config=cfg.opt_config(),
                             w0=st.coeffs, mesh=entity_mesh,
                             compute_variance=cfg.compute_variance, dtype=dtype,
@@ -399,6 +415,10 @@ class CoordinateDescent:
                 if self.verbose:
                     print(f"[CD] {record}")
                 history.append(record)
+            if checkpoint_callback is not None:
+                # coarse-grained per-outer-iteration checkpoint (the
+                # reference's per-stage HDFS writes — SURVEY.md §5.4)
+                checkpoint_callback(it, self._build_model(states))
 
         model = self._build_model(states)
         return model, history
@@ -428,7 +448,8 @@ class CoordinateDescent:
                         )
                     )
                 coords[cfg.name] = RandomEffectModel(
-                    cfg.name, buckets, self.task, cfg.feature_shard
+                    cfg.name, buckets, self.task, cfg.feature_shard,
+                    entity_column=cfg.entity_column,
                 )
         return GameModel(coords, self.task)
 

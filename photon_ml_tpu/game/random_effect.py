@@ -41,13 +41,13 @@ def _solver_for_bucket(local_dim: int, task: str, optimizer: str,
     obj = make_objective(task)
     opt = get_optimizer(optimizer)
 
-    def solve_one(indices, values, labels, weights, offs, w0, l2):
+    def solve_one(indices, values, labels, weights, offs, w0, l2, l1):
         batch = LabeledBatch(
             SparseFeatures(indices, values, dim=local_dim), labels, offs, weights
         )
         fg = lambda w: obj.value_and_grad(w, batch, l2)
         if optimizer == "owlqn":
-            res = opt(fg, w0, 0.0, config)
+            res = opt(fg, w0, l1, config)
         else:
             res = opt(fg, w0, config)
         var = (
@@ -57,7 +57,7 @@ def _solver_for_bucket(local_dim: int, task: str, optimizer: str,
         )
         return res.w, var, res.converged, res.iterations
 
-    return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None))
+    return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))
 
 
 @functools.lru_cache(maxsize=256)
@@ -72,7 +72,7 @@ def _jitted_solver(local_dim, task, optimizer, config, compute_variance):
 def _jitted_sharded_solver(local_dim, task, optimizer, config, compute_variance,
                            mesh, axis):
     solver = _solver_for_bucket(local_dim, task, optimizer, config, compute_variance)
-    spec = (P(axis),) * 6 + (P(),)
+    spec = (P(axis),) * 6 + (P(), P())
     sharded = jax.shard_map(
         solver, mesh=mesh, in_specs=spec,
         out_specs=(P(axis), P(axis), P(axis), P(axis)),
@@ -85,6 +85,7 @@ def train_random_effect(
     offsets: jax.Array,
     task: str = "logistic",
     l2=0.0,
+    l1=0.0,
     optimizer: str = "lbfgs",
     config: OptimizerConfig = OptimizerConfig(max_iters=50, history=5),
     w0: Optional[List[np.ndarray]] = None,
@@ -94,7 +95,10 @@ def train_random_effect(
     dtype=jnp.float32,
 ) -> RandomEffectFitResult:
     """Solve every entity's local GLM. ``offsets`` is the full-dataset
-    residual-offset vector [n] from the coordinate-descent loop."""
+    residual-offset vector [n] from the coordinate-descent loop. L1 weight
+    requires (and auto-routes to) the OWL-QN optimizer."""
+    if np.asarray(l1).item() > 0 and optimizer != "owlqn":
+        optimizer = "owlqn"
     offsets = jnp.asarray(offsets, dtype)
     coeffs, variances = [], []
     conv_sum, iter_sum, total = 0.0, 0.0, 0
@@ -111,6 +115,7 @@ def train_random_effect(
             off.astype(dtype),
             jnp.asarray(w0[b], dtype) if w0 is not None else jnp.zeros((E, D), dtype),
             jnp.asarray(l2, dtype),
+            jnp.asarray(l1, dtype),
         )
         if mesh is not None:
             n_dev = mesh.shape[axis]

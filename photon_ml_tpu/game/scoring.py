@@ -1,0 +1,94 @@
+"""Score arbitrary datasets with a trained GameModel.
+
+Equivalent of the reference's ``GameTransformer.transform`` scoring path
+(SURVEY.md §4.4; reference mount empty): fixed effects broadcast their
+coefficient vector and add ``x . w`` per row; random effects join rows to
+their entity's model — here a host-side projection onto each entity's local
+subspace followed by the same bucketed gather/dot/scatter used in training
+(no shuffle; the entity index is a dict lookup at view-build time).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import (
+    build_score_buckets,
+    group_rows_by_slot,
+    host_sparse_from_features,
+)
+from photon_ml_tpu.game.random_effect import score_random_effect
+from photon_ml_tpu.models import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.types import SparseFeatures, margins as _margins
+
+
+def _model_score_view(re_model: RandomEffectModel, sp, entity_ids):
+    """Build score-view buckets directly from a RandomEffectModel's
+    projections (used when scoring without the original train data); shares
+    the projection kernel with the train-data path (data.build_score_buckets)."""
+    per_bucket_rows = group_rows_by_slot(
+        entity_ids, re_model.entity_index(),
+        [len(b.entity_ids) for b in re_model.buckets],
+    )
+    local_maps_per_bucket = []
+    coeffs = []
+    for bucket in re_model.buckets:
+        proj = np.asarray(bucket.projection)
+        local_maps_per_bucket.append(
+            [{int(g): s for s, g in enumerate(proj[r]) if g >= 0}
+             for r in range(len(bucket.entity_ids))]
+        )
+        coeffs.append(np.asarray(bucket.coefficients))
+    views = build_score_buckets(sp, per_bucket_rows, local_maps_per_bucket)
+    return views, coeffs
+
+
+def score_game_model(
+    model: GameModel,
+    features: Dict[str, object],
+    entity_ids: Optional[Dict[str, np.ndarray]] = None,
+    offsets: Optional[np.ndarray] = None,
+    dtype=jnp.float32,
+    per_coordinate: bool = False,
+):
+    """Total score (sum of coordinate scores + offsets) for each row.
+
+    ``features``: dict shard -> features (any representation);
+    ``entity_ids``: dict entity-column -> per-row ids; random-effect
+    coordinates look up ids under their effect name's entity column — by
+    convention the RandomEffectModel's ``effect_name``."""
+    entity_ids = entity_ids or {}
+    host = {k: host_sparse_from_features(v) for k, v in features.items()}
+    n = next(iter(host.values())).num_rows
+    total = jnp.zeros((n,), dtype) if offsets is None else jnp.asarray(offsets, dtype)
+    parts = {}
+    for name, coord in model.coordinates.items():
+        sp = host[coord.feature_shard]
+        if isinstance(coord, FixedEffectModel):
+            feats = SparseFeatures(
+                jnp.asarray(sp.indices), jnp.asarray(sp.values, dtype), dim=sp.dim
+            )
+            s = _margins(feats, jnp.asarray(coord.model.coefficients.means, dtype))
+        else:
+            ids = _entity_ids_for(entity_ids, coord, name)
+            views, coeffs = _model_score_view(coord, sp, ids)
+            s = score_random_effect(views, coeffs, n, dtype)
+        parts[name] = s
+        total = total + s
+    if per_coordinate:
+        return total, parts
+    return total
+
+
+def _entity_ids_for(entity_ids: Dict, coord: RandomEffectModel, name: str):
+    for key in (coord.entity_column, name, coord.effect_name):
+        if key and key in entity_ids:
+            return entity_ids[key]
+    raise ValueError(
+        f"scoring random effect '{name}' needs entity ids under key "
+        f"'{coord.entity_column or name}' (have: {sorted(entity_ids)})"
+    )

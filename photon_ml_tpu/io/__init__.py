@@ -1,0 +1,11 @@
+from photon_ml_tpu.io.avro import read_avro_file, write_avro_file, parse_schema
+from photon_ml_tpu.io.schemas import (
+    TRAINING_EXAMPLE_SCHEMA,
+    BAYESIAN_LINEAR_MODEL_SCHEMA,
+    SCORING_RESULT_SCHEMA,
+    FEATURE_SUMMARIZATION_SCHEMA,
+)
+from photon_ml_tpu.io.index_map import IndexMap, build_index_map
+from photon_ml_tpu.io.data_reader import read_training_examples, write_training_examples
+from photon_ml_tpu.io.model_io import save_game_model, load_game_model
+from photon_ml_tpu.io.libsvm import read_libsvm

@@ -1,0 +1,130 @@
+"""Avro training-data reader/writer honoring the TrainingExampleAvro contract.
+
+Equivalent of the reference's ``data.avro.AvroDataReader`` +
+``NameAndTermFeatureMapUtils`` (SURVEY.md §3.3; reference mount empty):
+reads records with name/term/value feature arrays, maps them through
+per-shard feature index maps into padded sparse matrices, and carries
+response/offset/weight/uid plus entity-id columns (from ``metadataMap``)
+for GAME random effects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.game.data import HostSparse
+from photon_ml_tpu.io.avro import iter_avro_records, write_avro_file
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.schemas import (
+    INTERCEPT_KEY,
+    TRAINING_EXAMPLE_SCHEMA,
+    feature_key,
+)
+
+
+def read_training_examples(
+    paths,
+    index_maps: IndexMap | Dict[str, IndexMap],
+    entity_columns: Sequence[str] = (),
+):
+    """Read Avro training examples into per-shard sparse features.
+
+    Returns (features: dict shard->HostSparse, labels, offsets, weights,
+    entity_ids: dict column->np.ndarray, uids: list). Features absent from a
+    shard's index map are dropped for that shard (per-shard feature
+    selection, as in the reference's feature bags)."""
+    if isinstance(index_maps, IndexMap):
+        index_maps = {"global": index_maps}
+    rows_per_shard: Dict[str, List[List[Tuple[int, float]]]] = {
+        s: [] for s in index_maps
+    }
+    labels: List[float] = []
+    offsets: List[float] = []
+    weights: List[float] = []
+    uids: List = []
+    entity_vals: Dict[str, List] = {c: [] for c in entity_columns}
+
+    for rec in iter_avro_records(paths):
+        labels.append(float(rec["response"]))
+        offsets.append(float(rec["offset"]) if rec.get("offset") is not None else 0.0)
+        weights.append(float(rec["weight"]) if rec.get("weight") is not None else 1.0)
+        uids.append(rec.get("uid"))
+        meta = rec.get("metadataMap") or {}
+        for c in entity_columns:
+            if c not in meta:
+                raise ValueError(f"record uid={rec.get('uid')} missing entity "
+                                 f"column '{c}' in metadataMap")
+            entity_vals[c].append(meta[c])
+        for shard, imap in index_maps.items():
+            row: List[Tuple[int, float]] = []
+            for feat in rec["features"]:
+                idx = imap.index_of(feat["name"], feat.get("term", ""))
+                if idx is not None:
+                    row.append((idx, float(feat["value"])))
+            if imap.intercept_index >= 0:
+                row.append((imap.intercept_index, 1.0))
+            rows_per_shard[shard].append(row)
+
+    features = {
+        shard: _rows_to_host_sparse(rows, index_maps[shard].size)
+        for shard, rows in rows_per_shard.items()
+    }
+    return (
+        features,
+        np.asarray(labels),
+        np.asarray(offsets),
+        np.asarray(weights),
+        {c: np.asarray(v) for c, v in entity_vals.items()},
+        uids,
+    )
+
+
+def _rows_to_host_sparse(rows: List[List[Tuple[int, float]]], dim: int) -> HostSparse:
+    n = len(rows)
+    k = max(max((len(r) for r in rows), default=0), 1)
+    indices = np.zeros((n, k), np.int32)
+    values = np.zeros((n, k))
+    for i, row in enumerate(rows):
+        for j, (idx, val) in enumerate(row):
+            indices[i, j] = idx
+            values[i, j] = val
+    return HostSparse(indices, values, dim)
+
+
+def write_training_examples(
+    path: str,
+    features: Iterable[Iterable[Tuple[str, str, float]]],
+    labels: Sequence[float],
+    offsets: Optional[Sequence[float]] = None,
+    weights: Optional[Sequence[float]] = None,
+    entity_ids: Optional[Dict[str, Sequence]] = None,
+    uids: Optional[Sequence] = None,
+    codec: str = "deflate",
+) -> None:
+    """Write TrainingExampleAvro records; ``features`` yields per-row lists
+    of (name, term, value)."""
+    entity_ids = entity_ids or {}
+
+    def records():
+        for i, (row, label) in enumerate(zip(features, labels)):
+            yield {
+                "uid": str(uids[i]) if uids is not None else str(i),
+                "response": float(label),
+                "offset": float(offsets[i]) if offsets is not None else None,
+                "weight": float(weights[i]) if weights is not None else None,
+                "features": [
+                    {"name": name, "term": term, "value": float(v)}
+                    for name, term, v in row
+                ],
+                "metadataMap": {c: str(vals[i]) for c, vals in entity_ids.items()},
+            }
+
+    write_avro_file(path, records(), TRAINING_EXAMPLE_SCHEMA, codec=codec)
+
+
+def feature_tuples_from_dense(X: np.ndarray, prefix: str = "f"):
+    """Helper for fixtures: dense matrix -> per-row (name, term, value)."""
+    for row in np.asarray(X):
+        yield [(f"{prefix}{j}", "", float(v)) for j, v in enumerate(row) if v != 0]

@@ -1,0 +1,209 @@
+"""GAME model save/load as Avro (the model persistence contract).
+
+Equivalent of the reference's ``data.avro.ModelProcessingUtils``
+(SURVEY.md §3.3/§4.1; reference mount empty): a GAME model is saved as one
+``BayesianLinearModelAvro`` per fixed effect plus one per entity in each
+random effect, with coefficients as name/term/value records resolved through
+the feature index maps; loading reverses the mapping. Layout:
+
+    <dir>/metadata.json                    (task, coordinate order/types)
+    <dir>/fixed-effect/<name>/coefficients.avro
+    <dir>/random-effect/<name>/coefficients.avro
+
+Coefficient name/term resolution uses the shard's index map; saving also
+persists the index maps so a model directory is self-contained.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.schemas import (
+    BAYESIAN_LINEAR_MODEL_SCHEMA,
+    split_feature_key,
+)
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    GeneralizedLinearModel,
+    RandomEffectBucket,
+    RandomEffectModel,
+)
+
+import jax.numpy as jnp
+
+
+def _coef_records(w: np.ndarray, inverse: Dict[int, str]):
+    out = []
+    for idx in np.nonzero(w)[0]:
+        name, term = split_feature_key(inverse[int(idx)])
+        out.append({"name": name, "term": term, "value": float(w[idx])})
+    return out
+
+
+def save_game_model(
+    model: GameModel,
+    directory: str,
+    index_maps: IndexMap | Dict[str, IndexMap],
+) -> None:
+    if isinstance(index_maps, IndexMap):
+        index_maps = {"global": index_maps}
+    os.makedirs(directory, exist_ok=True)
+    meta = {"task": model.task, "coordinates": []}
+    for name, coord in model.coordinates.items():
+        imap = index_maps[coord.feature_shard]
+        inverse = imap.inverse()
+        if isinstance(coord, FixedEffectModel):
+            sub = os.path.join(directory, "fixed-effect", name)
+            os.makedirs(sub, exist_ok=True)
+            w = np.asarray(coord.model.coefficients.means)
+            var = coord.model.coefficients.variances
+            rec = {
+                "modelId": name,
+                "modelClass": "FixedEffectModel",
+                "means": _coef_records(w, inverse),
+                "variances": None if var is None else _coef_records(
+                    np.asarray(var), inverse
+                ),
+                "lossFunction": model.task,
+            }
+            write_avro_file(os.path.join(sub, "coefficients.avro"), [rec],
+                            BAYESIAN_LINEAR_MODEL_SCHEMA)
+            meta["coordinates"].append(
+                {"name": name, "type": "fixed", "feature_shard": coord.feature_shard}
+            )
+        else:
+            sub = os.path.join(directory, "random-effect", name)
+            os.makedirs(sub, exist_ok=True)
+
+            def records():
+                for bucket in coord.buckets:
+                    proj = np.asarray(bucket.projection)
+                    coefs = np.asarray(bucket.coefficients)
+                    variances = (
+                        None if bucket.variances is None else np.asarray(bucket.variances)
+                    )
+                    for r, eid in enumerate(bucket.entity_ids):
+                        valid = proj[r] >= 0
+                        w = np.zeros(imap.size)
+                        w[proj[r][valid]] = coefs[r][valid]
+                        rec = {
+                            "modelId": str(eid),
+                            "modelClass": "RandomEffectModel",
+                            "means": _coef_records(w, inverse),
+                            "variances": None,
+                            "lossFunction": model.task,
+                        }
+                        if variances is not None:
+                            v = np.zeros(imap.size)
+                            v[proj[r][valid]] = variances[r][valid]
+                            rec["variances"] = _coef_records(v, inverse)
+                        yield rec
+
+            write_avro_file(os.path.join(sub, "coefficients.avro"), records(),
+                            BAYESIAN_LINEAR_MODEL_SCHEMA)
+            meta["coordinates"].append(
+                {"name": name, "type": "random", "feature_shard": coord.feature_shard,
+                 "entity_column": coord.entity_column}
+            )
+        # persist the shard's index map alongside the model
+        imap.save(os.path.join(directory, f"index-map.{coord.feature_shard}.json"))
+    with open(os.path.join(directory, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_game_model(directory: str) -> GameModel:
+    with open(os.path.join(directory, "metadata.json")) as f:
+        meta = json.load(f)
+    index_maps: Dict[str, IndexMap] = {}
+    coords = {}
+    for c in meta["coordinates"]:
+        shard = c["feature_shard"]
+        if shard not in index_maps:
+            index_maps[shard] = IndexMap.load(
+                os.path.join(directory, f"index-map.{shard}.json")
+            )
+        imap = index_maps[shard]
+        if c["type"] == "fixed":
+            path = os.path.join(directory, "fixed-effect", c["name"], "coefficients.avro")
+            records, _ = read_avro_file(path)
+            rec = records[0]
+            w = np.zeros(imap.size)
+            for coef in rec["means"]:
+                idx = imap.index_of(coef["name"], coef.get("term", ""))
+                if idx is not None:
+                    w[idx] = coef["value"]
+            var = None
+            if rec.get("variances"):
+                var = np.zeros(imap.size)
+                for coef in rec["variances"]:
+                    idx = imap.index_of(coef["name"], coef.get("term", ""))
+                    if idx is not None:
+                        var[idx] = coef["value"]
+            coords[c["name"]] = FixedEffectModel(
+                GeneralizedLinearModel(
+                    Coefficients(jnp.asarray(w),
+                                 None if var is None else jnp.asarray(var)),
+                    meta["task"],
+                ),
+                shard,
+            )
+        else:
+            path = os.path.join(directory, "random-effect", c["name"], "coefficients.avro")
+            records, _ = read_avro_file(path)
+            coords[c["name"]] = _rebuild_random_effect(
+                c["name"], records, imap, meta["task"], shard,
+                c.get("entity_column", ""),
+            )
+    return GameModel(coords, meta["task"])
+
+
+def _rebuild_random_effect(name, records, imap: IndexMap, task, shard,
+                           entity_column="") -> RandomEffectModel:
+    """Rebuild bucketed per-entity coefficients from per-entity records,
+    grouping entities with equal support size into buckets."""
+    entities: List[tuple] = []
+    for rec in records:
+        ids, vals, variances = [], [], {}
+        for coef in rec["means"]:
+            idx = imap.index_of(coef["name"], coef.get("term", ""))
+            if idx is not None:
+                ids.append(idx)
+                vals.append(coef["value"])
+        if rec.get("variances"):
+            for coef in rec["variances"]:
+                idx = imap.index_of(coef["name"], coef.get("term", ""))
+                if idx is not None:
+                    variances[idx] = coef["value"]
+        order = np.argsort(ids)
+        entities.append(
+            (rec["modelId"], np.asarray(ids)[order], np.asarray(vals)[order], variances)
+        )
+    # bucket by support size
+    by_size: Dict[int, List[tuple]] = {}
+    for ent in entities:
+        by_size.setdefault(len(ent[1]), []).append(ent)
+    buckets = []
+    for size, members in sorted(by_size.items()):
+        E, D = len(members), max(size, 1)
+        proj = np.full((E, D), -1, np.int32)
+        coefs = np.zeros((E, D))
+        has_var = any(m[3] for m in members)
+        variances = np.zeros((E, D)) if has_var else None
+        eids = []
+        for r, (eid, ids, vals, var) in enumerate(members):
+            proj[r, : len(ids)] = ids
+            coefs[r, : len(ids)] = vals
+            if has_var:
+                for slot, gid in enumerate(ids):
+                    variances[r, slot] = var.get(int(gid), 0.0)
+            eids.append(eid)
+        buckets.append(RandomEffectBucket(eids, coefs, proj, variances))
+    return RandomEffectModel(name, buckets, task, shard, entity_column=entity_column)

@@ -101,6 +101,8 @@ class RandomEffectModel:
     buckets: Sequence[RandomEffectBucket]
     task: str = "logistic"
     feature_shard: str = "global"
+    # which dataset entity-id column keys this effect (e.g. "userId")
+    entity_column: str = ""
 
     def entity_index(self) -> Dict:
         """entity id -> (bucket_idx, row) mapping (host side)."""

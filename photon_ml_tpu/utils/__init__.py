@@ -1,0 +1,1 @@
+from photon_ml_tpu.utils.logging import PhotonLogger, Timed

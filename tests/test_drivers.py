@@ -1,0 +1,176 @@
+"""Driver-level integration tests on small Avro fixtures — the reference's
+``src/integTest`` tier with local-mode Spark replaced by local CPU devices
+(SURVEY.md §8)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.feature_indexing_driver import main as index_main
+from photon_ml_tpu.cli.game_scoring_driver import main as score_main
+from photon_ml_tpu.cli.game_training_driver import main as train_main
+from photon_ml_tpu.io.avro import read_avro_file
+from photon_ml_tpu.io.data_reader import feature_tuples_from_dense, write_training_examples
+
+
+@pytest.fixture
+def game_fixture(tmp_path, rng):
+    """Synthetic mixed-effect Avro fixtures (train + validation)."""
+    n_users, d_g, d_u = 20, 6, 3
+    w_fixed = rng.normal(size=d_g)
+    U = rng.normal(size=(n_users, d_u)) * 1.5
+    Xg, Xu, y, uid = [], [], [], []
+    for u in range(n_users):
+        m = int(rng.integers(15, 45))
+        xg, xu = rng.normal(size=(m, d_g)), rng.normal(size=(m, d_u))
+        marg = xg @ w_fixed + xu @ U[u]
+        y.append((rng.random(m) < 1 / (1 + np.exp(-marg))).astype(float))
+        Xg.append(xg); Xu.append(xu); uid.append(np.full(m, u))
+    Xg, Xu, y, uid = map(np.concatenate, (Xg, Xu, y, uid))
+    n = len(y)
+    perm = rng.permutation(n)
+    tr, va = perm[: int(n * 0.8)], perm[int(n * 0.8):]
+
+    def write(path, rows):
+        # one features list: global features f*, user features u*
+        def tuples():
+            for i in rows:
+                row = [(f"g{j}", "", float(Xg[i, j])) for j in range(d_g)]
+                row += [(f"u{j}", "", float(Xu[i, j])) for j in range(d_u)]
+                yield row
+        write_training_examples(
+            str(path), tuples(), y[rows],
+            entity_ids={"userId": uid[rows]},
+            uids=[str(i) for i in rows],
+        )
+
+    write(tmp_path / "train.avro", tr)
+    write(tmp_path / "val.avro", va)
+    coords = [
+        {"name": "fixed", "coordinate_type": "fixed", "feature_shard": "global",
+         "reg_type": "l2", "reg_weight": [0.1, 1.0], "max_iters": 100},
+        {"name": "per-user", "coordinate_type": "random", "feature_shard": "user",
+         "entity_column": "userId", "reg_type": "l2", "reg_weight": 1.0,
+         "max_iters": 50},
+    ]
+    cpath = tmp_path / "coords.json"
+    cpath.write_text(json.dumps(coords))
+    shards = tmp_path / "shards.json"
+    shards.write_text(json.dumps({"global": ["g"], "user": ["u"]}))
+    return tmp_path
+
+
+def test_training_and_scoring_drivers_end_to_end(game_fixture):
+    out = game_fixture / "out"
+    rc = train_main([
+        "--train-data", str(game_fixture / "train.avro"),
+        "--validation-data", str(game_fixture / "val.avro"),
+        "--output-dir", str(out),
+        "--task", "logistic_regression",
+        "--coordinates", str(game_fixture / "coords.json"),
+        "--feature-shards", str(game_fixture / "shards.json"),
+        "--n-iterations", "2",
+        "--save-all-models", "--checkpoint",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    assert (out / "best" / "metadata.json").exists()
+    assert (out / "all" / "config-0" / "metadata.json").exists()
+    assert (out / "all" / "config-1" / "metadata.json").exists()  # grid of 2
+    assert (out / "checkpoints" / "config-0-iter-0" / "metadata.json").exists()
+    log = [json.loads(l) for l in (out / "photon.log.jsonl").read_text().splitlines()]
+    events = {r["event"] for r in log}
+    assert {"driver_start", "data_read", "cd_iteration", "driver_done"} <= events
+    final_auc = [r for r in log if r["event"] == "cd_iteration"][-1]["auc"]
+    assert final_auc > 0.72, final_auc
+
+    # scoring driver on validation data with the best model
+    sout = game_fixture / "scores"
+    rc = score_main([
+        "--data", str(game_fixture / "val.avro"),
+        "--model-dir", str(out / "best"),
+        "--output-dir", str(sout),
+        "--evaluators", "auc",
+        "--per-coordinate-scores",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    records, _ = read_avro_file(str(sout / "scores.avro"))
+    assert len(records) > 0
+    r0 = records[0]
+    assert set(r0["scoreComponents"]) == {"fixed", "per-user"}
+    assert np.isclose(
+        r0["predictionScore"],
+        r0["scoreComponents"]["fixed"] + r0["scoreComponents"]["per-user"],
+        atol=1e-6,
+    )
+    slog = [json.loads(l) for l in (sout / "photon.log.jsonl").read_text().splitlines()]
+    ev = [r for r in slog if r["event"] == "evaluation"][0]
+    assert ev["auc"] > 0.72
+
+
+def test_warm_start_and_locked_via_driver(game_fixture):
+    out1 = game_fixture / "out1"
+    argv = [
+        "--train-data", str(game_fixture / "train.avro"),
+        "--output-dir", str(out1),
+        "--coordinates", json.dumps([
+            {"name": "fixed", "coordinate_type": "fixed",
+             "reg_type": "l2", "reg_weight": 1.0},
+        ]),
+        "--dtype", "float64",
+    ]
+    assert train_main(argv) == 0
+    out2 = game_fixture / "out2"
+    rc = train_main([
+        "--train-data", str(game_fixture / "train.avro"),
+        "--output-dir", str(out2),
+        "--coordinates", json.dumps([
+            {"name": "fixed", "coordinate_type": "fixed",
+             "reg_type": "l2", "reg_weight": 1.0},
+        ]),
+        "--warm-start-model", str(out1 / "best"),
+        "--locked-coordinates", "fixed",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    a, _ = read_avro_file(str(out1 / "best" / "fixed-effect" / "fixed" / "coefficients.avro"))
+    b, _ = read_avro_file(str(out2 / "best" / "fixed-effect" / "fixed" / "coefficients.avro"))
+    wa = {(c["name"], c["term"]): c["value"] for c in a[0]["means"]}
+    wb = {(c["name"], c["term"]): c["value"] for c in b[0]["means"]}
+    assert wa.keys() == wb.keys()
+    for k in wa:
+        assert np.isclose(wa[k], wb[k], rtol=1e-10)
+
+
+def test_feature_indexing_driver(game_fixture):
+    out = str(game_fixture / "imap.json")
+    rc = index_main(["--data", str(game_fixture / "train.avro"), "--output", out])
+    assert rc == 0
+    payload = json.loads(open(out).read())
+    assert "(INTERCEPT)" in payload["features"]
+    assert len(payload["features"]) == 6 + 3 + 1
+
+
+def test_normalization_through_driver(game_fixture):
+    out = game_fixture / "out_norm"
+    rc = train_main([
+        "--train-data", str(game_fixture / "train.avro"),
+        "--validation-data", str(game_fixture / "val.avro"),
+        "--output-dir", str(out),
+        "--coordinates", json.dumps([
+            {"name": "fixed", "coordinate_type": "fixed",
+             "reg_type": "l2", "reg_weight": 1.0},
+        ]),
+        "--normalization", "standardization",
+        "--summarize-features",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    assert (out / "feature-summary.avro").exists()
+    records, _ = read_avro_file(str(out / "feature-summary.avro"))
+    by_name = {r["name"]: r for r in records}
+    assert by_name["(INTERCEPT)"]["mean"] == 1.0
+    assert by_name["(INTERCEPT)"]["variance"] == 0.0

@@ -1,0 +1,84 @@
+"""GameEstimator / GameTransformer tests (SURVEY.md §3.2 layer 5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.estimators import GameEstimator, GameTransformer
+from photon_ml_tpu.game.descent import CoordinateConfig, make_game_dataset
+
+
+def _binary_data(rng, n=400, d=8):
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-X @ w))).astype(float)
+    return X, y
+
+
+def test_estimator_grid_and_selection(rng):
+    X, y = _binary_data(rng)
+    tr, va = np.arange(300), np.arange(300, 400)
+    ds_tr = make_game_dataset(X[tr], y[tr])
+    ds_va = make_game_dataset(X[va], y[va])
+    est = GameEstimator(task="logistic", evaluators=["auc", "logistic_loss"],
+                        dtype=jnp.float64)
+    grid = [
+        [CoordinateConfig("fixed", reg_type="l2", reg_weight=w)]
+        for w in (0.01, 1.0, 1000.0)
+    ]
+    results = est.fit(ds_tr, ds_va, config_grid=grid)
+    assert len(results) == 3
+    for r in results:
+        assert set(r.evaluation.metrics) == {"auc", "logistic_loss"}
+    best = est.select_best(results)
+    assert best.evaluation.primary_value == max(
+        r.evaluation.metrics["auc"] for r in results
+    )
+    # with logistic_loss primary (lower is better), selection flips direction:
+    # the over-regularized w->0 model has the worst calibrated loss
+    est_ll = GameEstimator(task="logistic", evaluators=["logistic_loss"],
+                           dtype=jnp.float64)
+    results_ll = est_ll.fit(ds_tr, ds_va, config_grid=grid)
+    best_ll = est_ll.select_best(results_ll)
+    assert best_ll.configs[0].reg_weight != 1000.0
+    assert best_ll.evaluation.primary_value == min(
+        r.evaluation.metrics["logistic_loss"] for r in results_ll
+    )
+
+
+def test_estimator_empty_grid_rejected(rng):
+    X, y = _binary_data(rng, n=50)
+    est = GameEstimator()
+    with pytest.raises(ValueError, match="config_grid"):
+        est.fit(make_game_dataset(X, y))
+
+
+def test_transformer_scores_match_cd_validation_scores(rng):
+    # transformer scoring a dataset == CD's own validation scoring
+    from photon_ml_tpu.game.descent import CoordinateDescent
+
+    n_users = 10
+    Xg = rng.normal(size=(300, 6))
+    Xu = rng.normal(size=(300, 3))
+    uid = rng.integers(0, n_users, 300)
+    y = (rng.random(300) < 0.5).astype(float)
+    feats = {"g": Xg, "u": Xu}
+    ds = make_game_dataset(feats, y, entity_ids={"userId": uid})
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", feature_shard="g", reg_type="l2", reg_weight=1.0),
+         CoordinateConfig("per-user", coordinate_type="random", feature_shard="u",
+                          entity_column="userId", reg_type="l2", reg_weight=2.0)],
+        task="logistic", evaluators=["auc"], dtype=jnp.float64,
+    )
+    model, hist = cd.run(ds, ds)  # validation == train for comparison
+    tf = GameTransformer(model, dtype=jnp.float64)
+    metrics = tf.evaluate(ds, ["auc"])
+    assert np.isclose(metrics["auc"], hist[-1]["auc"], atol=1e-9)
+    # probabilities are sigmoid of margins
+    probs = tf.predict_mean(ds)
+    assert np.all((probs >= 0) & (probs <= 1))
+    # per-coordinate breakdown sums to the total
+    total, parts = tf.transform(ds, per_coordinate=True)
+    np.testing.assert_allclose(
+        np.asarray(total), sum(np.asarray(p) for p in parts.values()), rtol=1e-10
+    )

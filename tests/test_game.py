@@ -228,3 +228,40 @@ def test_train_random_effect_entity_sharded_matches(rng):
     for a, b in zip(fit_plain.coefficients, fit_mesh.coefficients):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
     assert fit_mesh.converged_fraction == 1.0
+
+
+def test_random_effect_l1_regularization(rng):
+    # review finding: RE coordinates must honor L1 (auto-routed to OWL-QN)
+    n, d = 150, 8
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) < 0.5).astype(float)
+    ids = np.zeros(n, int)
+    data = build_random_effect_data(X, y, np.ones(n), ids)
+    cfg = OptimizerConfig(max_iters=150, tolerance=1e-10)
+    fit_l1 = train_random_effect(data, np.zeros(n), l1=5.0, dtype=jnp.float64,
+                                 config=cfg)
+    fit_none = train_random_effect(data, np.zeros(n), dtype=jnp.float64, config=cfg)
+    nz_l1 = (np.abs(fit_l1.coefficients[0]) > 1e-8).sum()
+    nz_none = (np.abs(fit_none.coefficients[0]) > 1e-8).sum()
+    assert nz_l1 < nz_none  # L1 produces sparsity
+
+
+def test_locked_without_warm_start_rejected(rng):
+    from photon_ml_tpu.game.descent import make_game_dataset
+
+    X = rng.normal(size=(50, 4))
+    y = (rng.random(50) < 0.5).astype(float)
+    ds = make_game_dataset(X, y)
+    cd = CoordinateDescent([CoordinateConfig("fixed")])
+    with pytest.raises(ValueError, match="warm_start"):
+        cd.run(ds, locked=["fixed"])
+
+
+def test_random_coordinate_normalization_rejected():
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+    import jax.numpy as jnp2
+
+    ctx = NormalizationContext(jnp2.ones(3), None)
+    with pytest.raises(ValueError, match="not supported"):
+        CoordinateConfig("re", coordinate_type="random", entity_column="u",
+                         normalization=ctx)

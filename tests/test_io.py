@@ -1,0 +1,204 @@
+"""I/O tests: Avro codec round-trips, index maps, data reader, model save/
+load, LIBSVM (the reference's Avro-in/Avro-out contract — SURVEY.md §3.4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.avro import parse_schema, read_avro_file, write_avro_file
+from photon_ml_tpu.io.data_reader import (
+    feature_tuples_from_dense,
+    read_training_examples,
+    write_training_examples,
+)
+from photon_ml_tpu.io.index_map import IndexMap, build_index_map
+from photon_ml_tpu.io.libsvm import read_libsvm
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.io.schemas import (
+    BAYESIAN_LINEAR_MODEL_SCHEMA,
+    TRAINING_EXAMPLE_SCHEMA,
+    feature_key,
+    split_feature_key,
+)
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_roundtrip_all_types(tmp_path, codec):
+    schema = {
+        "type": "record",
+        "name": "Everything",
+        "fields": [
+            {"name": "b", "type": "boolean"},
+            {"name": "i", "type": "int"},
+            {"name": "l", "type": "long"},
+            {"name": "f", "type": "float"},
+            {"name": "d", "type": "double"},
+            {"name": "s", "type": "string"},
+            {"name": "by", "type": "bytes"},
+            {"name": "arr", "type": {"type": "array", "items": "long"}},
+            {"name": "m", "type": {"type": "map", "values": "double"}},
+            {"name": "u", "type": ["null", "string"]},
+            {"name": "e", "type": {"type": "enum", "name": "E",
+                                   "symbols": ["A", "B"]}},
+            {"name": "fx", "type": {"type": "fixed", "name": "F", "size": 4}},
+        ],
+    }
+    recs = [
+        {"b": True, "i": -42, "l": 2**45, "f": 1.5, "s": "héllo", "d": -1e-9,
+         "by": b"\x00\xff", "arr": [1, -2, 3], "m": {"x": 1.0, "y": -2.5},
+         "u": None, "e": "B", "fx": b"abcd"},
+        {"b": False, "i": 0, "l": -(2**40), "f": -0.0, "s": "", "d": 3.14,
+         "by": b"", "arr": [], "m": {}, "u": "set", "e": "A", "fx": b"wxyz"},
+    ]
+    path = str(tmp_path / "t.avro")
+    write_avro_file(path, recs, schema, codec=codec)
+    out, out_schema = read_avro_file(path)
+    assert len(out) == 2
+    for a, b in zip(out, recs):
+        for k, v in b.items():
+            if k == "f":
+                assert np.isclose(a[k], v)
+            else:
+                assert a[k] == v, (k, a[k], v)
+
+
+def test_avro_zigzag_longs(tmp_path):
+    schema = {"type": "record", "name": "L",
+              "fields": [{"name": "v", "type": "long"}]}
+    vals = [0, -1, 1, -2, 2, 63, -64, 64, 2**62, -(2**62)]
+    path = str(tmp_path / "l.avro")
+    write_avro_file(path, [{"v": v} for v in vals], schema, codec="null")
+    out, _ = read_avro_file(path)
+    assert [r["v"] for r in out] == vals
+
+
+def test_avro_corrupt_sync_detected(tmp_path):
+    schema = {"type": "record", "name": "R", "fields": [{"name": "x", "type": "long"}]}
+    path = str(tmp_path / "c.avro")
+    write_avro_file(path, [{"x": i} for i in range(100)], schema, codec="null")
+    raw = bytearray(open(path, "rb").read())
+    raw[-3] ^= 0xFF  # corrupt inside trailing sync marker
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="sync"):
+        read_avro_file(path)
+
+
+def test_feature_key_roundtrip():
+    assert split_feature_key(feature_key("age", "18-25")) == ("age", "18-25")
+    assert split_feature_key(feature_key("bias")) == ("bias", "")
+
+
+def test_index_map_build_and_io(tmp_path, rng):
+    records = [
+        {"features": [{"name": "a", "term": ""}, {"name": "b", "term": "x"}]},
+        {"features": [{"name": "a", "term": ""}, {"name": "c", "term": ""}]},
+    ]
+    imap = build_index_map(records, add_intercept=True)
+    assert imap.size == 4  # a, b<x>, c + intercept
+    assert imap.intercept_index == 3
+    assert imap.index_of("b", "x") is not None
+    assert imap.index_of("zzz") is None
+    p = str(tmp_path / "imap.json")
+    imap.save(p)
+    loaded = IndexMap.load(p)
+    assert loaded.forward == imap.forward
+    # min_count filter
+    imap2 = build_index_map(records, add_intercept=False, min_count=2)
+    assert imap2.size == 1 and imap2.index_of("a") == 0
+
+
+def test_training_example_roundtrip(tmp_path, rng):
+    n, d = 30, 6
+    X = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.6)
+    y = (rng.random(n) < 0.5).astype(float)
+    w = rng.random(n) + 0.5
+    uid = rng.integers(0, 9999, n)
+    users = rng.integers(0, 5, n)
+    path = str(tmp_path / "train.avro")
+    write_training_examples(
+        path, feature_tuples_from_dense(X), y, weights=w,
+        entity_ids={"userId": users}, uids=uid,
+    )
+    from photon_ml_tpu.io.avro import iter_avro_records
+    imap = build_index_map(iter_avro_records(path), add_intercept=False)
+    feats, labels, offsets, weights, ents, uids = read_training_examples(
+        path, imap, entity_columns=["userId"]
+    )
+    np.testing.assert_allclose(labels, y)
+    np.testing.assert_allclose(weights, w)
+    assert list(ents["userId"]) == [str(u) for u in users]
+    # dense reconstruction matches through the index map
+    sp = feats["global"]
+    dense = np.zeros((n, imap.size))
+    for i in range(n):
+        for j in range(sp.indices.shape[1]):
+            if sp.values[i, j] != 0:
+                dense[i, sp.indices[i, j]] += sp.values[i, j]
+    recon = np.zeros_like(X)
+    for key, idx in imap.forward.items():
+        col = int(key[1:].split("\x01")[0]) if key.startswith("f") else None
+        recon[:, col] = dense[:, idx]
+    np.testing.assert_allclose(recon, X, atol=1e-12)
+
+
+def test_game_model_save_load_roundtrip(tmp_path, rng):
+    import jax.numpy as jnp
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig, CoordinateDescent, make_game_dataset,
+    )
+
+    n = 150
+    Xg = rng.normal(size=(n, 5))
+    Xu = rng.normal(size=(n, 3))
+    uid = rng.integers(0, 8, n)
+    y = (rng.random(n) < 0.5).astype(float)
+    ds = make_game_dataset({"g": Xg, "u": Xu}, y, entity_ids={"userId": uid})
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", feature_shard="g", reg_type="l2", reg_weight=1.0,
+                          compute_variance=True),
+         CoordinateConfig("per-user", coordinate_type="random", feature_shard="u",
+                          entity_column="userId", reg_type="l2", reg_weight=1.0)],
+        task="logistic", dtype=jnp.float64,
+    )
+    model, _ = cd.run(ds)
+    imaps = {
+        "g": IndexMap({f"g{j}": j for j in range(5)}),
+        "u": IndexMap({f"u{j}": j for j in range(3)}),
+    }
+    out = str(tmp_path / "model")
+    save_game_model(model, out, imaps)
+    assert os.path.exists(os.path.join(out, "metadata.json"))
+    loaded = load_game_model(out)
+    assert loaded.task == "logistic"
+    np.testing.assert_allclose(
+        np.asarray(loaded["fixed"].model.coefficients.means),
+        np.asarray(model["fixed"].model.coefficients.means), rtol=1e-12,
+    )
+    # variances persisted
+    assert loaded["fixed"].model.coefficients.variances is not None
+    # every entity's global-space coefficients survive the round trip
+    for eid in np.unique(uid):
+        a = model["per-user"].coefficients_for(eid)
+        b = loaded["per-user"].coefficients_for(str(eid))
+        na = np.zeros(3); na[: len(a)] = a
+        nb = np.zeros(3); nb[: len(b)] = b
+        np.testing.assert_allclose(na, nb, rtol=1e-10, atol=1e-12)
+
+
+def test_libsvm_reader(tmp_path):
+    path = str(tmp_path / "a.txt")
+    with open(path, "w") as f:
+        f.write("+1 1:0.5 3:-2.0\n-1 2:1.5\n# comment\n+1 1:1.0 4:0.25\n")
+    sp, labels, intercept = read_libsvm(path, add_intercept=True)
+    assert sp.dim == 5  # 4 features + intercept
+    assert intercept == 4
+    np.testing.assert_allclose(labels, [1.0, 0.0, 1.0])
+    dense = np.zeros((3, 5))
+    for i in range(3):
+        for j in range(sp.indices.shape[1]):
+            if sp.values[i, j] != 0:
+                dense[i, sp.indices[i, j]] = sp.values[i, j]
+    np.testing.assert_allclose(dense[0], [0.5, 0, -2.0, 0, 1.0])
+    np.testing.assert_allclose(dense[1], [0, 1.5, 0, 0, 1.0])
