@@ -1,0 +1,91 @@
+"""Headline benchmark: Criteo-shaped sparse logistic regression throughput.
+
+Mirrors the north star in BASELINE.json ("Criteo-1TB logistic-reg wall-clock
+vs 256-exec Spark") at single-run scale: a Criteo-like batch (39 nonzeros per
+row, hashed feature space) trained with the distributed jitted L-BFGS path —
+the exact hot loop SURVEY.md §4.2 identifies (the reference pays one cluster
+treeAggregate round-trip per optimizer iteration; here an iteration is an
+on-device fused pass + psum).
+
+Metric: example-passes/second = rows x optimizer-iterations / wall-clock of
+the jitted fit (compile time excluded; one warm-up fit on identical shapes
+precedes the timed run). ``vs_baseline`` is reported against the recorded
+reference baseline; BASELINE.json has ``"published": {}`` (no repo-published
+numbers — see BASELINE.md), so the ratio is against our own round-1 number
+once recorded; until then 1.0.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.data_parallel import fit_distributed
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+    platform = jax.devices()[0].platform
+    # Criteo shape: 39 features/row. Sized to finish the timed fit in
+    # seconds; CPU fallback keeps CI/driver runs fast.
+    if platform == "cpu":
+        n_rows, dim, iters = 1 << 15, 1 << 14, 10
+    else:
+        n_rows, dim, iters = 1 << 21, 1 << 18, 20
+    k = 39
+
+    rng = np.random.default_rng(0)
+    indices = rng.integers(0, dim, size=(n_rows, k), dtype=np.int32)
+    values = np.ones((n_rows, k), np.float32)
+    w_true = rng.normal(size=(dim,)).astype(np.float32) * 0.5
+    logits = w_true[indices].sum(axis=1)
+    labels = (rng.random(n_rows) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
+
+    mesh = make_mesh()
+    obj = make_objective("logistic")
+    batch = LabeledBatch(
+        SparseFeatures(jnp.asarray(indices), jnp.asarray(values), dim=dim),
+        jnp.asarray(labels),
+        jnp.zeros((n_rows,), jnp.float32),
+        jnp.ones((n_rows,), jnp.float32),
+    )
+    w0 = jnp.zeros((dim,), jnp.float32)
+    # tolerance=0 pins the iteration count so the metric is deterministic
+    cfg = OptimizerConfig(max_iters=iters, tolerance=0.0)
+
+    def run():
+        res = fit_distributed(
+            obj, batch, mesh, w0, l2=1.0, optimizer="lbfgs", config=cfg
+        )
+        jax.block_until_ready(res.w)
+        return res
+
+    run()  # compile + warm-up
+    t0 = time.perf_counter()
+    res = run()
+    elapsed = time.perf_counter() - t0
+
+    done = int(res.iterations)
+    value = n_rows * max(done, 1) / elapsed
+    print(json.dumps({
+        "metric": "criteo_shaped_logreg_lbfgs_example_passes_per_sec",
+        "value": round(value, 1),
+        "unit": f"example-passes/sec ({platform}, {len(jax.devices())} dev, "
+                f"n={n_rows}, d={dim}, k={k}, iters={done})",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()
