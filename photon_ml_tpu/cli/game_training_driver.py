@@ -31,10 +31,9 @@ from typing import Dict, List, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.estimators import GameEstimator, GameFitResult
-from photon_ml_tpu.evaluation import EvaluationResults
+from photon_ml_tpu.estimators import GameEstimator
 from photon_ml_tpu.evaluation.evaluators import TASK_DEFAULT_EVALUATOR
-from photon_ml_tpu.game.descent import CoordinateConfig, CoordinateDescent, GameDataset
+from photon_ml_tpu.game.descent import CoordinateConfig, GameDataset
 from photon_ml_tpu.io.avro import iter_avro_records
 from photon_ml_tpu.io.data_reader import read_training_examples
 from photon_ml_tpu.io.index_map import IndexMap, build_index_map, filter_index_map
@@ -201,30 +200,24 @@ def main(argv: Sequence[str] | None = None) -> int:
         task=task, n_iterations=args.n_iterations, evaluators=evaluators,
         dtype=jnp.float64 if args.dtype == "float64" else jnp.float32,
     )
+    ckpt = None
+    if args.checkpoint:
+        def ckpt(gi, it, model):
+            path = os.path.join(args.output_dir, "checkpoints",
+                                f"config-{gi}-iter-{it}")
+            save_game_model(model, path, index_maps)
+            logger.log("checkpoint", config=gi, iteration=it, path=path)
+
+    def log_fit(gi, result):
+        for rec in result.history:
+            logger.log("cd_iteration", config=gi, **rec)
+
     with Timed(logger, "training"):
-        results = []
-        for gi, configs in enumerate(grid):
-            ckpt = None
-            if args.checkpoint:
-                def ckpt(it, model, gi=gi):
-                    path = os.path.join(args.output_dir, "checkpoints",
-                                        f"config-{gi}-iter-{it}")
-                    save_game_model(model, path, index_maps)
-                    logger.log("checkpoint", config=gi, iteration=it, path=path)
-            cd = CoordinateDescent(configs, task=task,
-                                   n_iterations=args.n_iterations,
-                                   evaluators=evaluators,
-                                   dtype=estimator.dtype)
-            model, history = cd.run(train, validation, warm_start=warm,
-                                    locked=args.locked_coordinates,
-                                    checkpoint_callback=ckpt)
-            evaluation = None
-            if validation is not None and evaluators:
-                metrics = {e: history[-1][e] for e in evaluators if e in history[-1]}
-                evaluation = EvaluationResults(metrics, evaluators[0])
-            results.append(GameFitResult(model, evaluation, tuple(configs), history))
-            for rec in history:
-                logger.log("cd_iteration", config=gi, **rec)
+        results = estimator.fit(
+            train, validation, config_grid=grid, warm_start=warm,
+            locked=args.locked_coordinates, checkpoint_callback=ckpt,
+            fit_callback=log_fit,
+        )
 
     best = estimator.select_best(results)
     with Timed(logger, "save_models"):

@@ -61,18 +61,30 @@ class GameEstimator:
         config_grid: Sequence[Sequence[CoordinateConfig]] = (),
         warm_start: Optional[GameModel] = None,
         locked: Sequence[str] = (),
+        checkpoint_callback=None,
+        fit_callback=None,
     ) -> List[GameFitResult]:
+        """Train one GAME model per grid point. ``checkpoint_callback(config_
+        index, iteration, model)`` fires after each outer CD iteration;
+        ``fit_callback(config_index, result)`` after each grid point.
+        A dataset cache shared across grid points keeps the per-entity
+        bucketing built once per (dataset, shard, entity, bucketing) combo."""
         if not config_grid:
             raise ValueError("config_grid must contain at least one configuration")
         results: List[GameFitResult] = []
-        for configs in config_grid:
+        dataset_cache: dict = {}
+        for gi, configs in enumerate(config_grid):
             cd = CoordinateDescent(
                 configs, task=self.task, n_iterations=self.n_iterations,
                 mesh=self.mesh, evaluators=self.evaluator_names,
                 dtype=self.dtype, verbose=self.verbose,
+                dataset_cache=dataset_cache,
             )
+            ckpt = None
+            if checkpoint_callback is not None:
+                ckpt = lambda it, model, gi=gi: checkpoint_callback(gi, it, model)
             model, history = cd.run(train, validation, warm_start=warm_start,
-                                    locked=locked)
+                                    locked=locked, checkpoint_callback=ckpt)
             evaluation = None
             if validation is not None and self.evaluator_names:
                 # final metrics from the last history record
@@ -82,7 +94,10 @@ class GameEstimator:
                     if name in history[-1]
                 }
                 evaluation = EvaluationResults(metrics, self.evaluator_names[0])
-            results.append(GameFitResult(model, evaluation, tuple(configs), history))
+            result = GameFitResult(model, evaluation, tuple(configs), history)
+            results.append(result)
+            if fit_callback is not None:
+                fit_callback(gi, result)
         return results
 
     def select_best(self, results: Sequence[GameFitResult]) -> GameFitResult:

@@ -263,15 +263,25 @@ class _FixedState:
 
 
 class _RandomState:
-    def __init__(self, cfg: CoordinateConfig, data: GameDataset, dtype):
+    def __init__(self, cfg: CoordinateConfig, data: GameDataset, dtype,
+                 cache: Optional[dict] = None):
         sp = data.features[cfg.feature_shard]
         ids = data.entity_ids[cfg.entity_column]
-        self.train_data: RandomEffectTrainData = build_random_effect_data(
-            sp, data.labels, data.weights, ids,
-            effect_name=cfg.name, num_buckets=cfg.num_buckets,
-            active_cap=cfg.active_cap,
-        )
-        self.train_view = build_score_view(self.train_data, sp, ids)
+        key = ("re_data", id(data), cfg.name, cfg.feature_shard,
+               cfg.entity_column, cfg.num_buckets, cfg.active_cap)
+        if cache is not None and key in cache:
+            # entry[0] pins the keyed dataset alive so its id() can't be
+            # recycled by a different GameDataset while the cache lives
+            _, self.train_data, self.train_view = cache[key]
+        else:
+            self.train_data: RandomEffectTrainData = build_random_effect_data(
+                sp, data.labels, data.weights, ids,
+                effect_name=cfg.name, num_buckets=cfg.num_buckets,
+                active_cap=cfg.active_cap,
+            )
+            self.train_view = build_score_view(self.train_data, sp, ids)
+            if cache is not None:
+                cache[key] = (data, self.train_data, self.train_view)
         self.coeffs: Optional[List[np.ndarray]] = None
         self.variances = None
 
@@ -288,6 +298,7 @@ class CoordinateDescent:
         evaluators: Sequence[str] = (),
         dtype=jnp.float32,
         verbose: bool = False,
+        dataset_cache: Optional[dict] = None,
     ):
         names = [c.name for c in configs]
         if len(set(names)) != len(names):
@@ -299,6 +310,11 @@ class CoordinateDescent:
         self.evaluator_names = list(evaluators)
         self.dtype = dtype
         self.verbose = verbose
+        # Shared across CoordinateDescent instances by GameEstimator so the
+        # expensive per-entity bucketing is built once per dataset, not once
+        # per grid point (the reference builds coordinate datasets once and
+        # reuses them across configs — SURVEY.md §4.1).
+        self.dataset_cache = dataset_cache
 
     # -- main loop -------------------------------------------------------
     def run(
@@ -329,7 +345,8 @@ class CoordinateDescent:
             if cfg.coordinate_type == "fixed":
                 states[cfg.name] = _FixedState(cfg, train, dtype, self.task, self.mesh)
             else:
-                states[cfg.name] = _RandomState(cfg, train, dtype)
+                states[cfg.name] = _RandomState(cfg, train, dtype,
+                                                cache=self.dataset_cache)
 
         val_states: Dict[str, object] = {}
         val_feats: Dict[str, SparseFeatures] = {}
@@ -337,9 +354,18 @@ class CoordinateDescent:
             for cfg in self.configs:
                 if cfg.coordinate_type == "random":
                     st: _RandomState = states[cfg.name]
-                    sp = validation.features[cfg.feature_shard]
-                    ids = validation.entity_ids[cfg.entity_column]
-                    val_states[cfg.name] = build_score_view(st.train_data, sp, ids)
+                    key = ("val_view", id(validation), id(st.train_data))
+                    cache = self.dataset_cache
+                    if cache is not None and key in cache:
+                        val_states[cfg.name] = cache[key][2]
+                    else:
+                        sp = validation.features[cfg.feature_shard]
+                        ids = validation.entity_ids[cfg.entity_column]
+                        val_states[cfg.name] = build_score_view(st.train_data, sp, ids)
+                        if cache is not None:
+                            # pin both keyed objects against id() recycling
+                            cache[key] = (validation, st.train_data,
+                                          val_states[cfg.name])
                 else:
                     val_feats[cfg.name] = _device_features(
                         validation.features[cfg.feature_shard], dtype

@@ -77,6 +77,42 @@ def _schema_type(schema) -> str:
     return schema["type"]
 
 
+def dump_schema(schema) -> str:
+    """Serialize a resolved schema to JSON, emitting a *name reference* for
+    the second and later occurrences of each named type. ``parse_schema``
+    aliases repeated references to one shared dict; naively json.dumps-ing
+    that re-defines the named type, which the Avro spec forbids and standard
+    tooling rejects ("Can't redefine")."""
+    seen: set = set()
+
+    def conv(s):
+        if isinstance(s, str):
+            return s
+        if isinstance(s, list):
+            return [conv(b) for b in s]
+        t = s.get("type")
+        if t in ("record", "enum", "fixed"):
+            name = s["name"]
+            full = f"{s['namespace']}.{name}" if s.get("namespace") else name
+            if full in seen:
+                return full
+            seen.add(full)
+            out = dict(s)
+            if t == "record":
+                out["fields"] = [dict(f, type=conv(f["type"])) for f in s["fields"]]
+            return out
+        out = dict(s)
+        if t == "array":
+            out["items"] = conv(s["items"])
+        elif t == "map":
+            out["values"] = conv(s["values"])
+        elif isinstance(t, (dict, list)):
+            out["type"] = conv(t)
+        return out
+
+    return json.dumps(conv(schema))
+
+
 # -- binary primitives -----------------------------------------------------
 def _write_long(out: BinaryIO, n: int) -> None:
     n = (n << 1) ^ (n >> 63)  # zig-zag
@@ -86,19 +122,31 @@ def _write_long(out: BinaryIO, n: int) -> None:
     out.write(bytes([n & 0x7F]))
 
 
-def _read_long(buf: io.BytesIO) -> int:
+def _read_long_or_eof(f: BinaryIO):
+    """Read a zig-zag varint; None at clean EOF (zero bytes available).
+    A partial varint still raises (truncation is corruption, not EOF)."""
+    b = f.read(1)
+    if not b:
+        return None
     shift = 0
     acc = 0
     while True:
-        b = buf.read(1)
-        if not b:
-            raise EOFError("truncated varint")
         byte = b[0]
         acc |= (byte & 0x7F) << shift
         if not byte & 0x80:
             break
         shift += 7
+        b = f.read(1)
+        if not b:
+            raise EOFError("truncated varint")
     return (acc >> 1) ^ -(acc & 1)  # un-zig-zag
+
+
+def _read_long(buf: io.BytesIO) -> int:
+    v = _read_long_or_eof(buf)
+    if v is None:
+        raise EOFError("truncated varint")
+    return v
 
 
 # -- datum encode/decode ---------------------------------------------------
@@ -263,7 +311,7 @@ def write_avro_file(
     with open(path, "wb") as f:
         f.write(MAGIC)
         meta = {
-            "avro.schema": json.dumps(schema).encode(),
+            "avro.schema": dump_schema(schema).encode(),
             "avro.codec": codec.encode(),
         }
         write_datum(f, meta, _META_SCHEMA)
@@ -292,39 +340,63 @@ def write_avro_file(
         flush()
 
 
-def read_avro_file(path: str):
-    """Read an Avro object container file -> (records, schema)."""
-    with open(path, "rb") as f:
-        data = f.read()
-    buf = io.BytesIO(data)
-    if buf.read(4) != MAGIC:
+def _read_header(f: BinaryIO, path: str):
+    """Read container-file magic + metadata -> (schema, codec, sync)."""
+    if f.read(4) != MAGIC:
         raise ValueError(f"{path}: not an Avro object container file")
-    meta = read_datum(buf, _META_SCHEMA)
+    meta = read_datum(f, _META_SCHEMA)
     schema = parse_schema(json.loads(meta["avro.schema"].decode()))
     codec = meta.get("avro.codec", b"null").decode()
     if codec not in ("null", "deflate"):
         raise ValueError(f"{path}: unsupported codec '{codec}'")
-    sync = buf.read(16)
-    records = []
-    while buf.tell() < len(data):
-        count = _read_long(buf)
-        size = _read_long(buf)
-        payload = buf.read(size)
+    sync = f.read(16)
+    return schema, codec, sync
+
+
+def _iter_blocks(f: BinaryIO, path: str, schema, codec: str, sync: bytes) -> Iterator:
+    """Yield records from a positioned container file, one block at a time."""
+    while True:
+        count = _read_long_or_eof(f)
+        if count is None:
+            return
+        size = _read_long(f)
+        payload = f.read(size)
         if codec == "deflate":
             payload = zlib.decompress(payload, -15)
         block = io.BytesIO(payload)
         for _ in range(count):
-            records.append(read_datum(block, schema))
-        if buf.read(16) != sync:
+            yield read_datum(block, schema)
+        if f.read(16) != sync:
             raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+
+
+def stream_avro_file(path: str) -> Iterator:
+    """Yield records one sync-delimited block at a time — constant memory in
+    the file size (one decompressed block resident at once)."""
+    with open(path, "rb") as f:
+        schema, codec, sync = _read_header(f, path)
+        yield from _iter_blocks(f, path, schema, codec, sync)
+
+
+def read_avro_schema(path: str):
+    """Read just the schema from a container file's header."""
+    with open(path, "rb") as f:
+        return _read_header(f, path)[0]
+
+
+def read_avro_file(path: str):
+    """Read an Avro object container file -> (records, schema)."""
+    with open(path, "rb") as f:
+        schema, codec, sync = _read_header(f, path)
+        records = list(_iter_blocks(f, path, schema, codec, sync))
     return records, schema
 
 
 def iter_avro_records(paths: Iterable[str]) -> Iterator:
-    """Stream records from one or more Avro files (directory ok)."""
+    """Stream records from one or more Avro files (directory ok),
+    block-at-a-time — never materializes a whole file."""
     for path in _expand(paths):
-        records, _ = read_avro_file(path)
-        yield from records
+        yield from stream_avro_file(path)
 
 
 def _expand(paths) -> List[str]:
