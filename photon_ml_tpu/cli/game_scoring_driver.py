@@ -25,7 +25,7 @@ from photon_ml_tpu.io.model_io import load_game_model
 from photon_ml_tpu.io.schemas import SCORING_RESULT_SCHEMA
 from photon_ml_tpu.evaluation import get_evaluator
 from photon_ml_tpu.models import RandomEffectModel
-from photon_ml_tpu.utils import PhotonLogger, Timed
+from photon_ml_tpu.utils import PhotonLogger, Timed, resolve_dtype
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -42,10 +42,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    dtype = resolve_dtype(args.dtype)
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(os.path.join(args.output_dir, "photon.log.jsonl"))
     logger.log("driver_start", driver="game_scoring", args=vars(args))
-    dtype = jnp.float64 if args.dtype == "float64" else jnp.float32
 
     with Timed(logger, "load_model"):
         model = load_game_model(args.model_dir)

@@ -43,7 +43,7 @@ from photon_ml_tpu.ops.losses import TASK_TO_LOSS
 from photon_ml_tpu.ops.normalization import NormalizationType, build_normalization_context
 from photon_ml_tpu.ops.statistics import summarize_features
 from photon_ml_tpu.types import make_batch
-from photon_ml_tpu.utils import PhotonLogger, Timed
+from photon_ml_tpu.utils import PhotonLogger, Timed, resolve_dtype
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -79,6 +79,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--summarize-features", action="store_true",
                    help="write FeatureSummarizationResultAvro output")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    p.add_argument("--tuning-mode", default="none",
+                   choices=["none", "random", "bayesian"],
+                   help="auto-tune reg weights after the grid (SURVEY.md §4.5)")
+    p.add_argument("--tuning-iters", type=int, default=10)
+    p.add_argument("--tuning-range", type=float, nargs=2, default=(1e-4, 1e4),
+                   metavar=("LOW", "HIGH"),
+                   help="log-scale search range for regularization weights")
+    p.add_argument("--tuning-coordinates", nargs="*", default=None,
+                   help="coordinates whose reg weights are tuned (default: all "
+                        "unlocked)")
+    p.add_argument("--tuning-seed", type=int, default=0)
     return p
 
 
@@ -122,6 +133,7 @@ def _read_dataset(paths, index_maps, entity_columns) -> GameDataset:
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    dtype = resolve_dtype(args.dtype)
     task = TASK_TO_LOSS.get(args.task, args.task)
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(os.path.join(args.output_dir, "photon.log.jsonl"))
@@ -130,6 +142,28 @@ def main(argv: Sequence[str] | None = None) -> int:
     grid = _load_coordinate_grid(args.coordinates)
     shards = sorted({cfg.feature_shard for cfg in grid[0]})
     entity_columns = _entity_columns(grid)
+
+    # fail fast on bad tuning flags — tuning runs AFTER the (possibly long)
+    # grid training, so catching these there would waste the whole run
+    tuned_coords = None
+    if args.tuning_mode != "none":
+        if not args.validation_data:
+            raise SystemExit("--tuning-mode requires --validation-data")
+        lo, hi = args.tuning_range
+        if not (0 < lo < hi):
+            raise SystemExit(f"--tuning-range needs 0 < LOW < HIGH, got "
+                             f"{lo} {hi}")
+        tuned_coords = args.tuning_coordinates
+        if tuned_coords is None:
+            tuned_coords = [c.name for c in grid[0]
+                            if c.name not in set(args.locked_coordinates)]
+        unknown = set(tuned_coords) - {c.name for c in grid[0]}
+        if unknown:
+            raise SystemExit(f"--tuning-coordinates not in configs: "
+                             f"{sorted(unknown)}")
+        if not tuned_coords:
+            raise SystemExit("--tuning-mode set but no tunable (unlocked) "
+                             "coordinates")
 
     with Timed(logger, "feature_indexing"):
         if args.index_map:
@@ -198,7 +232,7 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     estimator = GameEstimator(
         task=task, n_iterations=args.n_iterations, evaluators=evaluators,
-        dtype=jnp.float64 if args.dtype == "float64" else jnp.float32,
+        dtype=dtype,
     )
     ckpt = None
     if args.checkpoint:
@@ -218,6 +252,25 @@ def main(argv: Sequence[str] | None = None) -> int:
             locked=args.locked_coordinates, checkpoint_callback=ckpt,
             fit_callback=log_fit,
         )
+
+    if args.tuning_mode != "none":
+        from photon_ml_tpu.tuning import tune_game
+
+        def log_tune(ri, result):
+            logger.log("tuning_round", round=ri,
+                       reg_weights={c.name: c.reg_weight for c in result.configs},
+                       metrics=result.evaluation.metrics)
+
+        with Timed(logger, "hyperparameter_tuning"):
+            tuned = tune_game(
+                estimator, train, validation, list(grid[0]),
+                n_iterations=args.tuning_iters, mode=args.tuning_mode,
+                reg_range=tuple(args.tuning_range), prior_results=results,
+                seed=args.tuning_seed, tuned_coordinates=tuned_coords,
+                fit_callback=log_tune, warm_start=warm,
+                locked=args.locked_coordinates,
+            )
+        results = results + tuned
 
     best = estimator.select_best(results)
     with Timed(logger, "save_models"):

@@ -63,16 +63,20 @@ class GameEstimator:
         locked: Sequence[str] = (),
         checkpoint_callback=None,
         fit_callback=None,
+        dataset_cache: Optional[dict] = None,
     ) -> List[GameFitResult]:
         """Train one GAME model per grid point. ``checkpoint_callback(config_
         index, iteration, model)`` fires after each outer CD iteration;
         ``fit_callback(config_index, result)`` after each grid point.
         A dataset cache shared across grid points keeps the per-entity
-        bucketing built once per (dataset, shard, entity, bucketing) combo."""
+        bucketing built once per (dataset, shard, entity, bucketing) combo;
+        pass one explicitly to share it across ``fit`` calls too (the
+        tuner does, so per-round refits don't rebuild it)."""
         if not config_grid:
             raise ValueError("config_grid must contain at least one configuration")
         results: List[GameFitResult] = []
-        dataset_cache: dict = {}
+        if dataset_cache is None:
+            dataset_cache = {}
         for gi, configs in enumerate(config_grid):
             cd = CoordinateDescent(
                 configs, task=self.task, n_iterations=self.n_iterations,

@@ -1,0 +1,29 @@
+"""Bayesian hyperparameter tuning (SURVEY.md §3.1 "Hyperparameter tuning",
+§4.5 call stack; reference mount empty).
+
+Equivalent of the reference's ``hyperparameter.estimators.{GaussianProcess-
+Estimator, GaussianProcessModel}`` and ``hyperparameter.search.{RandomSearch,
+GaussianProcessSearch}``: a Gaussian-process surrogate with a Matérn-5/2
+kernel fit to (hyperparameter-vector, metric) observations, maximizing
+expected improvement to propose the next configuration; random search as the
+baseline strategy. Used by the GAME training driver to auto-tune
+regularization weights after the explicit grid is evaluated.
+"""
+
+from photon_ml_tpu.tuning.gp import GaussianProcessModel, fit_gp, matern52
+from photon_ml_tpu.tuning.search import (
+    GaussianProcessSearch,
+    ParamRange,
+    RandomSearch,
+)
+from photon_ml_tpu.tuning.game_tuner import tune_game
+
+__all__ = [
+    "GaussianProcessModel",
+    "GaussianProcessSearch",
+    "ParamRange",
+    "RandomSearch",
+    "fit_gp",
+    "matern52",
+    "tune_game",
+]

@@ -1,0 +1,105 @@
+"""Auto-tune GAME regularization weights after the explicit grid.
+
+Equivalent of the reference's GAME Bayesian-tuning path (SURVEY.md §4.5:
+GameTrainingDriver seeds a GaussianProcessSearch with the evaluated grid
+points, then runs fit→evaluate rounds; best model across grid + tuned
+points wins). The tunable surface is each coordinate's ``reg_weight`` on a
+log scale — the same surface the reference tunes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from photon_ml_tpu.estimators import GameEstimator, GameFitResult
+from photon_ml_tpu.evaluation import get_evaluator
+from photon_ml_tpu.game.descent import CoordinateConfig, GameDataset
+from photon_ml_tpu.tuning.search import (
+    GaussianProcessSearch,
+    ParamRange,
+    RandomSearch,
+)
+
+
+def tune_game(
+    estimator: GameEstimator,
+    train: GameDataset,
+    validation: GameDataset,
+    base_configs: Sequence[CoordinateConfig],
+    n_iterations: int,
+    mode: str = "bayesian",
+    reg_range: Tuple[float, float] = (1e-4, 1e4),
+    prior_results: Sequence[GameFitResult] = (),
+    seed: int = 0,
+    tuned_coordinates: Optional[Sequence[str]] = None,
+    fit_callback=None,
+    warm_start=None,
+    locked: Sequence[str] = (),
+) -> List[GameFitResult]:
+    """Run ``n_iterations`` tuning rounds; returns one GameFitResult per
+    round. ``prior_results`` (e.g. the evaluated grid) seed the surrogate.
+    ``tuned_coordinates`` restricts which coordinates' reg_weights move
+    (default: all). ``fit_callback(round_index, result)`` fires per round.
+    """
+    if not estimator.evaluator_names:
+        raise ValueError("tuning needs at least one evaluator on the estimator")
+    if mode not in ("random", "bayesian"):
+        raise ValueError(f"tuning mode must be random|bayesian, got {mode}")
+    locked = list(locked)
+    tuned = list(tuned_coordinates
+                 if tuned_coordinates is not None
+                 else [c.name for c in base_configs
+                       if c.name not in set(locked)])
+    known = {c.name for c in base_configs}
+    unknown = set(tuned) - known
+    if unknown:
+        raise ValueError(f"tuned coordinates not in configs: {sorted(unknown)}")
+    if not tuned:
+        raise ValueError("no coordinates to tune")
+
+    primary = estimator.evaluator_names[0]
+    evaluator = get_evaluator(primary)
+    ranges = [ParamRange(name, reg_range[0], reg_range[1], log=True)
+              for name in tuned]
+
+    results: List[GameFitResult] = []
+    dataset_cache: dict = {}  # per-entity bucketing built once, not per round
+
+    def evaluate(params: Dict[str, float]) -> float:
+        configs = [
+            dataclasses.replace(c, reg_weight=params[c.name])
+            if c.name in params else c
+            for c in base_configs
+        ]
+        fits = estimator.fit(train, validation, config_grid=[configs],
+                             warm_start=warm_start, locked=locked,
+                             dataset_cache=dataset_cache)
+        result = fits[0]
+        results.append(result)
+        if fit_callback is not None:
+            fit_callback(len(results) - 1, result)
+        return result.evaluation.metrics[primary]
+
+    search_cls = GaussianProcessSearch if mode == "bayesian" else RandomSearch
+    search = search_cls(ranges, evaluate, seed=seed,
+                        maximize=evaluator.higher_is_better)
+    for prior in prior_results:
+        if prior.evaluation is None or primary not in prior.evaluation.metrics:
+            continue
+        by_name = {c.name: c for c in prior.configs}
+        if not all(name in by_name for name in tuned):
+            continue
+        params = {}
+        in_range = True
+        for name in tuned:
+            w = by_name[name].reg_weight
+            if not (reg_range[0] <= w <= reg_range[1]):
+                in_range = False
+                break
+            params[name] = w
+        if in_range:
+            search.on_prior_observation(params,
+                                        prior.evaluation.metrics[primary])
+    search.find(n_iterations)
+    return results

@@ -1,1 +1,2 @@
+from photon_ml_tpu.utils.config import resolve_dtype
 from photon_ml_tpu.utils.logging import PhotonLogger, Timed

@@ -1,0 +1,15 @@
+"""Small runtime-config helpers shared by the CLI drivers."""
+
+from __future__ import annotations
+
+
+def resolve_dtype(name: str):
+    """Map a ``--dtype`` flag to a jnp dtype, enabling x64 first when needed
+    (jax truncates f64 arrays silently otherwise)."""
+    import jax
+    import jax.numpy as jnp
+
+    if name == "float64":
+        jax.config.update("jax_enable_x64", True)
+        return jnp.float64
+    return jnp.float32

@@ -27,3 +27,18 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def game_dataset_pair():
+    """Small logistic train/validation GameDataset pair (shared by tuning
+    and estimator tests)."""
+    from photon_ml_tpu.game.descent import make_game_dataset
+
+    r = np.random.default_rng(7)
+    n, d = 500, 8
+    X = r.normal(size=(n, d))
+    w = r.normal(size=d)
+    y = (r.random(n) < 1 / (1 + np.exp(-X @ w))).astype(float)
+    tr, va = np.arange(350), np.arange(350, n)
+    return (make_game_dataset(X[tr], y[tr]), make_game_dataset(X[va], y[va]))

@@ -174,3 +174,34 @@ def test_normalization_through_driver(game_fixture):
     by_name = {r["name"]: r for r in records}
     assert by_name["(INTERCEPT)"]["mean"] == 1.0
     assert by_name["(INTERCEPT)"]["variance"] == 0.0
+
+
+def test_tuning_through_driver(game_fixture):
+    out = game_fixture / "out_tune"
+    rc = train_main([
+        "--train-data", str(game_fixture / "train.avro"),
+        "--validation-data", str(game_fixture / "val.avro"),
+        "--output-dir", str(out),
+        "--coordinates", json.dumps([
+            {"name": "fixed", "coordinate_type": "fixed",
+             "reg_type": "l2", "reg_weight": 100.0, "max_iters": 50},
+        ]),
+        "--tuning-mode", "bayesian",
+        "--tuning-iters", "3",
+        "--tuning-range", "0.001", "100.0",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    log = [json.loads(l) for l in (out / "photon.log.jsonl").read_text().splitlines()]
+    rounds = [r for r in log if r["event"] == "tuning_round"]
+    assert len(rounds) == 3
+    assert all("auc" in r["metrics"] for r in rounds)
+    # the tuner actually explored: not every round at the seed weight
+    assert any(r["reg_weights"]["fixed"] != 100.0 for r in rounds)
+    done = [r for r in log if r["event"] == "driver_done"][0]
+    # the selected model is best-of(grid + tuned points)
+    grid_aucs = [r["auc"] for r in log if r["event"] == "cd_iteration"]
+    tuned_aucs = [r["metrics"]["auc"] for r in rounds]
+    assert done["best_metrics"]["auc"] == pytest.approx(
+        max(grid_aucs + tuned_aucs), abs=1e-12
+    )

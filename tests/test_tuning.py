@@ -1,0 +1,156 @@
+"""Hyperparameter tuning: GP regression, random & Bayesian search, GAME
+auto-tune (SURVEY.md §3.1/§4.5 parity)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.tuning import (
+    GaussianProcessSearch,
+    ParamRange,
+    RandomSearch,
+    fit_gp,
+    matern52,
+    tune_game,
+)
+
+
+def test_matern52_kernel_properties():
+    rng = np.random.default_rng(0)
+    x = rng.random((12, 3))
+    k = matern52(x, x, lengthscale=0.5, amplitude=2.0)
+    # symmetric, unit diagonal * amplitude, PSD
+    assert np.allclose(k, k.T)
+    assert np.allclose(np.diag(k), 2.0)
+    eigs = np.linalg.eigvalsh(k)
+    assert eigs.min() > -1e-9
+    # monotone decreasing in distance
+    k2 = matern52(np.array([[0.0]]), np.array([[0.1], [0.5], [2.0]]), 0.5)
+    assert k2[0, 0] > k2[0, 1] > k2[0, 2]
+
+
+def test_gp_regression_recovers_smooth_function():
+    rng = np.random.default_rng(1)
+    x = rng.random((40, 1))
+    y = np.sin(6.0 * x[:, 0]) + 0.01 * rng.normal(size=40)
+    gp = fit_gp(x, y)
+    xq = np.linspace(0.05, 0.95, 50)[:, None]
+    mean, std = gp.predict(xq)
+    rmse = np.sqrt(np.mean((mean - np.sin(6.0 * xq[:, 0])) ** 2))
+    assert rmse < 0.1
+    # predictive std collapses at observed points relative to far points
+    m_at, s_at = gp.predict(x[:1])
+    assert s_at[0] < std.max()
+
+
+def test_gp_constant_targets_do_not_crash():
+    x = np.linspace(0, 1, 5)[:, None]
+    gp = fit_gp(x, np.ones(5))
+    mean, std = gp.predict(np.array([[0.5]]))
+    assert np.isfinite(mean).all() and np.isfinite(std).all()
+
+
+def test_param_range_roundtrip_and_log_scale():
+    lin = ParamRange("a", -2.0, 6.0)
+    log = ParamRange("b", 1e-4, 1e4, log=True)
+    for v in [-2.0, 0.0, 6.0]:
+        assert lin.from_unit(lin.to_unit(v)) == pytest.approx(v)
+    for v in [1e-4, 1.0, 1e4]:
+        assert log.from_unit(log.to_unit(v)) == pytest.approx(v, rel=1e-9)
+    # log midpoint is the geometric mean
+    assert log.from_unit(0.5) == pytest.approx(1.0, rel=1e-6)
+    with pytest.raises(ValueError):
+        ParamRange("c", 1.0, 1.0)
+    with pytest.raises(ValueError):
+        ParamRange("d", 0.0, 1.0, log=True)
+
+
+def _quadratic(params):
+    return -((params["x"] - 0.7) ** 2) - (params["y"] + 0.2) ** 2
+
+
+def test_random_search_improves():
+    ranges = [ParamRange("x", -2.0, 2.0), ParamRange("y", -2.0, 2.0)]
+    search = RandomSearch(ranges, _quadratic, seed=0, maximize=True)
+    obs = search.find(60)
+    assert len(obs) == 60
+    best = search.best()
+    assert best.value > -0.2  # near the optimum at (0.7, -0.2)
+
+
+def test_gp_search_beats_random_budget():
+    ranges = [ParamRange("x", -2.0, 2.0), ParamRange("y", -2.0, 2.0)]
+    gp_search = GaussianProcessSearch(ranges, _quadratic, seed=3, maximize=True)
+    gp_search.find(25)
+    assert gp_search.best().value > -0.05
+
+
+def test_gp_search_minimize_direction():
+    ranges = [ParamRange("x", 0.0, 1.0)]
+    search = GaussianProcessSearch(
+        ranges, lambda p: (p["x"] - 0.3) ** 2, seed=0, maximize=False
+    )
+    search.find(20)
+    assert abs(search.best().params["x"] - 0.3) < 0.1
+
+
+def test_prior_observations_seed_the_search():
+    ranges = [ParamRange("x", 0.0, 1.0)]
+    calls = []
+
+    def f(p):
+        calls.append(p["x"])
+        return -((p["x"] - 0.5) ** 2)
+
+    search = GaussianProcessSearch(ranges, f, seed=0, maximize=True)
+    for v in [0.1, 0.45, 0.9]:
+        search.on_prior_observation({"x": v}, -((v - 0.5) ** 2))
+    search.find(8)
+    assert len(search.observations) == 11
+    assert abs(search.best().params["x"] - 0.5) < 0.1
+
+
+def test_tune_game_improves_over_bad_grid(game_dataset_pair):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.estimators import GameEstimator
+    from photon_ml_tpu.game.descent import CoordinateConfig
+
+    train, val = game_dataset_pair
+    estimator = GameEstimator(task="logistic", n_iterations=1,
+                              evaluators=["auc"], dtype=jnp.float64)
+    # deliberately over-regularized starting grid
+    base = [CoordinateConfig(name="fixed", coordinate_type="fixed",
+                             reg_type="l2", reg_weight=1e4, max_iters=40)]
+    grid_fits = estimator.fit(train, val, config_grid=[base])
+    results = tune_game(
+        estimator, train, val, base,
+        n_iterations=4, mode="bayesian", reg_range=(1e-3, 1e4),
+        prior_results=grid_fits, seed=0,
+    )
+    assert len(results) == 4
+    best_tuned = max(r.evaluation.metrics["auc"] for r in results)
+    assert best_tuned >= grid_fits[0].evaluation.metrics["auc"] - 1e-9
+    # the tuned reg weights actually moved off the seed value
+    tuned_weights = {r.configs[0].reg_weight for r in results}
+    assert any(w != 1e4 for w in tuned_weights)
+
+
+def test_tune_game_validates_inputs(game_dataset_pair):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.estimators import GameEstimator
+    from photon_ml_tpu.game.descent import CoordinateConfig
+
+    train, val = game_dataset_pair
+    base = [CoordinateConfig(name="fixed", coordinate_type="fixed")]
+    no_eval = GameEstimator(task="logistic", evaluators=[])
+    with pytest.raises(ValueError, match="evaluator"):
+        tune_game(no_eval, train, val, base, n_iterations=1)
+    est = GameEstimator(task="logistic", evaluators=["auc"], dtype=jnp.float64)
+    with pytest.raises(ValueError, match="mode"):
+        tune_game(est, train, val, base, n_iterations=1, mode="grid")
+    with pytest.raises(ValueError, match="not in configs"):
+        tune_game(est, train, val, base, n_iterations=1,
+                  tuned_coordinates=["nope"])
