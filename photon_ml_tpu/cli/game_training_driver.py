@@ -153,17 +153,18 @@ def main(argv: Sequence[str] | None = None) -> int:
         if not (0 < lo < hi):
             raise SystemExit(f"--tuning-range needs 0 < LOW < HIGH, got "
                              f"{lo} {hi}")
-        tuned_coords = args.tuning_coordinates
-        if tuned_coords is None:
-            tuned_coords = [c.name for c in grid[0]
-                            if c.name not in set(args.locked_coordinates)]
-        unknown = set(tuned_coords) - {c.name for c in grid[0]}
-        if unknown:
-            raise SystemExit(f"--tuning-coordinates not in configs: "
-                             f"{sorted(unknown)}")
-        if not tuned_coords:
-            raise SystemExit("--tuning-mode set but no tunable (unlocked) "
-                             "coordinates")
+        if args.evaluators is not None and not args.evaluators:
+            raise SystemExit("--tuning-mode needs at least one evaluator "
+                             "(drop the bare --evaluators flag to use the "
+                             "task default)")
+        from photon_ml_tpu.tuning import resolve_tuned_coordinates
+
+        try:
+            tuned_coords = resolve_tuned_coordinates(
+                grid[0], args.tuning_coordinates, args.locked_coordinates
+            )
+        except ValueError as e:
+            raise SystemExit(f"--tuning-coordinates: {e}")
 
     with Timed(logger, "feature_indexing"):
         if args.index_map:

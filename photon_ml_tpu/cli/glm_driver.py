@@ -1,0 +1,314 @@
+"""Classic (non-GAME) GLM training driver — the staged pipeline.
+
+Equivalent of the reference's legacy ``com.linkedin.photon.ml.Driver``
+(SURVEY.md §3.3, marked ``(?)``; reference mount empty): a fixed sequence of
+stages — validate → summarize/normalize → train one model per regularization
+weight with **warm start** across the lambda grid → validate + select best →
+diagnostics — for a single fixed-effect GLM, no random effects. The GAME
+driver supersedes this for mixed-effect models; this driver remains the
+shortest path for plain sparse GLMs (the a1a / Criteo baseline configs,
+BASELINE.md #1–#3).
+
+TPU-native shape: each lambda's fit is one jitted device computation
+(`fit_distributed`: sharded batch + psum — SURVEY.md §4.2); the lambda loop
+reuses the same compiled program because the regularization weight is a
+traced argument.
+
+Usage:
+    python -m photon_ml_tpu.cli.glm_driver \
+        --train-data a1a --input-format libsvm --task logistic_regression \
+        --reg-weights 0.1 1.0 10.0 --optimizer lbfgs --output-dir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.evaluation import get_evaluator
+from photon_ml_tpu.evaluation.evaluators import TASK_DEFAULT_EVALUATOR
+from photon_ml_tpu.game.data import HostSparse
+from photon_ml_tpu.io.avro import iter_avro_records
+from photon_ml_tpu.io.data_reader import read_training_examples
+from photon_ml_tpu.io.index_map import IndexMap, build_index_map
+from photon_ml_tpu.io.libsvm import read_libsvm
+from photon_ml_tpu.io.model_io import save_game_model
+from photon_ml_tpu.io.validators import validate_training_data
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    GeneralizedLinearModel,
+)
+from photon_ml_tpu.ops.losses import TASK_TO_LOSS
+from photon_ml_tpu.ops.normalization import (
+    NormalizationType,
+    build_normalization_context,
+)
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.ops.statistics import summarize_features
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.parallel.data_parallel import fit_distributed
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import SparseFeatures, make_batch
+from photon_ml_tpu.utils import PhotonLogger, Timed, resolve_dtype
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Classic GLM training driver "
+                                            "(staged pipeline, TPU-native)")
+    p.add_argument("--train-data", required=True, nargs="+")
+    p.add_argument("--validation-data", nargs="+", default=None)
+    p.add_argument("--input-format", default="avro", choices=["avro", "libsvm"])
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", default="logistic_regression",
+                   choices=sorted(TASK_TO_LOSS) + sorted(set(TASK_TO_LOSS.values())))
+    p.add_argument("--optimizer", default="lbfgs",
+                   choices=["lbfgs", "owlqn", "tron"])
+    p.add_argument("--reg-type", default="l2",
+                   choices=["none", "l1", "l2", "elastic_net"])
+    p.add_argument("--reg-weights", type=float, nargs="+", default=[0.0],
+                   help="lambda grid; trained in order with warm start")
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument("--max-iters", type=int, default=100)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--normalization", default="none",
+                   choices=[t.value for t in NormalizationType])
+    p.add_argument("--add-intercept", action="store_true", default=True)
+    p.add_argument("--no-intercept", dest="add_intercept", action="store_false")
+    p.add_argument("--index-map", default=None,
+                   help="prebuilt index-map JSON (avro input only)")
+    p.add_argument("--min-feature-count", type=int, default=1)
+    p.add_argument("--evaluators", nargs="*", default=None)
+    p.add_argument("--validate-data", action="store_true", default=True,
+                   help="run DataValidators-style checks before training")
+    p.add_argument("--no-validate-data", dest="validate_data",
+                   action="store_false")
+    p.add_argument("--compute-variances", action="store_true",
+                   help="diagonal-inverse-Hessian coefficient variances")
+    p.add_argument("--summarize-features", action="store_true")
+    p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    return p
+
+
+def _read(paths, fmt, index_map: Optional[IndexMap], add_intercept):
+    """-> (HostSparse, labels, offsets, weights, index_map, intercept_index).
+    Host-side only; device conversion happens after validation."""
+    if fmt == "libsvm":
+        # read raw (no intercept) so multiple files share one feature space,
+        # then append the intercept column at the common dim
+        parts = [read_libsvm(p) for p in paths]
+        # an index_map (from the training pass) pins the feature space, so
+        # validation files line up with the trained model: features beyond it
+        # are dropped, missing ones stay implicit zeros
+        if index_map is not None:
+            base_dim = index_map.size - (
+                1 if index_map.intercept_index >= 0 else 0
+            )
+            for sp, _, _ in parts:
+                drop = sp.indices >= base_dim
+                sp.indices[drop] = 0
+                sp.values[drop] = 0.0
+        else:
+            base_dim = max(sp.dim for sp, _, _ in parts)
+        intercept = base_dim if add_intercept else -1
+        dim = base_dim + (1 if add_intercept else 0)
+        k = max(sp.values.shape[1] for sp, _, _ in parts) + (
+            1 if add_intercept else 0
+        )
+        n = sum(sp.num_rows for sp, _, _ in parts)
+        indices = np.zeros((n, k), np.int32)
+        values = np.zeros((n, k))
+        at = 0
+        for sp, _, _ in parts:
+            m, kk = sp.values.shape
+            indices[at:at + m, :kk] = sp.indices
+            values[at:at + m, :kk] = sp.values
+            if add_intercept:
+                indices[at:at + m, kk] = intercept
+                values[at:at + m, kk] = 1.0
+            at += m
+        labels = np.concatenate([lab for _, lab, _ in parts])
+        feats = HostSparse(indices, values, dim)
+        if index_map is None:
+            entries = {f"f{i}": i for i in range(base_dim)}
+            if intercept >= 0:
+                entries["(INTERCEPT)"] = intercept
+            index_map = IndexMap(entries)
+        return feats, labels, np.zeros(n), np.ones(n), index_map, intercept
+    feats, labels, offsets, weights, _, _ = read_training_examples(
+        paths, index_map
+    )
+    return (feats["global"], labels, offsets, weights, index_map,
+            index_map.intercept_index)
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    dtype = resolve_dtype(args.dtype)
+    task = TASK_TO_LOSS.get(args.task, args.task)
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger = PhotonLogger(os.path.join(args.output_dir, "photon.log.jsonl"))
+    logger.log("driver_start", driver="glm", args=vars(args))
+
+    reg = RegularizationContext(args.reg_type, alpha=args.elastic_net_alpha)
+    optimizer = args.optimizer
+    if reg.needs_owlqn and optimizer != "owlqn":
+        logger.log("optimizer_override", requested=optimizer, used="owlqn",
+                   reason=f"reg_type={args.reg_type} needs OWL-QN")
+        optimizer = "owlqn"
+
+    # -- stage: read + index -------------------------------------------------
+    with Timed(logger, "read_train_data"):
+        index_map = None
+        if args.input_format == "avro":
+            if args.index_map:
+                index_map = IndexMap.load(args.index_map)
+            else:
+                index_map = build_index_map(
+                    iter_avro_records(args.train_data),
+                    add_intercept=args.add_intercept,
+                    min_count=args.min_feature_count,
+                )
+        host_feats, labels, offsets, weights, index_map, intercept_index = _read(
+            args.train_data, args.input_format, index_map, args.add_intercept
+        )
+    validation = None
+    if args.validation_data:
+        with Timed(logger, "read_validation_data"):
+            vhost, vlabels, voffsets, vweights, _, _ = _read(
+                args.validation_data, args.input_format, index_map,
+                args.add_intercept,
+            )
+            validation = (vhost, vlabels, voffsets, vweights)
+    logger.log("data_read", num_train=int(labels.shape[0]),
+               num_validation=0 if validation is None else int(vlabels.shape[0]),
+               num_features=host_feats.dim)
+
+    # -- stage: validate (on host, before any device transfer) ---------------
+    if args.validate_data:
+        with Timed(logger, "validate_data"):
+            validate_training_data(host_feats, labels, offsets, weights,
+                                   task=task)
+            if validation is not None:
+                validate_training_data(vhost, vlabels, voffsets, vweights,
+                                       task=task)
+
+    # -- stage: summarize + normalization ------------------------------------
+    feats = SparseFeatures(jnp.asarray(host_feats.indices),
+                           jnp.asarray(host_feats.values, dtype),
+                           dim=host_feats.dim)
+    batch = make_batch(feats, labels, offsets, weights, dtype=dtype)
+    validation_batch = None
+    if validation is not None:
+        vfeats = SparseFeatures(jnp.asarray(vhost.indices),
+                                jnp.asarray(vhost.values, dtype),
+                                dim=vhost.dim)
+        validation_batch = make_batch(vfeats, vlabels, voffsets, vweights,
+                                      dtype=dtype)
+    norm_type = NormalizationType(args.normalization)
+    normalization = None
+    if norm_type != NormalizationType.NONE or args.summarize_features:
+        with Timed(logger, "feature_summarization"):
+            summary = summarize_features(batch)
+            if args.summarize_features:
+                from photon_ml_tpu.cli.game_training_driver import _write_summary
+
+                _write_summary(args.output_dir, summary, index_map)
+            if norm_type != NormalizationType.NONE:
+                normalization = build_normalization_context(
+                    norm_type, summary, intercept_index=intercept_index
+                )
+
+    objective = make_objective(task, normalization=normalization,
+                               intercept_index=intercept_index)
+    mesh = make_mesh()
+    opt_config = OptimizerConfig(max_iters=args.max_iters,
+                                 tolerance=args.tolerance)
+
+    evaluators = args.evaluators
+    if evaluators is None:
+        evaluators = [TASK_DEFAULT_EVALUATOR[task]] if validation is not None else []
+
+    # -- stage: train over the lambda grid with warm start -------------------
+    results = []
+    w = jnp.zeros((feats.dim,), dtype)
+    with Timed(logger, "training"):
+        for lam in args.reg_weights:
+            res = fit_distributed(
+                objective, batch, mesh, w,
+                l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
+                optimizer=optimizer, config=opt_config,
+            )
+            w = res.w  # warm start the next lambda
+            diag = {
+                "reg_weight": lam,
+                "loss": float(res.value),
+                "grad_norm": float(res.grad_norm),
+                "iterations": int(res.iterations),
+                "converged": bool(res.converged),
+                "loss_history": [
+                    float(v) for v in np.asarray(res.loss_history)
+                    if np.isfinite(v)
+                ],
+            }
+            metrics = {}
+            if validation_batch is not None and evaluators:
+                scores = np.asarray(objective.margins(res.w, validation_batch))
+                for name in evaluators:
+                    metrics[name] = get_evaluator(name).evaluate(
+                        scores, vlabels, vweights
+                    )
+                diag["metrics"] = metrics
+            variances = None
+            if args.compute_variances:
+                variances = objective.coefficient_variances(
+                    res.w, batch, reg.l2_weight(lam)
+                )
+            results.append((lam, res, metrics, variances))
+            logger.log("lambda_trained", **diag)
+
+    # -- stage: validate + select best ---------------------------------------
+    best_i = 0
+    if validation is not None and evaluators:
+        ev = get_evaluator(evaluators[0])
+        for i in range(1, len(results)):
+            if ev.better(results[i][2][evaluators[0]],
+                         results[best_i][2][evaluators[0]]):
+                best_i = i
+
+    # -- stage: diagnostics + model output ------------------------------------
+    with Timed(logger, "save_models"):
+        for i, (lam, res, metrics, variances) in enumerate(results):
+            model = GameModel(
+                {"global": FixedEffectModel(
+                    GeneralizedLinearModel(
+                        Coefficients(res.w, variances), task=task
+                    )
+                )},
+                task=task,
+            )
+            out = os.path.join(
+                args.output_dir,
+                "best" if i == best_i else os.path.join("all", f"lambda-{lam:g}"),
+            )
+            save_game_model(model, out, index_map)
+            if i == best_i and len(results) > 1:
+                save_game_model(
+                    model, os.path.join(args.output_dir, "all", f"lambda-{lam:g}"),
+                    index_map,
+                )
+    logger.log("driver_done", best_reg_weight=results[best_i][0],
+               best_metrics=results[best_i][2] or None)
+    logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,7 +16,7 @@ from photon_ml_tpu.tuning.search import (
     ParamRange,
     RandomSearch,
 )
-from photon_ml_tpu.tuning.game_tuner import tune_game
+from photon_ml_tpu.tuning.game_tuner import resolve_tuned_coordinates, tune_game
 
 __all__ = [
     "GaussianProcessModel",
@@ -25,5 +25,6 @@ __all__ = [
     "RandomSearch",
     "fit_gp",
     "matern52",
+    "resolve_tuned_coordinates",
     "tune_game",
 ]

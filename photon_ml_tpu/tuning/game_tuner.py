@@ -22,6 +22,26 @@ from photon_ml_tpu.tuning.search import (
 )
 
 
+def resolve_tuned_coordinates(
+    base_configs: Sequence[CoordinateConfig],
+    tuned_coordinates: Optional[Sequence[str]],
+    locked: Sequence[str] = (),
+) -> List[str]:
+    """Which coordinates' reg weights move during tuning: the explicit list,
+    else every unlocked coordinate. Shared by the driver's fail-fast check
+    and ``tune_game`` so the two can't disagree."""
+    tuned = list(tuned_coordinates
+                 if tuned_coordinates is not None
+                 else [c.name for c in base_configs
+                       if c.name not in set(locked)])
+    unknown = set(tuned) - {c.name for c in base_configs}
+    if unknown:
+        raise ValueError(f"tuned coordinates not in configs: {sorted(unknown)}")
+    if not tuned:
+        raise ValueError("no coordinates to tune")
+    return tuned
+
+
 def tune_game(
     estimator: GameEstimator,
     train: GameDataset,
@@ -46,17 +66,7 @@ def tune_game(
         raise ValueError("tuning needs at least one evaluator on the estimator")
     if mode not in ("random", "bayesian"):
         raise ValueError(f"tuning mode must be random|bayesian, got {mode}")
-    locked = list(locked)
-    tuned = list(tuned_coordinates
-                 if tuned_coordinates is not None
-                 else [c.name for c in base_configs
-                       if c.name not in set(locked)])
-    known = {c.name for c in base_configs}
-    unknown = set(tuned) - known
-    if unknown:
-        raise ValueError(f"tuned coordinates not in configs: {sorted(unknown)}")
-    if not tuned:
-        raise ValueError("no coordinates to tune")
+    tuned = resolve_tuned_coordinates(base_configs, tuned_coordinates, locked)
 
     primary = estimator.evaluator_names[0]
     evaluator = get_evaluator(primary)

@@ -1,0 +1,128 @@
+"""Classic GLM driver (staged pipeline) + data-validator tests — the
+reference's legacy ``Driver`` tier (SURVEY.md §3.3, integTest style §8)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.glm_driver import main as glm_main
+from photon_ml_tpu.io.data_reader import feature_tuples_from_dense, write_training_examples
+from photon_ml_tpu.io.validators import DataValidationError, validate_training_data
+
+
+def _write_libsvm(path, X, y):
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            toks = [f"{int(y[i]) * 2 - 1}"]
+            for j in np.nonzero(X[i])[0]:
+                toks.append(f"{j + 1}:{X[i, j]:.6f}")
+            f.write(" ".join(toks) + "\n")
+
+
+@pytest.fixture
+def logistic_data(rng):
+    n, d = 400, 10
+    X = (rng.random((n, d)) < 0.4) * rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
+    return X, y
+
+
+def test_glm_driver_libsvm_lambda_grid(tmp_path, logistic_data):
+    X, y = logistic_data
+    _write_libsvm(tmp_path / "train.svm", X[:300], y[:300])
+    _write_libsvm(tmp_path / "val.svm", X[300:], y[300:])
+    out = tmp_path / "out"
+    rc = glm_main([
+        "--train-data", str(tmp_path / "train.svm"),
+        "--validation-data", str(tmp_path / "val.svm"),
+        "--input-format", "libsvm",
+        "--output-dir", str(out),
+        "--reg-weights", "10.0", "1.0", "0.1",
+        "--compute-variances",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    assert (out / "best" / "metadata.json").exists()
+    # every lambda lands under all/ (best is also mirrored there)
+    for lam in ("10", "1", "0.1"):
+        assert (out / "all" / f"lambda-{lam}" / "metadata.json").exists()
+    log = [json.loads(l) for l in (out / "photon.log.jsonl").read_text().splitlines()]
+    trained = [r for r in log if r["event"] == "lambda_trained"]
+    assert [r["reg_weight"] for r in trained] == [10.0, 1.0, 0.1]
+    assert all(r["metrics"]["auc"] > 0.6 for r in trained)
+    done = [r for r in log if r["event"] == "driver_done"][0]
+    # selection picks the grid point with the best validation AUC
+    best = max(trained, key=lambda r: r["metrics"]["auc"])
+    assert done["best_reg_weight"] == best["reg_weight"]
+    assert done["best_metrics"]["auc"] == best["metrics"]["auc"]
+
+    # model round-trips through the standard GAME loader
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    model = load_game_model(str(out / "best"))
+    w = np.asarray(model["global"].model.coefficients.means)
+    assert w.shape[0] == X.shape[1] + 1  # + intercept
+    assert model["global"].model.coefficients.variances is not None
+
+
+def test_glm_driver_avro_elastic_net(tmp_path, logistic_data):
+    X, y = logistic_data
+    write_training_examples(
+        str(tmp_path / "train.avro"), feature_tuples_from_dense(X[:300]), y[:300]
+    )
+    write_training_examples(
+        str(tmp_path / "val.avro"), feature_tuples_from_dense(X[300:]), y[300:]
+    )
+    out = tmp_path / "out"
+    rc = glm_main([
+        "--train-data", str(tmp_path / "train.avro"),
+        "--validation-data", str(tmp_path / "val.avro"),
+        "--output-dir", str(out),
+        "--reg-type", "elastic_net",
+        "--reg-weights", "0.5",
+        "--normalization", "standardization",
+        "--summarize-features",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    assert (out / "feature-summary.avro").exists()
+    log = [json.loads(l) for l in (out / "photon.log.jsonl").read_text().splitlines()]
+    # elastic net forces the OWL-QN override
+    assert any(r["event"] == "optimizer_override" and r["used"] == "owlqn"
+               for r in log)
+    trained = [r for r in log if r["event"] == "lambda_trained"]
+    assert trained[0]["metrics"]["auc"] > 0.6
+
+
+def test_glm_driver_validation_rejects_bad_labels(tmp_path, logistic_data):
+    X, y = logistic_data
+    y_bad = y.copy()
+    y_bad[0] = 3.0  # not a binary label
+    write_training_examples(
+        str(tmp_path / "train.avro"), feature_tuples_from_dense(X), y_bad
+    )
+    with pytest.raises(DataValidationError, match="outside"):
+        glm_main([
+            "--train-data", str(tmp_path / "train.avro"),
+            "--output-dir", str(tmp_path / "out"),
+            "--reg-weights", "1.0",
+        ])
+
+
+def test_validate_training_data_rules():
+    X = np.ones((4, 2))
+    y = np.array([0.0, 1.0, 1.0, 0.0])
+    validate_training_data(X, y, task="logistic")  # clean passes
+
+    with pytest.raises(DataValidationError, match="non-finite labels"):
+        validate_training_data(X, np.array([0.0, np.nan, 1.0, 0.0]))
+    with pytest.raises(DataValidationError, match="negative labels"):
+        validate_training_data(X, np.array([1.0, -2.0, 0.0, 3.0]), task="poisson")
+    with pytest.raises(DataValidationError, match="non-finite feature"):
+        validate_training_data(np.array([[np.inf, 1.0]]), np.array([1.0]))
+    with pytest.raises(DataValidationError, match="non-positive weights"):
+        validate_training_data(X, y, weights=np.array([1.0, 0.0, 1.0, 1.0]))
+    with pytest.raises(DataValidationError, match="non-finite offsets"):
+        validate_training_data(X, y, offsets=np.array([0.0, np.nan, 0.0, 0.0]))
