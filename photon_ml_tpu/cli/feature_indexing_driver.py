@@ -18,7 +18,10 @@ from photon_ml_tpu.utils import PhotonLogger
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="Feature indexing driver (TPU-native)")
     p.add_argument("--data", required=True, nargs="+")
-    p.add_argument("--output", required=True, help="index map JSON path")
+    p.add_argument("--output", required=True, help="index map output path")
+    p.add_argument("--store-format", default="json", choices=["json", "paldb"],
+                   help="json: human-readable; paldb: native mmap store "
+                        "(the reference's PalDB role, zero load time)")
     p.add_argument("--min-feature-count", type=int, default=1)
     p.add_argument("--add-intercept", action="store_true", default=True)
     p.add_argument("--no-intercept", dest="add_intercept", action="store_false")
@@ -33,8 +36,14 @@ def main(argv: Sequence[str] | None = None) -> int:
         add_intercept=args.add_intercept,
         min_count=args.min_feature_count,
     )
-    imap.save(args.output)
-    logger.log("index_map_built", num_features=imap.size, output=args.output)
+    if args.store_format == "paldb":
+        from photon_ml_tpu.io.paldb import build_store
+
+        build_store(imap.forward, args.output)
+    else:
+        imap.save(args.output)
+    logger.log("index_map_built", num_features=imap.size, output=args.output,
+               store_format=args.store_format)
     return 0
 
 

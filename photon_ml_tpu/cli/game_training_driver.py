@@ -168,7 +168,9 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     with Timed(logger, "feature_indexing"):
         if args.index_map:
-            base_map = IndexMap.load(args.index_map)
+            from photon_ml_tpu.io.paldb import load_index_map
+
+            base_map = load_index_map(args.index_map)
         else:
             base_map = build_index_map(
                 iter_avro_records(args.train_data),

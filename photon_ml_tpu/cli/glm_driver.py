@@ -169,7 +169,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         index_map = None
         if args.input_format == "avro":
             if args.index_map:
-                index_map = IndexMap.load(args.index_map)
+                from photon_ml_tpu.io.paldb import load_index_map
+
+                index_map = load_index_map(args.index_map)
             else:
                 index_map = build_index_map(
                     iter_avro_records(args.train_data),

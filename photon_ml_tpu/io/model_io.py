@@ -127,7 +127,9 @@ def load_game_model(directory: str) -> GameModel:
     for c in meta["coordinates"]:
         shard = c["feature_shard"]
         if shard not in index_maps:
-            index_maps[shard] = IndexMap.load(
+            from photon_ml_tpu.io.paldb import load_index_map
+
+            index_maps[shard] = load_index_map(
                 os.path.join(directory, f"index-map.{shard}.json")
             )
         imap = index_maps[shard]

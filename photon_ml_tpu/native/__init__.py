@@ -1,0 +1,62 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded via ctypes (pybind11 is not available in this image).
+
+Currently: the persistent feature index store (``feature_index_store.cpp``)
+— the PalDB replacement (SURVEY.md §3.3/§3.7).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_BUILD_LOCK = threading.Lock()
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _source_digest(src_path: str) -> str:
+    with open(src_path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def build_library(name: str, *, cxx: str | None = None) -> str:
+    """Compile ``<name>.cpp`` into a cached ``.so`` and return its path.
+    The cache key includes a source digest, so editing the .cpp rebuilds."""
+    src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+    if not os.path.exists(src):
+        raise NativeBuildError(f"no such native source: {src}")
+    out_dir = os.path.join(_NATIVE_DIR, "_build")
+    lib = os.path.join(out_dir, f"lib{name}-{_source_digest(src)}.so")
+    with _BUILD_LOCK:
+        if os.path.exists(lib):
+            return lib
+        os.makedirs(out_dir, exist_ok=True)
+        cxx = cxx or os.environ.get("CXX", "g++")
+        cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", src, "-o",
+               lib + ".tmp"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise NativeBuildError(f"compiler not found: {cxx}") from e
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"native build failed ({' '.join(cmd)}):\n{proc.stderr}"
+            )
+        os.replace(lib + ".tmp", lib)
+    return lib
+
+
+_LOADED: dict[str, ctypes.CDLL] = {}
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    if name not in _LOADED:
+        _LOADED[name] = ctypes.CDLL(build_library(name))
+    return _LOADED[name]

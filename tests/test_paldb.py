@@ -1,0 +1,117 @@
+"""Native persistent index store (the PalDB replacement — SURVEY.md §3.3):
+build → reopen → lookup parity with the in-memory IndexMap."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.paldb import PersistentIndexMap, build_store, load_index_map
+from photon_ml_tpu.io.schemas import INTERCEPT_KEY, feature_key
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    forward = {feature_key(f"name{i}", f"t{i % 7}"): i for i in range(5000)}
+    forward["unicode→feature"] = 5000
+    forward[INTERCEPT_KEY] = 5001
+    path = str(tmp_path_factory.mktemp("paldb") / "index.store")
+    build_store(forward, path)
+    return forward, path
+
+
+def test_build_open_lookup_parity(store):
+    forward, path = store
+    pmap = PersistentIndexMap(path)
+    assert pmap.size == len(forward)
+    assert pmap.intercept_index == 5001
+    # every key resolves to the same index as the dict
+    for key, idx in list(forward.items())[::97]:
+        name, _, term = key.partition("\x01")
+        assert pmap.index_of(name, term) == idx
+    assert pmap.index_of("nope") is None
+    assert pmap.index_of("name1", "wrong-term") is None
+
+
+def test_inverse_and_items_roundtrip(store):
+    forward, path = store
+    pmap = PersistentIndexMap(path)
+    assert dict(pmap.items()) == forward
+    inv = pmap.inverse()
+    assert len(inv) == len(forward)
+    assert inv[5000] == "unicode→feature"
+
+
+def test_lookup_batch(store):
+    forward, path = store
+    pmap = PersistentIndexMap(path)
+    keys = list(forward)[:100] + ["missing-a", "missing-b"]
+    out = pmap.lookup_batch(keys)
+    expect = np.array([forward.get(k, -1) for k in keys], np.int32)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    import photon_ml_tpu.io.paldb as paldb
+
+    with pytest.raises(OSError):
+        # same key twice via the raw builder path
+        lib = paldb._lib()
+        import ctypes
+
+        blob = b"aa" + b"aa"
+        offsets = np.array([0, 2], np.uint64)
+        lens = np.array([2, 2], np.uint32)
+        indices = np.array([0, 1], np.int32)
+        rc = lib.fis_build(
+            blob,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_uint64(2),
+            str(tmp_path / "dup.store").encode(),
+        )
+        if rc != 0:
+            raise OSError(-rc, "duplicate")
+
+
+def test_load_index_map_sniffs_backend(store, tmp_path):
+    forward, path = store
+    assert isinstance(load_index_map(path), PersistentIndexMap)
+    jmap = IndexMap({"a": 0, "b": 1})
+    jpath = str(tmp_path / "map.json")
+    jmap.save(jpath)
+    loaded = load_index_map(jpath)
+    assert isinstance(loaded, IndexMap)
+    assert loaded.index_of("b") == 1
+
+
+def test_empty_store(tmp_path):
+    path = str(tmp_path / "empty.store")
+    build_store({}, path)
+    pmap = PersistentIndexMap(path)
+    assert pmap.size == 0
+    assert pmap.intercept_index == -1
+    assert pmap.index_of("anything") is None
+    assert dict(pmap.items()) == {}
+
+
+def test_indexing_driver_paldb_format(tmp_path, rng):
+    from photon_ml_tpu.cli.feature_indexing_driver import main as index_main
+    from photon_ml_tpu.io.data_reader import (
+        feature_tuples_from_dense,
+        write_training_examples,
+    )
+
+    X = rng.normal(size=(20, 4))
+    y = (rng.random(20) < 0.5).astype(float)
+    write_training_examples(
+        str(tmp_path / "d.avro"), feature_tuples_from_dense(X), y
+    )
+    out = str(tmp_path / "index.store")
+    rc = index_main(["--data", str(tmp_path / "d.avro"),
+                     "--output", out, "--store-format", "paldb"])
+    assert rc == 0
+    pmap = load_index_map(out)
+    assert isinstance(pmap, PersistentIndexMap)
+    assert pmap.size == 5  # 4 features + intercept
+    assert pmap.intercept_index >= 0
