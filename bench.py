@@ -28,6 +28,15 @@ import numpy as np
 
 def main() -> None:
     import jax
+
+    # The axon sitecustomize force-sets jax_platforms=axon,cpu at interpreter
+    # startup, overriding the JAX_PLATFORMS env var; honor the env var again
+    # so CPU runs don't try to initialize the TPU tunnel.
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
     import jax.numpy as jnp
 
     from photon_ml_tpu.ops.objective import make_objective
@@ -45,18 +54,29 @@ def main() -> None:
         n_rows, dim, iters = 1 << 21, 1 << 18, 20
     k = 39
 
-    rng = np.random.default_rng(0)
-    indices = rng.integers(0, dim, size=(n_rows, k), dtype=np.int32)
-    values = np.ones((n_rows, k), np.float32)
-    w_true = rng.normal(size=(dim,)).astype(np.float32) * 0.5
-    logits = w_true[indices].sum(axis=1)
-    labels = (rng.random(n_rows) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
+    # Synthesize the dataset ON DEVICE: the axon tunnel to the TPU wedges on
+    # bulk host->device transfers, and a transfer would time the pipe, not
+    # the hot loop. jit'd jax.random keeps everything in HBM.
+    @jax.jit
+    def make_data(key):
+        k_idx, k_w, k_lab = jax.random.split(key, 3)
+        indices = jax.random.randint(k_idx, (n_rows, k), 0, dim, jnp.int32)
+        values = jnp.ones((n_rows, k), jnp.float32)
+        w_true = jax.random.normal(k_w, (dim,), jnp.float32) * 0.5
+        logits = jnp.sum(w_true[indices], axis=1)
+        labels = (jax.random.uniform(k_lab, (n_rows,))
+                  < jax.nn.sigmoid(logits)).astype(jnp.float32)
+        return indices, values, labels
+
+    indices, values, labels = jax.block_until_ready(
+        make_data(jax.random.key(0))
+    )
 
     mesh = make_mesh()
     obj = make_objective("logistic")
     batch = LabeledBatch(
-        SparseFeatures(jnp.asarray(indices), jnp.asarray(values), dim=dim),
-        jnp.asarray(labels),
+        SparseFeatures(indices, values, dim=dim),
+        labels,
         jnp.zeros((n_rows,), jnp.float32),
         jnp.ones((n_rows,), jnp.float32),
     )
