@@ -56,7 +56,7 @@ from photon_ml_tpu.ops.statistics import summarize_features
 from photon_ml_tpu.optimize import OptimizerConfig
 from photon_ml_tpu.parallel.data_parallel import fit_distributed
 from photon_ml_tpu.parallel.mesh import make_mesh
-from photon_ml_tpu.types import SparseFeatures, make_batch
+from photon_ml_tpu.types import LabeledBatch, SparseFeatures, make_batch
 from photon_ml_tpu.utils import PhotonLogger, Timed, resolve_dtype
 
 
@@ -93,6 +93,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-variances", action="store_true",
                    help="diagonal-inverse-Hessian coefficient variances")
     p.add_argument("--summarize-features", action="store_true")
+    p.add_argument("--streaming", action="store_true",
+                   help="larger-than-HBM mode: keep the training set in host "
+                        "RAM and stream fixed-shape chunks through the "
+                        "device each optimizer pass")
+    p.add_argument("--chunk-rows", type=int, default=1 << 16,
+                   help="rows per streamed chunk (--streaming)")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
     return p
 
@@ -203,10 +209,26 @@ def main(argv: Sequence[str] | None = None) -> int:
                                        task=task)
 
     # -- stage: summarize + normalization ------------------------------------
-    feats = SparseFeatures(jnp.asarray(host_feats.indices),
-                           jnp.asarray(host_feats.values, dtype),
-                           dim=host_feats.dim)
-    batch = make_batch(feats, labels, offsets, weights, dtype=dtype)
+    streaming = args.streaming
+    if streaming and reg.needs_owlqn:
+        raise SystemExit("--streaming supports smooth objectives only "
+                         "(L-BFGS); L1/elastic_net needs the in-memory "
+                         "OWL-QN path")
+    dim = host_feats.dim
+    if streaming:
+        from photon_ml_tpu.parallel.streaming import make_host_chunks
+
+        # training set stays in host RAM; only fixed-shape chunks ever
+        # touch the device
+        chunks, _ = make_host_chunks(host_feats, labels, offsets, weights,
+                                     chunk_rows=args.chunk_rows)
+        batch = LabeledBatch(host_feats, labels, offsets, weights)
+        feats = None
+    else:
+        feats = SparseFeatures(jnp.asarray(host_feats.indices),
+                               jnp.asarray(host_feats.values, dtype),
+                               dim=dim)
+        batch = make_batch(feats, labels, offsets, weights, dtype=dtype)
     validation_batch = None
     if validation is not None:
         vfeats = SparseFeatures(jnp.asarray(vhost.indices),
@@ -240,14 +262,22 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     # -- stage: train over the lambda grid with warm start -------------------
     results = []
-    w = jnp.zeros((feats.dim,), dtype)
+    w = jnp.zeros((dim,), dtype)
     with Timed(logger, "training"):
         for lam in args.reg_weights:
-            res = fit_distributed(
-                objective, batch, mesh, w,
-                l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
-                optimizer=optimizer, config=opt_config,
-            )
+            if streaming:
+                from photon_ml_tpu.parallel.streaming import fit_streaming
+
+                res = fit_streaming(
+                    objective, chunks, dim, w0=w, l2=reg.l2_weight(lam),
+                    config=opt_config, dtype=dtype,
+                )
+            else:
+                res = fit_distributed(
+                    objective, batch, mesh, w,
+                    l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
+                    optimizer=optimizer, config=opt_config,
+                )
             w = res.w  # warm start the next lambda
             diag = {
                 "reg_weight": lam,
@@ -270,9 +300,19 @@ def main(argv: Sequence[str] | None = None) -> int:
                 diag["metrics"] = metrics
             variances = None
             if args.compute_variances:
-                variances = objective.coefficient_variances(
-                    res.w, batch, reg.l2_weight(lam)
-                )
+                if streaming:
+                    from photon_ml_tpu.parallel.streaming import (
+                        streaming_coefficient_variances,
+                    )
+
+                    variances = streaming_coefficient_variances(
+                        objective, chunks, dim, res.w,
+                        l2=reg.l2_weight(lam), dtype=dtype,
+                    )
+                else:
+                    variances = objective.coefficient_variances(
+                        res.w, batch, reg.l2_weight(lam)
+                    )
             results.append((lam, res, metrics, variances))
             logger.log("lambda_trained", **diag)
 

@@ -13,7 +13,7 @@ import dataclasses
 
 import numpy as np
 
-from photon_ml_tpu.types import LabeledBatch, SparseFeatures, feature_dim
+from photon_ml_tpu.types import LabeledBatch
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,9 +41,11 @@ def summarize_features(batch: LabeledBatch) -> FeatureSummary:
     Summarization is a one-shot preprocessing stage (a dedicated job in the
     reference — SURVEY.md §4.1), so host-side f64 is the right trade."""
     feats = batch.features
-    d = feature_dim(feats)
     n = batch.num_examples
-    if isinstance(feats, SparseFeatures):
+    # duck-typed so host-resident HostSparse (the streaming path, which never
+    # moves the training set to device) summarizes identically
+    if hasattr(feats, "indices"):
+        d = feats.dim
         flat_idx = np.asarray(feats.indices).reshape(-1)
         flat_val = np.asarray(feats.values, np.float64).reshape(-1)
         present = flat_val != 0.0
@@ -66,6 +68,7 @@ def summarize_features(batch: LabeledBatch) -> FeatureSummary:
         mn = np.where(np.isfinite(mn), mn, 0.0)
     else:
         X = np.asarray(feats, np.float64)
+        d = X.shape[1]
         s1 = X.sum(axis=0)
         s2 = (X**2).sum(axis=0)
         nnz = (X != 0.0).sum(axis=0).astype(np.float64)

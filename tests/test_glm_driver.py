@@ -96,6 +96,39 @@ def test_glm_driver_avro_elastic_net(tmp_path, logistic_data):
     assert trained[0]["metrics"]["auc"] > 0.6
 
 
+def test_glm_driver_streaming_matches_in_memory(tmp_path, logistic_data):
+    X, y = logistic_data
+    _write_libsvm(tmp_path / "train.svm", X[:300], y[:300])
+    _write_libsvm(tmp_path / "val.svm", X[300:], y[300:])
+    common = [
+        "--train-data", str(tmp_path / "train.svm"),
+        "--validation-data", str(tmp_path / "val.svm"),
+        "--input-format", "libsvm",
+        "--reg-weights", "1.0",
+        "--normalization", "standardization",
+        "--compute-variances",
+        "--dtype", "float64",
+    ]
+    assert glm_main(common + ["--output-dir", str(tmp_path / "mem")]) == 0
+    assert glm_main(common + ["--output-dir", str(tmp_path / "str"),
+                              "--streaming", "--chunk-rows", "64"]) == 0
+
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    w_mem = np.asarray(
+        load_game_model(str(tmp_path / "mem" / "best"))["global"]
+        .model.coefficients.means
+    )
+    best = load_game_model(str(tmp_path / "str" / "best"))["global"].model
+    w_str = np.asarray(best.coefficients.means)
+    np.testing.assert_allclose(w_str, w_mem, rtol=1e-4, atol=1e-6)
+    assert best.coefficients.variances is not None
+    log = [json.loads(l)
+           for l in (tmp_path / "str" / "photon.log.jsonl").read_text().splitlines()]
+    auc_str = [r for r in log if r["event"] == "lambda_trained"][0]["metrics"]["auc"]
+    assert auc_str > 0.6
+
+
 def test_glm_driver_validation_rejects_bad_labels(tmp_path, logistic_data):
     X, y = logistic_data
     y_bad = y.copy()

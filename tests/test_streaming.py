@@ -1,0 +1,113 @@
+"""Streaming (larger-than-HBM) fit: chunked full-pass gradients must match
+the in-memory objective exactly, and the streamed L-BFGS must reach the same
+optimum as the in-memory jitted fit (SURVEY.md §4.2's one-pass-per-iteration
+cost model)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game.data import HostSparse
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.parallel.data_parallel import fit_distributed
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.parallel.streaming import (
+    fit_streaming,
+    make_host_chunks,
+    streaming_value_and_grad,
+)
+from photon_ml_tpu.types import make_batch, sparse_from_scipy
+
+
+@pytest.fixture
+def sparse_problem(rng):
+    import scipy.sparse as sp
+
+    n, d = 700, 40
+    X = sp.random(n, d, density=0.2, random_state=7, format="csr")
+    w_true = rng.normal(size=d)
+    margins = np.asarray(X @ w_true)
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(float)
+    weights = rng.uniform(0.5, 2.0, size=n)
+    offsets = rng.normal(size=n) * 0.1
+    return X, y, offsets, weights
+
+
+def test_streamed_pass_matches_in_memory(sparse_problem, rng):
+    X, y, offsets, weights = sparse_problem
+    feats = sparse_from_scipy(X, dtype=jnp.float64)
+    batch = make_batch(feats, y, offsets, weights, dtype=jnp.float64)
+    obj = make_objective("logistic")
+
+    chunks, dim = make_host_chunks(
+        HostSparse(np.asarray(feats.indices), np.asarray(feats.values),
+                   feats.dim),
+        y, offsets, weights, chunk_rows=128,
+    )
+    assert len(chunks) == 6  # 700 rows -> 6 chunks of 128 (last padded)
+    fg = streaming_value_and_grad(obj, chunks, dim, dtype=jnp.float64)
+
+    w = jnp.asarray(rng.normal(size=dim))
+    f_stream, g_stream = fg(w, 0.3)
+    f_mem, g_mem = obj.value_and_grad(w, batch, 0.3)
+    np.testing.assert_allclose(float(f_stream), float(f_mem), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(g_stream), np.asarray(g_mem),
+                               rtol=1e-10, atol=1e-12)
+
+
+def test_fit_streaming_matches_fit_distributed(sparse_problem):
+    X, y, offsets, weights = sparse_problem
+    feats = sparse_from_scipy(X, dtype=jnp.float64)
+    batch = make_batch(feats, y, offsets, weights, dtype=jnp.float64)
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=200, tolerance=1e-12)
+
+    mem = fit_distributed(obj, batch, make_mesh(), jnp.zeros(feats.dim),
+                          l2=0.5, config=cfg)
+    chunks, dim = make_host_chunks(
+        HostSparse(np.asarray(feats.indices), np.asarray(feats.values),
+                   feats.dim),
+        y, offsets, weights, chunk_rows=256,
+    )
+    stream = fit_streaming(obj, chunks, dim, l2=0.5, config=cfg,
+                           dtype=jnp.float64)
+    assert bool(stream.converged)
+    # same optimum: compare objective values and coefficients
+    np.testing.assert_allclose(float(stream.value), float(mem.value),
+                               rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(stream.w), np.asarray(mem.w),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_fit_streaming_sharded_over_mesh(sparse_problem):
+    X, y, offsets, weights = sparse_problem
+    feats = sparse_from_scipy(X, dtype=jnp.float64)
+    obj = make_objective("logistic")
+    chunks, dim = make_host_chunks(
+        HostSparse(np.asarray(feats.indices), np.asarray(feats.values),
+                   feats.dim),
+        y, offsets, weights, chunk_rows=256,  # 256 % 8 devices == 0
+    )
+    mesh = make_mesh()
+    res = fit_streaming(obj, chunks, dim, l2=0.5,
+                        config=OptimizerConfig(max_iters=100),
+                        dtype=jnp.float64, mesh=mesh)
+    assert bool(res.converged)
+    res_plain = fit_streaming(obj, chunks, dim, l2=0.5,
+                              config=OptimizerConfig(max_iters=100),
+                              dtype=jnp.float64)
+    np.testing.assert_allclose(np.asarray(res.w), np.asarray(res_plain.w),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_make_host_chunks_dense_and_padding():
+    X = np.arange(12.0).reshape(6, 2)
+    y = np.arange(6.0)
+    chunks, dim = make_host_chunks(X, y, chunk_rows=4, pad_nnz=5)
+    assert dim == 2
+    assert len(chunks) == 2
+    assert chunks[0].indices.shape == (4, 5)
+    # padding rows carry zero weight so they contribute nothing
+    assert chunks[1].weights.tolist() == [1.0, 1.0, 0.0, 0.0]
+    np.testing.assert_array_equal(chunks[1].labels[2:], 0.0)
