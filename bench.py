@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -84,16 +85,32 @@ def main() -> None:
     # tolerance=0 pins the iteration count so the metric is deterministic
     cfg = OptimizerConfig(max_iters=iters, tolerance=0.0)
 
-    def run():
+    def run(sparse_grad, n_iters):
         res = fit_distributed(
-            obj, batch, mesh, w0, l2=1.0, optimizer="lbfgs", config=cfg
+            obj, batch, mesh, w0, l2=1.0, optimizer="lbfgs",
+            config=OptimizerConfig(max_iters=n_iters, tolerance=0.0),
+            sparse_grad=sparse_grad,
         )
         jax.block_until_ready(res.w)
         return res
 
-    run()  # compile + warm-up
+    # Two sparse-gradient strategies exist (scatter-add vs scatter-free CSC
+    # prefix sums — types.CSCTranspose); which wins is hardware-dependent, so
+    # calibrate with short fits unless pinned via BENCH_SPARSE_GRAD.
+    mode = os.environ.get("BENCH_SPARSE_GRAD", "auto")
+    if mode == "auto":
+        times = {}
+        for m in ("scatter", "csc"):
+            run(m, 3)  # compile + warm-up
+            t0 = time.perf_counter()
+            run(m, 3)
+            times[m] = time.perf_counter() - t0
+        mode = min(times, key=times.get)
+        print(f"calibration: {times} -> {mode}", file=sys.stderr)
+
+    run(mode, iters)  # compile + warm-up
     t0 = time.perf_counter()
-    res = run()
+    res = run(mode, iters)
     elapsed = time.perf_counter() - t0
 
     done = int(res.iterations)
@@ -102,7 +119,8 @@ def main() -> None:
         "metric": "criteo_shaped_logreg_lbfgs_example_passes_per_sec",
         "value": round(value, 1),
         "unit": f"example-passes/sec ({platform}, {len(jax.devices())} dev, "
-                f"n={n_rows}, d={dim}, k={k}, iters={done})",
+                f"n={n_rows}, d={dim}, k={k}, iters={done}, "
+                f"sparse_grad={mode})",
         "vs_baseline": 1.0,
     }))
 

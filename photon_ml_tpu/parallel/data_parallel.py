@@ -24,7 +24,13 @@ from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
 from photon_ml_tpu.optimize.common import OptimizationResult
 from photon_ml_tpu.parallel.mesh import shard_batch
-from photon_ml_tpu.types import LabeledBatch
+from photon_ml_tpu.types import (
+    LabeledBatch,
+    SparseFeatures,
+    build_csc_transpose,
+    csc_transpose_apply,
+    margins as ell_margins,
+)
 
 
 def distributed_value_and_grad(
@@ -84,6 +90,84 @@ def distributed_hvp(objective: GLMObjective, mesh: Mesh, axis: str = "data") -> 
     return hvp
 
 
+def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data"):
+    """Scatter-free sparse gradient path (see ``types.CSCTranspose``).
+
+    Returns (build, fg, hvp): ``build(batch)`` sorts each shard's nonzeros by
+    column under ``shard_map`` (runs on device, once per jitted fit);
+    ``fg(w, batch, csc, l2)`` / ``hvp(w, v, batch, csc, l2)`` evaluate the
+    objective with explicit margin-space derivatives — forward is the ELL
+    gather, backward is the CSC prefix-sum, reductions are explicit psums.
+    Requires SparseFeatures and no normalization context (the normalized
+    chain rule still routes through the autodiff/scatter path)."""
+    if objective.normalization is not None:
+        raise ValueError("CSC sparse-gradient path does not support "
+                         "normalization contexts; use sparse_grad='scatter'")
+    def build(batch: LabeledBatch):
+        feats = batch.features
+        if not isinstance(feats, SparseFeatures):
+            raise ValueError("CSC path needs SparseFeatures")
+        dim = feats.dim
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P(axis)),
+        )
+        def _build(indices, values):
+            csc = build_csc_transpose(indices, values, dim)
+            # lead with a shard axis so P(axis) concatenation keeps each
+            # shard's arrays intact ([n_shards, ...] overall)
+            return (csc.values[None], csc.rows[None], csc.col_starts[None])
+
+        return _build(feats.indices, feats.values)
+
+    def _margin_value_and_d(w, batch):
+        m = ell_margins(batch.features, w) + batch.offsets
+        per_ex = lambda m: jnp.sum(batch.weights * objective.loss.loss(m, batch.labels))
+        f, d = jax.value_and_grad(per_ex)(m)
+        return f, d
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P()),
+    )
+    def shard_fg(w, batch, t_values, t_rows, t_col_starts):
+        from photon_ml_tpu.types import CSCTranspose
+
+        f, d = _margin_value_and_d(w, batch)
+        csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
+        g = csc_transpose_apply(csc, d)
+        return lax.psum(f, axis), lax.psum(g, axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(),
+    )
+    def shard_hvp(w, v, batch, t_values, t_rows, t_col_starts):
+        from photon_ml_tpu.types import CSCTranspose
+
+        m = ell_margins(batch.features, w) + batch.offsets
+        mv = ell_margins(batch.features, v)  # directional margin, no offset
+        d2 = batch.weights * objective.loss.d2(m, batch.labels)
+        csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
+        return lax.psum(csc_transpose_apply(csc, d2 * mv), axis)
+
+    def fg(w, batch, csc, l2=0.0):
+        l2 = jnp.asarray(l2, w.dtype)
+        f, g = shard_fg(w, batch, *csc)
+        wr = objective._reg_mask(w)
+        return f + 0.5 * l2 * jnp.sum(wr * wr), g + l2 * wr
+
+    def hvp(w, v, batch, csc, l2=0.0):
+        l2 = jnp.asarray(l2, w.dtype)
+        hv = shard_hvp(w, v, batch, *csc)
+        return hv + l2 * objective._reg_mask(v)
+
+    return build, fg, hvp
+
+
 def fit_distributed(
     objective: GLMObjective,
     batch: LabeledBatch,
@@ -94,9 +178,19 @@ def fit_distributed(
     optimizer: str = "lbfgs",
     config: OptimizerConfig = OptimizerConfig(),
     axis: str = "data",
+    sparse_grad: str = "scatter",
 ) -> OptimizationResult:
     """Shard the batch over the mesh and run a full jitted fit — the
-    ``DistributedOptimizationProblem.run`` equivalent (SURVEY.md §3.2)."""
+    ``DistributedOptimizationProblem.run`` equivalent (SURVEY.md §3.2).
+
+    ``sparse_grad``: "scatter" (XLA scatter-add via autodiff transpose) or
+    "csc" (scatter-free column-sorted gradients — see ``make_csc_path``;
+    sorts once per fit on device, best for many-iteration sparse fits on
+    TPU)."""
+    if sparse_grad == "csc":
+        return _fit_distributed_csc(
+            objective, batch, mesh, w0, l2, l1, optimizer, config, axis
+        )
     batch = shard_batch(batch, mesh, axis)
     fg = distributed_value_and_grad(objective, mesh, axis)
     opt = get_optimizer(optimizer)
@@ -122,4 +216,44 @@ def fit_distributed(
         )
         return run(w0, batch, l2)
     run = jax.jit(lambda w0, b, l2v: opt(lambda w: fg(w, b, l2v), w0, config))
+    return run(w0, batch, l2)
+
+
+def _fit_distributed_csc(
+    objective, batch, mesh, w0, l2, l1, optimizer, config, axis
+) -> OptimizationResult:
+    """CSC-path fit: ONE jitted program that sorts the shard nonzeros by
+    column, then runs the whole optimizer loop against the sorted view —
+    sort cost amortizes over every iteration."""
+    batch = shard_batch(batch, mesh, axis)
+    build, fg, hvp = make_csc_path(objective, mesh, axis)
+    opt = get_optimizer(optimizer)
+
+    if optimizer == "owlqn":
+        l1_mask = None
+        if objective.intercept_index >= 0 and not objective.regularize_intercept:
+            l1_mask = jnp.ones_like(w0).at[objective.intercept_index].set(0.0)
+
+        @jax.jit
+        def run(w0, b, l2v, l1v):
+            csc = build(b)
+            return opt(lambda w: fg(w, b, csc, l2v), w0, l1v, config,
+                       l1_mask=l1_mask)
+
+        return run(w0, batch, l2, l1)
+    if optimizer == "tron":
+
+        @jax.jit
+        def run(w0, b, l2v):
+            csc = build(b)
+            return opt(lambda w: fg(w, b, csc, l2v), w0, config,
+                       hvp=lambda w, v: hvp(w, v, b, csc, l2v))
+
+        return run(w0, batch, l2)
+
+    @jax.jit
+    def run(w0, b, l2v):
+        csc = build(b)
+        return opt(lambda w: fg(w, b, csc, l2v), w0, config)
+
     return run(w0, batch, l2)
