@@ -90,6 +90,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="coordinates whose reg weights are tuned (default: all "
                         "unlocked)")
     p.add_argument("--tuning-seed", type=int, default=0)
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a JAX profiler trace of training here "
+                        "(view in TensorBoard/Perfetto)")
     return p
 
 
@@ -249,7 +252,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         for rec in result.history:
             logger.log("cd_iteration", config=gi, **rec)
 
-    with Timed(logger, "training"):
+    from photon_ml_tpu.utils import profile_trace
+
+    with Timed(logger, "training"), profile_trace(args.profile_dir):
         results = estimator.fit(
             train, validation, config_grid=grid, warm_start=warm,
             locked=args.locked_coordinates, checkpoint_callback=ckpt,

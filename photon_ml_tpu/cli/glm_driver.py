@@ -100,6 +100,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--chunk-rows", type=int, default=1 << 16,
                    help="rows per streamed chunk (--streaming)")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a JAX profiler trace of training here")
     return p
 
 
@@ -263,7 +265,9 @@ def main(argv: Sequence[str] | None = None) -> int:
     # -- stage: train over the lambda grid with warm start -------------------
     results = []
     w = jnp.zeros((dim,), dtype)
-    with Timed(logger, "training"):
+    from photon_ml_tpu.utils import profile_trace
+
+    with Timed(logger, "training"), profile_trace(args.profile_dir):
         for lam in args.reg_weights:
             if streaming:
                 from photon_ml_tpu.parallel.streaming import fit_streaming

@@ -1,0 +1,163 @@
+"""Test scaffolding: synthetic datasets and fixtures.
+
+Equivalent of the reference's ``photon-test-utils`` module
+(``SparkTestUtils``/``GameTestUtils``/``CommonTestUtils`` — SURVEY.md §3.5;
+reference mount empty, paths unverified). The local-mode-Spark role is played
+by the virtual CPU device mesh (``tests/conftest.py`` sets
+``--xla_force_host_platform_device_count``); this module supplies the
+deterministic synthetic data generators: plain GLM problems, mixed-effect
+(GAME) datasets with known fixed/random-effect structure, and Avro fixture
+writers for driver-level integration tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticGLM:
+    X: np.ndarray  # [n, d] dense
+    y: np.ndarray  # [n]
+    w_true: np.ndarray  # [d]
+    offsets: np.ndarray
+    weights: np.ndarray
+
+
+def synthetic_glm_data(
+    n: int = 500,
+    d: int = 10,
+    task: str = "logistic",
+    seed: int = 0,
+    density: float = 1.0,
+    with_offsets: bool = False,
+    with_weights: bool = False,
+) -> SyntheticGLM:
+    """A well-specified GLM problem with known coefficients."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    if density < 1.0:
+        X *= rng.random((n, d)) < density
+    w = rng.normal(size=d)
+    offsets = rng.normal(size=n) * 0.1 if with_offsets else np.zeros(n)
+    weights = rng.uniform(0.5, 2.0, size=n) if with_weights else np.ones(n)
+    m = X @ w + offsets
+    if task == "logistic" or task == "smoothed_hinge":
+        y = (rng.random(n) < 1.0 / (1.0 + np.exp(-m))).astype(float)
+    elif task == "poisson":
+        y = rng.poisson(np.exp(np.clip(m, None, 5.0))).astype(float)
+    else:  # squared / linear
+        y = m + rng.normal(size=n) * 0.1
+    return SyntheticGLM(X, y, w, offsets, weights)
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticGame:
+    """Mixed-effect data with known structure: global fixed effect plus one
+    coefficient vector per entity per random effect."""
+
+    features: Dict[str, np.ndarray]  # shard -> [n, d_shard]
+    labels: np.ndarray
+    entity_ids: Dict[str, np.ndarray]  # column -> [n]
+    w_fixed: np.ndarray
+    random_effects: Dict[str, np.ndarray]  # column -> [n_entities, d_shard]
+
+
+def synthetic_game_data(
+    n_entities: Dict[str, int] = None,
+    d_fixed: int = 6,
+    d_random: int = 3,
+    rows_per_entity: Tuple[int, int] = (15, 45),
+    task: str = "logistic",
+    seed: int = 0,
+) -> SyntheticGame:
+    """Generate GAME data: every row belongs to one entity per random-effect
+    column; margins sum the fixed effect and each entity's effect (the model
+    ``CoordinateDescent`` should recover — SURVEY.md §4.1)."""
+    if n_entities is None:
+        n_entities = {"userId": 20}
+    rng = np.random.default_rng(seed)
+    w_fixed = rng.normal(size=d_fixed)
+    effects = {
+        col: rng.normal(size=(count, d_random)) * 1.5
+        for col, count in n_entities.items()
+    }
+    # rows are grouped by the FIRST entity column; other columns get random
+    # entity assignments (crossed random effects)
+    first = next(iter(n_entities))
+    Xg_parts, Xr_parts, y_parts, ids = [], [], [], {c: [] for c in n_entities}
+    for e in range(n_entities[first]):
+        m_rows = int(rng.integers(*rows_per_entity))
+        xg = rng.normal(size=(m_rows, d_fixed))
+        xr = rng.normal(size=(m_rows, d_random))
+        margin = xg @ w_fixed + xr @ effects[first][e]
+        ids[first].append(np.full(m_rows, e))
+        for col in list(n_entities)[1:]:
+            assign = rng.integers(0, n_entities[col], size=m_rows)
+            ids[col].append(assign)
+            margin = margin + np.sum(xr * effects[col][assign], axis=1)
+        if task == "logistic":
+            y = (rng.random(m_rows) < 1 / (1 + np.exp(-margin))).astype(float)
+        else:
+            y = margin + rng.normal(size=m_rows) * 0.1
+        Xg_parts.append(xg)
+        Xr_parts.append(xr)
+        y_parts.append(y)
+    features = {
+        "global": np.concatenate(Xg_parts),
+        "entity": np.concatenate(Xr_parts),
+    }
+    return SyntheticGame(
+        features=features,
+        labels=np.concatenate(y_parts),
+        entity_ids={c: np.concatenate(v) for c, v in ids.items()},
+        w_fixed=w_fixed,
+        random_effects=effects,
+    )
+
+
+def game_dataset_from_synthetic(data: SyntheticGame, share_features: bool = False):
+    """Build a GameDataset (both shards, entity ids) from synthetic data.
+    ``share_features=True`` exposes only the 'global' shard (fixed-effect-
+    only tests)."""
+    from photon_ml_tpu.game.descent import make_game_dataset
+
+    feats = ({"global": data.features["global"]} if share_features
+             else dict(data.features))
+    return make_game_dataset(feats, labels=data.labels,
+                             entity_ids=dict(data.entity_ids))
+
+
+def write_game_avro_fixture(
+    path: str,
+    data: SyntheticGame,
+    rows: Optional[np.ndarray] = None,
+    feature_prefixes: Dict[str, str] = None,
+) -> None:
+    """Write synthetic GAME rows as TrainingExampleAvro for driver tests.
+    Feature names are ``<prefix><j>`` per shard (prefix defaults: 'g' for
+    global, 'u' for entity), so shard configs can select by prefix."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    if feature_prefixes is None:
+        feature_prefixes = {"global": "g", "entity": "u"}
+    if rows is None:
+        rows = np.arange(len(data.labels))
+
+    def tuples():
+        for i in rows:
+            row = []
+            for shard, prefix in feature_prefixes.items():
+                X = data.features[shard]
+                row += [(f"{prefix}{j}", "", float(X[i, j]))
+                        for j in range(X.shape[1])]
+            yield row
+
+    write_training_examples(
+        path, tuples(), data.labels[rows],
+        entity_ids={c: v[rows] for c, v in data.entity_ids.items()},
+        uids=[str(i) for i in rows],
+    )

@@ -1,0 +1,38 @@
+"""Profiling / tracing hooks.
+
+The reference has no real tracer — driver stages are wall-clock timed and
+``OptimizationStatesTracker`` records per-iteration optimizer state, with
+Spark's UI covering task-level profiling (SURVEY.md §5.1). The TPU-native
+rebuild keeps the stage timers (``utils.logging.Timed``) and optimizer
+histories (``OptimizationResult.loss_history``), and adds the JAX profiler
+for device-level traces: pass ``--profile-dir`` to a driver (or use
+``profile_trace``) and load the result in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a JAX profiler trace into ``trace_dir`` (no-op when None)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-span inside an active trace (jax.profiler.TraceAnnotation);
+    usable as a context manager."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
