@@ -100,11 +100,14 @@ def main() -> None:
     mode = os.environ.get("BENCH_SPARSE_GRAD", "auto")
     if mode == "auto":
         times = {}
-        for m in ("scatter", "csc"):
-            run(m, 3)  # compile + warm-up
-            t0 = time.perf_counter()
-            run(m, 3)
-            times[m] = time.perf_counter() - t0
+        for m in ("scatter", "csc", "csc_pallas"):
+            try:
+                run(m, 3)  # compile + warm-up
+                t0 = time.perf_counter()
+                run(m, 3)
+                times[m] = time.perf_counter() - t0
+            except Exception as e:  # a mode that fails to lower is skipped
+                print(f"calibration: {m} failed: {e}", file=sys.stderr)
         mode = min(times, key=times.get)
         print(f"calibration: {times} -> {mode}", file=sys.stderr)
 

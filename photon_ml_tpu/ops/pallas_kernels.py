@@ -1,0 +1,113 @@
+"""Pallas TPU kernels for the sparse-gradient hot path.
+
+The scatter-free CSC gradient (``types.CSCTranspose``) is bottlenecked by a
+length-nnz prefix sum: XLA lowers ``jnp.cumsum`` over tens of millions of
+elements to several log-tree passes over HBM. The kernel here streams the
+array once: a sequential 1-D grid over row tiles with a running carry in
+SMEM, computing each tile's inclusive scan as two small lower-triangular
+**matmuls on the MXU** (cumsum-as-matmul — the TPU-native scan idiom; no
+unsupported vector shifts or gathers), and fusing the
+``contrib = values * d_gathered`` multiply into the same pass so the
+contribution vector is never materialized in HBM.
+
+Why matmul: a [T, 128] tile's per-lane inclusive prefix is ``x @ L`` with
+``L[a, b] = 1 if a <= b``; the running offset across the tile's rows is a
+strict-lower-triangular matmul of the per-row totals. Both hit the MXU with
+static shapes.
+
+Falls back to interpret mode off-TPU (CPU tests run the same kernel code).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _mps_kernel(v_ref, d_ref, out_ref, carry_ref):
+    """One [T, 128] tile of the fused multiply + inclusive prefix sum."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        carry_ref[0, 0] = jnp.zeros((), v_ref.dtype)
+
+    x = v_ref[:] * d_ref[:]  # fused contribution product
+    rows = x.shape[0]
+    dtype = x.dtype
+
+    # match_vma: in interpret mode (CPU tests) the kernel body runs under
+    # shard_map's varying-axis tracking, where fresh iota constants are
+    # unvarying and may not meet varying data in a dot; on the compiled TPU
+    # path the kernel traces standalone and this is a no-op.
+    from photon_ml_tpu.optimize.common import match_vma
+
+    # inclusive prefix along lanes: x @ L, L[a, b] = (a <= b)
+    a = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 0)
+    b = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 1)
+    lane_cum = jnp.dot(x, match_vma((a <= b).astype(dtype), x),
+                       preferred_element_type=dtype)
+
+    # running offset across rows: strict lower-triangular matmul of row sums
+    row_tot = lane_cum[:, _LANES - 1:_LANES]  # [rows, 1]
+    ra = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0)
+    rb = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1)
+    row_excl = jnp.dot(match_vma((rb < ra).astype(dtype), x), row_tot,
+                       preferred_element_type=dtype)  # [rows, 1]
+
+    carry = carry_ref[0, 0]
+    out_ref[:] = lane_cum + row_excl + carry
+    carry_ref[0, 0] = carry + row_excl[rows - 1, 0] + row_tot[rows - 1, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def multiply_prefix_sum(
+    values: jax.Array,
+    d_sorted: jax.Array,
+    block_rows: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Inclusive prefix sum of ``values * d_sorted`` (both [nnz]) in one
+    streamed pass. ``interpret=None`` auto-selects interpret mode off-TPU."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    nnz = values.shape[0]
+    tile = block_rows * _LANES
+    padded = max(pl.cdiv(nnz, tile), 1) * tile
+    pad = padded - nnz
+    v = jnp.pad(values, (0, pad)).reshape(-1, _LANES)
+    d = jnp.pad(d_sorted, (0, pad)).reshape(-1, _LANES)
+
+    # under shard_map (manual mode) the output varies over the same mesh
+    # axes as the inputs; plumb the vma through or check_vma rejects the call
+    vma = frozenset(getattr(jax.typeof(v), "vma", frozenset()))
+    out_shape = (jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma) if vma
+                 else jax.ShapeDtypeStruct(v.shape, v.dtype))
+    out = pl.pallas_call(
+        _mps_kernel,
+        grid=(padded // tile,),
+        in_specs=[
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.SMEM((1, 1), v.dtype)],
+        interpret=interpret,
+    )(v, d)
+    return out.reshape(-1)[:nnz]
+
+
+def csc_transpose_apply_pallas(csc, d: jax.Array) -> jax.Array:
+    """``X^T d`` from the column-sorted view with the fused Pallas scan
+    (drop-in for ``types.csc_transpose_apply``)."""
+    prefix_incl = multiply_prefix_sum(csc.values, d[csc.rows])
+    prefix = jnp.concatenate([jnp.zeros((1,), prefix_incl.dtype), prefix_incl])
+    out = prefix[csc.col_starts[1:]] - prefix[csc.col_starts[:-1]]
+    return out.astype(d.dtype)

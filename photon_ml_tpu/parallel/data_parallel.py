@@ -90,7 +90,8 @@ def distributed_hvp(objective: GLMObjective, mesh: Mesh, axis: str = "data") -> 
     return hvp
 
 
-def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data"):
+def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
+                  use_pallas: bool = False):
     """Scatter-free sparse gradient path (see ``types.CSCTranspose``).
 
     Returns (build, fg, hvp): ``build(batch)`` sorts each shard's nonzeros by
@@ -103,6 +104,12 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data"):
     if objective.normalization is not None:
         raise ValueError("CSC sparse-gradient path does not support "
                          "normalization contexts; use sparse_grad='scatter'")
+    if use_pallas:
+        from photon_ml_tpu.ops.pallas_kernels import csc_transpose_apply_pallas
+
+        apply_t = csc_transpose_apply_pallas
+    else:
+        apply_t = csc_transpose_apply
     def build(batch: LabeledBatch):
         feats = batch.features
         if not isinstance(feats, SparseFeatures):
@@ -127,23 +134,28 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data"):
         f, d = jax.value_and_grad(per_ex)(m)
         return f, d
 
+    # check_vma is disabled on the pallas variant: the interpret-mode kernel
+    # body can't thread varying-axis types through pallas_call (reductions
+    # here are explicit psums, so nothing relies on vma-driven transposes)
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(), P()),
+        check_vma=not use_pallas,
     )
     def shard_fg(w, batch, t_values, t_rows, t_col_starts):
         from photon_ml_tpu.types import CSCTranspose
 
         f, d = _margin_value_and_d(w, batch)
         csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
-        g = csc_transpose_apply(csc, d)
+        g = apply_t(csc, d)
         return lax.psum(f, axis), lax.psum(g, axis)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(),
+        check_vma=not use_pallas,
     )
     def shard_hvp(w, v, batch, t_values, t_rows, t_col_starts):
         from photon_ml_tpu.types import CSCTranspose
@@ -152,7 +164,7 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data"):
         mv = ell_margins(batch.features, v)  # directional margin, no offset
         d2 = batch.weights * objective.loss.d2(m, batch.labels)
         csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
-        return lax.psum(csc_transpose_apply(csc, d2 * mv), axis)
+        return lax.psum(apply_t(csc, d2 * mv), axis)
 
     def fg(w, batch, csc, l2=0.0):
         l2 = jnp.asarray(l2, w.dtype)
@@ -187,9 +199,10 @@ def fit_distributed(
     "csc" (scatter-free column-sorted gradients — see ``make_csc_path``;
     sorts once per fit on device, best for many-iteration sparse fits on
     TPU)."""
-    if sparse_grad == "csc":
+    if sparse_grad in ("csc", "csc_pallas"):
         return _fit_distributed_csc(
-            objective, batch, mesh, w0, l2, l1, optimizer, config, axis
+            objective, batch, mesh, w0, l2, l1, optimizer, config, axis,
+            use_pallas=(sparse_grad == "csc_pallas"),
         )
     batch = shard_batch(batch, mesh, axis)
     fg = distributed_value_and_grad(objective, mesh, axis)
@@ -220,13 +233,14 @@ def fit_distributed(
 
 
 def _fit_distributed_csc(
-    objective, batch, mesh, w0, l2, l1, optimizer, config, axis
+    objective, batch, mesh, w0, l2, l1, optimizer, config, axis,
+    use_pallas: bool = False,
 ) -> OptimizationResult:
     """CSC-path fit: ONE jitted program that sorts the shard nonzeros by
     column, then runs the whole optimizer loop against the sorted view —
     sort cost amortizes over every iteration."""
     batch = shard_batch(batch, mesh, axis)
-    build, fg, hvp = make_csc_path(objective, mesh, axis)
+    build, fg, hvp = make_csc_path(objective, mesh, axis, use_pallas=use_pallas)
     opt = get_optimizer(optimizer)
 
     if optimizer == "owlqn":
