@@ -59,7 +59,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--evaluators", nargs="*", default=None)
     p.add_argument("--n-iterations", type=int, default=1)
     p.add_argument("--index-map", default=None,
-                   help="prebuilt index map JSON (else built from data)")
+                   help="prebuilt index map (JSON, native store, or hashing "
+                        "config; else built from data)")
+    p.add_argument("--hash-dim", type=int, default=None,
+                   help="feature-hash into this width instead of building an "
+                        "index map (TB-scale path; collisions accepted)")
     p.add_argument("--feature-shards", default=None,
                    help="JSON (inline or path): shard name -> list of feature-"
                         "name prefixes (per-shard feature bags); shards not "
@@ -90,6 +94,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="coordinates whose reg weights are tuned (default: all "
                         "unlocked)")
     p.add_argument("--tuning-seed", type=int, default=0)
+    p.add_argument("--coordinator-address", default=None,
+                   help="multi-host: coordinator host:port for "
+                        "jax.distributed.initialize (every process runs this "
+                        "driver with the same args)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--profile-dir", default=None,
                    help="capture a JAX profiler trace of training here "
                         "(view in TensorBoard/Perfetto)")
@@ -136,11 +146,16 @@ def _read_dataset(paths, index_maps, entity_columns) -> GameDataset:
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    from photon_ml_tpu.parallel.multihost import initialize_multihost, runtime_info
+
+    distributed = initialize_multihost(args.coordinator_address,
+                                       args.num_processes, args.process_id)
     dtype = resolve_dtype(args.dtype)
     task = TASK_TO_LOSS.get(args.task, args.task)
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(os.path.join(args.output_dir, "photon.log.jsonl"))
-    logger.log("driver_start", driver="game_training", args=vars(args))
+    logger.log("driver_start", driver="game_training", args=vars(args),
+               distributed=distributed, **runtime_info())
 
     grid = _load_coordinate_grid(args.coordinates)
     shards = sorted({cfg.feature_shard for cfg in grid[0]})
@@ -170,7 +185,12 @@ def main(argv: Sequence[str] | None = None) -> int:
             raise SystemExit(f"--tuning-coordinates: {e}")
 
     with Timed(logger, "feature_indexing"):
-        if args.index_map:
+        if args.hash_dim:
+            from photon_ml_tpu.io.hashing import HashingIndexMap
+
+            base_map = HashingIndexMap(args.hash_dim,
+                                       add_intercept=args.add_intercept)
+        elif args.index_map:
             from photon_ml_tpu.io.paldb import load_index_map
 
             base_map = load_index_map(args.index_map)
@@ -186,6 +206,12 @@ def main(argv: Sequence[str] | None = None) -> int:
                 shard_defs = json.load(open(args.feature_shards))
             else:
                 shard_defs = json.loads(args.feature_shards)
+            if args.hash_dim and any(s in shard_defs for s in shards):
+                raise SystemExit(
+                    "--hash-dim cannot be combined with feature-shard prefix "
+                    "filtering (a hashing map has no enumerable features); "
+                    "give each shard its own driver run or drop --hash-dim"
+                )
         index_maps: Dict[str, IndexMap] = {}
         for s in shards:
             if s in shard_defs:

@@ -83,7 +83,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--add-intercept", action="store_true", default=True)
     p.add_argument("--no-intercept", dest="add_intercept", action="store_false")
     p.add_argument("--index-map", default=None,
-                   help="prebuilt index-map JSON (avro input only)")
+                   help="prebuilt index map (avro input only)")
+    p.add_argument("--hash-dim", type=int, default=None,
+                   help="feature-hash into this width instead of building an "
+                        "index map (avro input only)")
     p.add_argument("--min-feature-count", type=int, default=1)
     p.add_argument("--evaluators", nargs="*", default=None)
     p.add_argument("--validate-data", action="store_true", default=True,
@@ -100,6 +103,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--chunk-rows", type=int, default=1 << 16,
                    help="rows per streamed chunk (--streaming)")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    p.add_argument("--coordinator-address", default=None,
+                   help="multi-host: coordinator host:port for "
+                        "jax.distributed.initialize (every process runs this "
+                        "driver with the same args)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--profile-dir", default=None,
                    help="capture a JAX profiler trace of training here")
     return p
@@ -159,11 +168,16 @@ def _read(paths, fmt, index_map: Optional[IndexMap], add_intercept):
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    from photon_ml_tpu.parallel.multihost import initialize_multihost, runtime_info
+
+    distributed = initialize_multihost(args.coordinator_address,
+                                       args.num_processes, args.process_id)
     dtype = resolve_dtype(args.dtype)
     task = TASK_TO_LOSS.get(args.task, args.task)
     os.makedirs(args.output_dir, exist_ok=True)
     logger = PhotonLogger(os.path.join(args.output_dir, "photon.log.jsonl"))
-    logger.log("driver_start", driver="glm", args=vars(args))
+    logger.log("driver_start", driver="glm", args=vars(args),
+               distributed=distributed, **runtime_info())
 
     reg = RegularizationContext(args.reg_type, alpha=args.elastic_net_alpha)
     optimizer = args.optimizer
@@ -176,7 +190,12 @@ def main(argv: Sequence[str] | None = None) -> int:
     with Timed(logger, "read_train_data"):
         index_map = None
         if args.input_format == "avro":
-            if args.index_map:
+            if args.hash_dim:
+                from photon_ml_tpu.io.hashing import HashingIndexMap
+
+                index_map = HashingIndexMap(args.hash_dim,
+                                            add_intercept=args.add_intercept)
+            elif args.index_map:
                 from photon_ml_tpu.io.paldb import load_index_map
 
                 index_map = load_index_map(args.index_map)

@@ -35,7 +35,7 @@ def read_training_examples(
     entity_ids: dict column->np.ndarray, uids: list). Features absent from a
     shard's index map are dropped for that shard (per-shard feature
     selection, as in the reference's feature bags)."""
-    if isinstance(index_maps, IndexMap):
+    if not isinstance(index_maps, dict):  # any IndexMap-like backend
         index_maps = {"global": index_maps}
     rows_per_shard: Dict[str, List[List[Tuple[int, float]]]] = {
         s: [] for s in index_maps

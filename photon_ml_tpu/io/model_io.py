@@ -53,7 +53,7 @@ def save_game_model(
     directory: str,
     index_maps: IndexMap | Dict[str, IndexMap],
 ) -> None:
-    if isinstance(index_maps, IndexMap):
+    if not isinstance(index_maps, dict):  # any IndexMap-like backend
         index_maps = {"global": index_maps}
     os.makedirs(directory, exist_ok=True)
     meta = {"task": model.task, "coordinates": []}

@@ -184,9 +184,13 @@ def load_index_map(path: str):
     """Open either backend by sniffing the file: native store (magic bytes)
     or JSON. Drivers use this so --index-map takes either format."""
     with open(path, "rb") as f:
-        head = f.read(8)
+        head = f.read(16)
     if head[:1] != b"{":  # native store starts with its binary magic
         return PersistentIndexMap(path)
+    if b'"hashing"' in head:
+        from photon_ml_tpu.io.hashing import HashingIndexMap
+
+        return HashingIndexMap.load(path)
     from photon_ml_tpu.io.index_map import IndexMap
 
     return IndexMap.load(path)

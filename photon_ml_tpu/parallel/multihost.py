@@ -1,0 +1,71 @@
+"""Multi-host (multi-process) runtime initialization.
+
+The reference scales past one machine through Spark's cluster manager and
+netty shuffle service (SURVEY.md §5.8). The TPU-native equivalent is the JAX
+multi-controller runtime: every host runs the same program, calls
+``jax.distributed.initialize`` (coordinator rendezvous), and afterwards
+``jax.devices()`` spans every chip in the slice — the same ``shard_map`` /
+``psum`` programs used single-host then reduce over ICI within a host and
+DCN across hosts, with XLA picking the collective implementation. No
+NCCL/MPI port is needed or wanted.
+
+Drivers expose this via ``--coordinator-address`` (plus optional
+``--num-processes`` / ``--process-id``; on TPU pods those are inferred from
+the environment). Data loading composes with it: each process reads its own
+row range (``process_span``) and the global batch is formed by sharding over
+the full mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Rendezvous this process into the global runtime. Returns True if
+    distributed mode was initialized, False for the single-process no-op
+    (no coordinator given and no TPU pod environment to infer one from).
+
+    Must run before the first use of the jax backend."""
+    import jax
+
+    if coordinator_address is None and num_processes is None:
+        return False
+    if (num_processes is None) != (process_id is None):
+        raise ValueError("--num-processes and --process-id go together")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def process_span(total_rows: int) -> Tuple[int, int]:
+    """This process's [start, stop) slice of a globally-ordered dataset:
+    near-equal contiguous ranges per process (the reference's input-split
+    assignment)."""
+    import jax
+
+    p = jax.process_count()
+    i = jax.process_index()
+    base, extra = divmod(total_rows, p)
+    start = i * base + min(i, extra)
+    return start, start + base + (1 if i < extra else 0)
+
+
+def runtime_info() -> dict:
+    """Host/device topology for logs (PhotonLogger-friendly)."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }

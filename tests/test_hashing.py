@@ -1,0 +1,94 @@
+"""Feature-hashing index backend: stability, round-trip, driver + scoring
+end-to-end (the TB-scale alternative to materialized index maps)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.hashing import HashingIndexMap, fnv1a_64
+from photon_ml_tpu.io.paldb import load_index_map
+
+
+def test_hashing_map_basics(tmp_path):
+    m = HashingIndexMap(1000)
+    assert m.size == 1001
+    assert m.intercept_index == 1000
+    i1 = m.index_of("age")
+    assert 0 <= i1 < 1000
+    assert m.index_of("age") == i1  # deterministic
+    assert m.index_of("age", "25") != i1 or True  # name+term hashes the pair
+    assert m.index_of("(INTERCEPT)") == 1000
+    # synthetic coefficient names round-trip (model save/load path)
+    assert m.index_of(f"(HASH {i1})") == i1
+    # save/load
+    p = str(tmp_path / "hash.json")
+    m.save(p)
+    m2 = load_index_map(p)
+    assert isinstance(m2, HashingIndexMap)
+    assert m2.size == m.size and m2.index_of("age") == i1
+
+
+def test_fnv_stability():
+    # pinned digest: hashing must never drift across versions (stored models
+    # depend on it)
+    assert fnv1a_64(b"") == 0xCBF29CE484222325
+    assert fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+
+
+def test_glm_driver_hash_dim_end_to_end(tmp_path, rng):
+    from photon_ml_tpu.cli.glm_driver import main as glm_main
+    from photon_ml_tpu.io.data_reader import (
+        feature_tuples_from_dense,
+        write_training_examples,
+    )
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    n, d = 400, 10
+    X = (rng.random((n, d)) < 0.5) * rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
+    write_training_examples(
+        str(tmp_path / "train.avro"), feature_tuples_from_dense(X[:300]), y[:300]
+    )
+    write_training_examples(
+        str(tmp_path / "val.avro"), feature_tuples_from_dense(X[300:]), y[300:]
+    )
+    out = tmp_path / "out"
+    rc = glm_main([
+        "--train-data", str(tmp_path / "train.avro"),
+        "--validation-data", str(tmp_path / "val.avro"),
+        "--output-dir", str(out),
+        "--hash-dim", "64",  # 10 live features in 64 slots: few collisions
+        "--reg-weights", "1.0",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    log = [json.loads(l) for l in (out / "photon.log.jsonl").read_text().splitlines()]
+    auc = [r for r in log if r["event"] == "lambda_trained"][0]["metrics"]["auc"]
+    assert auc > 0.6, auc
+
+    # model round-trips through the hashed map
+    model = load_game_model(str(out / "best"))
+    wloaded = np.asarray(model["global"].model.coefficients.means)
+    assert wloaded.shape == (65,)
+    assert np.count_nonzero(wloaded) >= 10
+
+
+def test_game_driver_rejects_hash_with_shard_filtering(tmp_path, rng):
+    from photon_ml_tpu.cli.game_training_driver import main as train_main
+    from photon_ml_tpu.testing import synthetic_game_data, write_game_avro_fixture
+
+    data = synthetic_game_data({"userId": 4}, seed=0)
+    write_game_avro_fixture(str(tmp_path / "t.avro"), data)
+    coords = [{"name": "fixed", "coordinate_type": "fixed",
+               "feature_shard": "global", "reg_weight": 1.0}]
+    shards = {"global": ["g"]}
+    with pytest.raises(SystemExit, match="hash-dim"):
+        train_main([
+            "--train-data", str(tmp_path / "t.avro"),
+            "--output-dir", str(tmp_path / "out"),
+            "--coordinates", json.dumps(coords),
+            "--feature-shards", json.dumps(shards),
+            "--hash-dim", "128",
+        ])

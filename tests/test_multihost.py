@@ -1,0 +1,50 @@
+"""Multi-host wiring (single-process testable surface): the initialize
+no-op path, argument validation, and the per-process input-split math."""
+
+import pytest
+
+from photon_ml_tpu.parallel.multihost import (
+    initialize_multihost,
+    process_span,
+    runtime_info,
+)
+
+
+def test_initialize_noop_without_coordinator():
+    assert initialize_multihost() is False
+
+
+def test_initialize_validates_pairing():
+    with pytest.raises(ValueError, match="go together"):
+        initialize_multihost("host:1234", num_processes=2, process_id=None)
+
+
+def test_process_span_single_process():
+    # single process owns everything
+    assert process_span(100) == (0, 100)
+    assert process_span(0) == (0, 0)
+
+
+def test_runtime_info_shape():
+    info = runtime_info()
+    assert info["process_count"] == 1
+    assert info["process_index"] == 0
+    assert info["global_devices"] >= info["local_devices"] >= 1
+    assert info["platform"] == "cpu"  # conftest pins the test platform
+
+
+def test_span_partition_math():
+    # simulate the formula for p processes without a real multi-host runtime
+    def spans(total, p):
+        base, extra = divmod(total, p)
+        out = []
+        for i in range(p):
+            start = i * base + min(i, extra)
+            out.append((start, start + base + (1 if i < extra else 0)))
+        return out
+
+    s = spans(10, 3)
+    assert s == [(0, 4), (4, 7), (7, 10)]
+    # contiguous, disjoint, covering
+    assert s[0][0] == 0 and s[-1][1] == 10
+    assert all(s[i][1] == s[i + 1][0] for i in range(2))
