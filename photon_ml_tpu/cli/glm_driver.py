@@ -96,6 +96,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-variances", action="store_true",
                    help="diagonal-inverse-Hessian coefficient variances")
     p.add_argument("--summarize-features", action="store_true")
+    p.add_argument("--diagnostics", action="store_true",
+                   help="write diagnostics.json for the best model: Hosmer-"
+                        "Lemeshow fit test (binary), feature importance, "
+                        "optional bootstrap CIs")
+    p.add_argument("--bootstrap-replicates", type=int, default=0,
+                   help="bootstrap refits for coefficient CIs (vmapped into "
+                        "one batched fit; 0 disables)")
     p.add_argument("--streaming", action="store_true",
                    help="larger-than-HBM mode: keep the training set in host "
                         "RAM and stream fixed-shape chunks through the "
@@ -347,6 +354,49 @@ def main(argv: Sequence[str] | None = None) -> int:
             if ev.better(results[i][2][evaluators[0]],
                          results[best_i][2][evaluators[0]]):
                 best_i = i
+
+    if args.diagnostics:
+        from photon_ml_tpu import diagnostics as diag
+
+        lam_best, res_best, _, _ = results[best_i]
+        report = {"reg_weight": lam_best}
+        inverse = index_map.inverse()
+        summary_std = None
+        if norm_type != NormalizationType.NONE or args.summarize_features:
+            summary_std = np.zeros(dim)
+            summary_std[:summary.dim] = summary.std
+        imp = diag.feature_importance(np.asarray(res_best.w), summary_std,
+                                      top_k=50)
+        report["feature_importance"] = [
+            {"feature": inverse.get(int(i), str(int(i))),
+             "score": float(s)}
+            for i, s in zip(imp["index"], imp["score"])
+        ]
+        if validation_batch is not None and task in ("logistic",
+                                                     "smoothed_hinge"):
+            probs = np.asarray(
+                objective.loss.mean(
+                    objective.margins(res_best.w, validation_batch)
+                )
+            )
+            report["hosmer_lemeshow"] = diag.hosmer_lemeshow(probs, vlabels)
+        if args.bootstrap_replicates > 0 and not streaming:
+            with Timed(logger, "bootstrap"):
+                boot = diag.bootstrap_coefficients(
+                    objective, batch, res_best.w,
+                    l2=reg.l2_weight(lam_best),
+                    n_replicates=args.bootstrap_replicates,
+                )
+            report["bootstrap"] = {
+                "replicates": args.bootstrap_replicates,
+                "std": boot["std"].tolist(),
+                "lower": boot["lower"].tolist(),
+                "upper": boot["upper"].tolist(),
+            }
+        with open(os.path.join(args.output_dir, "diagnostics.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        logger.log("diagnostics_written",
+                   hosmer_lemeshow=report.get("hosmer_lemeshow"))
 
     # -- stage: diagnostics + model output ------------------------------------
     with Timed(logger, "save_models"):

@@ -1,0 +1,97 @@
+"""Diagnostics stage (the classic driver's final stage): HL fit test,
+vmapped bootstrap CIs, feature importance, and the driver integration."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.diagnostics import (
+    bootstrap_coefficients,
+    feature_importance,
+    hosmer_lemeshow,
+)
+
+
+def test_hosmer_lemeshow_calibrated_vs_miscalibrated(rng):
+    n = 4000
+    p = rng.uniform(0.05, 0.95, size=n)
+    y_good = (rng.random(n) < p).astype(float)
+    good = hosmer_lemeshow(p, y_good)
+    # calibrated probabilities: no evidence of misfit
+    assert good["p_value"] > 0.01
+    # badly miscalibrated: overconfident probabilities
+    p_bad = np.clip(p**3, 0.01, 0.99)
+    bad = hosmer_lemeshow(p_bad, y_good)
+    assert bad["statistic"] > good["statistic"]
+    assert bad["p_value"] < 1e-4
+
+
+def test_bootstrap_coefficients_cover_truth(rng):
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.optimize.lbfgs import lbfgs
+    from photon_ml_tpu.types import make_batch
+
+    n, d = 800, 4
+    X = rng.normal(size=(n, d))
+    w_true = np.array([1.0, -0.5, 0.0, 0.25])
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(float)
+    batch = make_batch(jnp.asarray(X), y, dtype=jnp.float64)
+    obj = make_objective("logistic")
+    res = lbfgs(lambda w: obj.value_and_grad(w, batch, 1e-3),
+                jnp.zeros(d, jnp.float64), OptimizerConfig())
+    boot = bootstrap_coefficients(obj, batch, res.w, l2=1e-3,
+                                  n_replicates=24, seed=1)
+    assert boot["replicates"].shape == (24, d)
+    # intervals are ordered and (for this well-specified problem) cover truth
+    assert np.all(boot["lower"] <= boot["upper"])
+    covered = (boot["lower"] <= w_true) & (w_true <= boot["upper"])
+    assert covered.sum() >= 3, (boot["lower"], w_true, boot["upper"])
+    assert np.all(boot["std"] > 0)
+
+
+def test_feature_importance_ranking():
+    w = np.array([0.1, -2.0, 0.5])
+    std = np.array([10.0, 0.1, 1.0])
+    imp = feature_importance(w, std)
+    # |0.1*10| = 1.0, |-2*0.1| = 0.2, |0.5*1| = 0.5
+    assert imp["index"].tolist() == [0, 2, 1]
+    imp2 = feature_importance(w, None, top_k=1)
+    assert imp2["index"].tolist() == [1]
+
+
+def test_glm_driver_diagnostics_output(tmp_path, rng):
+    from photon_ml_tpu.cli.glm_driver import main as glm_main
+    from photon_ml_tpu.io.data_reader import (
+        feature_tuples_from_dense,
+        write_training_examples,
+    )
+
+    n, d = 400, 6
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
+    write_training_examples(
+        str(tmp_path / "train.avro"), feature_tuples_from_dense(X[:300]), y[:300]
+    )
+    write_training_examples(
+        str(tmp_path / "val.avro"), feature_tuples_from_dense(X[300:]), y[300:]
+    )
+    out = tmp_path / "out"
+    rc = glm_main([
+        "--train-data", str(tmp_path / "train.avro"),
+        "--validation-data", str(tmp_path / "val.avro"),
+        "--output-dir", str(out),
+        "--reg-weights", "1.0",
+        "--diagnostics", "--bootstrap-replicates", "8",
+        "--summarize-features",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    report = json.loads((out / "diagnostics.json").read_text())
+    assert report["reg_weight"] == 1.0
+    assert len(report["feature_importance"]) == d + 1  # + intercept
+    assert {"statistic", "dof", "p_value"} <= set(report["hosmer_lemeshow"])
+    assert len(report["bootstrap"]["std"]) == d + 1
