@@ -20,7 +20,6 @@ from photon_ml_tpu.game.descent import GameDataset
 from photon_ml_tpu.game.scoring import score_game_model
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.data_reader import read_training_examples
-from photon_ml_tpu.io.index_map import IndexMap
 from photon_ml_tpu.io.model_io import load_game_model
 from photon_ml_tpu.io.schemas import SCORING_RESULT_SCHEMA
 from photon_ml_tpu.evaluation import get_evaluator
@@ -36,8 +35,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--evaluators", nargs="*", default=())
     p.add_argument("--per-coordinate-scores", action="store_true",
                    help="include a per-coordinate score breakdown")
+    p.add_argument("--batch-rows", type=int, default=None,
+                   help="score in row batches of this size (bounds device "
+                        "memory for large scoring sets)")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
     return p
+
+
+def _slice_host_sparse(sp, row_slice):
+    from photon_ml_tpu.game.data import HostSparse
+
+    return HostSparse(sp.indices[row_slice], sp.values[row_slice], sp.dim)
 
 
 def main(argv: Sequence[str] | None = None) -> int:
@@ -49,9 +57,11 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     with Timed(logger, "load_model"):
         model = load_game_model(args.model_dir)
+    from photon_ml_tpu.io.paldb import load_index_map
+
     shards = sorted({c.feature_shard for c in model.coordinates.values()})
     index_maps = {
-        s: IndexMap.load(os.path.join(args.model_dir, f"index-map.{s}.json"))
+        s: load_index_map(os.path.join(args.model_dir, f"index-map.{s}.json"))
         for s in shards
     }
     entity_columns = [
@@ -65,17 +75,28 @@ def main(argv: Sequence[str] | None = None) -> int:
         )
     logger.log("data_read", num_rows=len(labels))
 
-    with Timed(logger, "score"):
+    def score_rows(row_slice):
+        f = {s: _slice_host_sparse(sp, row_slice) for s, sp in feats.items()}
+        e = {c: v[row_slice] for c, v in ents.items()}
         result = score_game_model(
-            model, feats, ents, offsets=offsets, dtype=dtype,
+            model, f, e, offsets=offsets[row_slice], dtype=dtype,
             per_coordinate=args.per_coordinate_scores,
         )
         if args.per_coordinate_scores:
-            scores, parts = result
-            parts = {k: np.asarray(v) for k, v in parts.items()}
-        else:
-            scores, parts = result, {}
-        scores = np.asarray(scores)
+            s, parts = result
+            return np.asarray(s), {k: np.asarray(v) for k, v in parts.items()}
+        return np.asarray(result), {}
+
+    with Timed(logger, "score"):
+        n = len(labels)
+        step = args.batch_rows or max(n, 1)
+        chunks = [score_rows(slice(i, min(i + step, n)))
+                  for i in range(0, max(n, 1), step)]
+        scores = np.concatenate([c[0] for c in chunks]) if chunks else np.zeros(0)
+        parts = {}
+        if chunks and chunks[0][1]:
+            parts = {k: np.concatenate([c[1][k] for c in chunks])
+                     for k in chunks[0][1]}
 
     with Timed(logger, "write_scores"):
         def records():
