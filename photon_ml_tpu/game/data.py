@@ -102,6 +102,36 @@ class REBucket:
         return self.projection.shape[1]
 
 
+_U64 = (1 << 64) - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchProjection:
+    """Count-sketch random projection: global feature id → (slot, ±1).
+
+    The reference's older random-projection projector (SURVEY.md §3.2
+    ``projector`` row, marked ``(?)``) for random effects whose entity
+    feature spaces are too wide for exact subspace maps: every entity of the
+    effect shares one signed hash into a fixed ``dim``-wide space, so entity
+    problems have constant shape regardless of support size. Mixing is a
+    splitmix64-style finalizer — stable across processes (the same reason
+    ``io.hashing`` avoids Python's ``hash``)."""
+
+    dim: int
+    seed: int = 0
+
+    def slots_signs(self, gids: np.ndarray):
+        x = np.asarray(gids, np.uint64) + np.uint64(
+            (self.seed * 0x9E3779B97F4A7C15 + 0x632BE59BD9B4E019) & _U64
+        )
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9) & _U64
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB) & _U64
+        x = x ^ (x >> np.uint64(31))
+        slots = (x % np.uint64(self.dim)).astype(np.int64)
+        signs = 1.0 - 2.0 * ((x >> np.uint64(32)) & np.uint64(1)).astype(np.float64)
+        return slots, signs
+
+
 def _local_map_arrays(lm: Dict[int, int]):
     """Sorted global ids + their local slots, for vectorized remapping."""
     if not lm:
@@ -112,10 +142,17 @@ def _local_map_arrays(lm: Dict[int, int]):
     return gids[order], slots[order]
 
 
-def _remap_to_local(row_idx: np.ndarray, row_val: np.ndarray, lm: Dict[int, int]):
+def _remap_to_local(row_idx: np.ndarray, row_val: np.ndarray, lm):
     """Map global feature ids to entity-local slots in one vectorized pass
     (np.searchsorted); entries outside the local map are zeroed (projector
-    semantics: their coefficient is structurally 0)."""
+    semantics: their coefficient is structurally 0). ``lm`` is either a
+    global-id→slot dict (subspace projector) or a SketchProjection."""
+    if isinstance(lm, SketchProjection):
+        slots, signs = lm.slots_signs(row_idx)
+        present = row_val != 0
+        loc = np.where(present, slots, 0).astype(row_idx.dtype)
+        val = np.where(present, row_val * signs, 0.0)
+        return loc, val
     gids, slots = _local_map_arrays(lm)
     if len(gids) == 0:
         return np.zeros_like(row_idx), np.zeros_like(row_val)
@@ -159,8 +196,16 @@ def build_random_effect_data(
     num_buckets: int = 4,
     active_cap: Optional[int] = None,
     seed: int = 0,
+    projection: str = "subspace",
+    projection_dim: Optional[int] = None,
+    projection_seed: int = 0,
 ) -> RandomEffectTrainData:
-    """Group rows by entity, split active/passive, project, bucket, pad."""
+    """Group rows by entity, split active/passive, project, bucket, pad.
+
+    ``projection``: "subspace" builds exact per-entity feature maps (the
+    LinearSubspaceProjector role); "random" uses a shared count-sketch of
+    width ``projection_dim`` (the RandomProjection role — constant-shape
+    entity problems, non-invertible)."""
     sp = host_sparse_from_features(features)
     labels = np.asarray(labels, np.float64)
     weights = np.asarray(weights, np.float64)
@@ -183,12 +228,22 @@ def build_random_effect_data(
         active_rows.append(rows)
 
     # per-entity local feature maps from active data
-    local_maps: List[Dict[int, int]] = []
-    for e in range(len(uniq)):
-        rows = active_rows[e]
-        feats = sp.indices[rows][sp.values[rows] != 0]
-        ids = np.unique(feats)
-        local_maps.append({int(g): i for i, g in enumerate(ids)})
+    if projection == "random":
+        if not projection_dim or projection_dim <= 0:
+            raise ValueError("projection='random' needs a positive "
+                             "projection_dim")
+        sketch = SketchProjection(projection_dim, projection_seed)
+        local_maps = [sketch] * len(uniq)
+    elif projection == "subspace":
+        local_maps = []
+        for e in range(len(uniq)):
+            rows = active_rows[e]
+            feats = sp.indices[rows][sp.values[rows] != 0]
+            ids = np.unique(feats)
+            local_maps.append({int(g): i for i, g in enumerate(ids)})
+    else:
+        raise ValueError(f"unknown projection '{projection}' "
+                         "(subspace|random)")
 
     # bucket entities by active-row count
     counts = np.array([len(r) for r in active_rows])
@@ -202,7 +257,10 @@ def build_random_effect_data(
     for b, members in enumerate(splits):
         E = len(members)
         N = max(int(counts[members].max()), 1)
-        D = max(max(len(local_maps[e]) for e in members), 1)
+        if projection == "random":
+            D = projection_dim
+        else:
+            D = max(max(len(local_maps[e]) for e in members), 1)
         k = sp.indices.shape[1]
         indices = np.zeros((E, N, k), np.int32)
         values = np.zeros((E, N, k))
@@ -221,8 +279,9 @@ def build_random_effect_data(
             lab[r, :m] = labels[rows]
             wts[r, :m] = weights[rows]
             sidx[r, :m] = rows
-            for gid, slot in lm.items():
-                proj[r, slot] = gid
+            if not isinstance(lm, SketchProjection):
+                for gid, slot in lm.items():
+                    proj[r, slot] = gid
             eids.append(uniq[e])
             entity_to_slot[uniq[e]] = (b, r)
         buckets.append(

@@ -38,6 +38,7 @@ from photon_ml_tpu.evaluation import get_evaluator
 from photon_ml_tpu.game.data import (
     HostSparse,
     RandomEffectTrainData,
+    SketchProjection,
     build_random_effect_data,
     build_score_view,
     host_sparse_from_features,
@@ -85,6 +86,11 @@ class CoordinateConfig:
     down_sampling_rate: float = 1.0  # fixed-effect only
     active_cap: Optional[int] = None  # random-effect only
     num_buckets: int = 4  # random-effect entity size buckets
+    # random-effect projector: "subspace" (exact per-entity maps) or
+    # "random" (shared count-sketch of width projection_dim)
+    projection: str = "subspace"
+    projection_dim: Optional[int] = None
+    projection_seed: int = 0
     compute_variance: bool = False
     normalization: Optional[NormalizationContext] = None
     intercept_index: int = -1
@@ -268,7 +274,8 @@ class _RandomState:
         sp = data.features[cfg.feature_shard]
         ids = data.entity_ids[cfg.entity_column]
         key = ("re_data", id(data), cfg.name, cfg.feature_shard,
-               cfg.entity_column, cfg.num_buckets, cfg.active_cap)
+               cfg.entity_column, cfg.num_buckets, cfg.active_cap,
+               cfg.projection, cfg.projection_dim, cfg.projection_seed)
         if cache is not None and key in cache:
             # entry[0] pins the keyed dataset alive so its id() can't be
             # recycled by a different GameDataset while the cache lives
@@ -278,6 +285,9 @@ class _RandomState:
                 sp, data.labels, data.weights, ids,
                 effect_name=cfg.name, num_buckets=cfg.num_buckets,
                 active_cap=cfg.active_cap,
+                projection=cfg.projection,
+                projection_dim=cfg.projection_dim,
+                projection_seed=cfg.projection_seed,
             )
             self.train_view = build_score_view(self.train_data, sp, ids)
             if cache is not None:
@@ -465,12 +475,14 @@ class CoordinateDescent:
             else:
                 buckets = []
                 for b, bucket in enumerate(st.train_data.buckets):
+                    lm0 = bucket.local_maps[0] if bucket.local_maps else None
                     buckets.append(
                         RandomEffectBucket(
                             entity_ids=bucket.entity_ids,
                             coefficients=st.coeffs[b],
                             projection=bucket.projection,
                             variances=None if st.variances is None else st.variances[b],
+                            sketch=lm0 if isinstance(lm0, SketchProjection) else None,
                         )
                     )
                 coords[cfg.name] = RandomEffectModel(
@@ -506,13 +518,22 @@ class CoordinateDescent:
                     W = np.zeros((bucket.num_entities, bucket.local_dim))
                     for r, eid in enumerate(bucket.entity_ids):
                         slot = prev_index.get(eid)
+                        if slot is None:  # loaded models key entities as str
+                            slot = prev_index.get(str(eid))
                         if slot is None:
                             continue
                         pb, pr = slot
                         prev_bucket = prev.buckets[pb]
-                        prev_proj = np.asarray(prev_bucket.projection[pr])
                         prev_coef = np.asarray(prev_bucket.coefficients[pr])
                         lm = bucket.local_maps[r]
+                        if prev_bucket.sketch is not None:
+                            # sketched spaces line up only when the sketch is
+                            # identical; otherwise start that entity cold
+                            if (isinstance(lm, SketchProjection)
+                                    and lm == prev_bucket.sketch):
+                                W[r, : len(prev_coef)] = prev_coef
+                            continue
+                        prev_proj = np.asarray(prev_bucket.projection[pr])
                         for slot_local, gid in enumerate(prev_proj):
                             if gid >= 0 and int(gid) in lm:
                                 W[r, lm[int(gid)]] = prev_coef[slot_local]

@@ -37,11 +37,16 @@ def _model_score_view(re_model: RandomEffectModel, sp, entity_ids):
     local_maps_per_bucket = []
     coeffs = []
     for bucket in re_model.buckets:
-        proj = np.asarray(bucket.projection)
-        local_maps_per_bucket.append(
-            [{int(g): s for s, g in enumerate(proj[r]) if g >= 0}
-             for r in range(len(bucket.entity_ids))]
-        )
+        if bucket.sketch is not None:
+            local_maps_per_bucket.append(
+                [bucket.sketch] * len(bucket.entity_ids)
+            )
+        else:
+            proj = np.asarray(bucket.projection)
+            local_maps_per_bucket.append(
+                [{int(g): s for s, g in enumerate(proj[r]) if g >= 0}
+                 for r in range(len(bucket.entity_ids))]
+            )
         coeffs.append(np.asarray(bucket.coefficients))
     views = build_score_buckets(sp, per_bucket_rows, local_maps_per_bucket)
     return views, coeffs

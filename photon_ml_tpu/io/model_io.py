@@ -40,6 +40,12 @@ from photon_ml_tpu.models import (
 import jax.numpy as jnp
 
 
+def _sketch_records(w: np.ndarray):
+    w = np.asarray(w)
+    return [{"name": f"(SKETCH {j})", "term": "", "value": float(w[j])}
+            for j in np.nonzero(w)[0]]
+
+
 def _coef_records(w: np.ndarray, inverse: Dict[int, str]):
     out = []
     for idx in np.nonzero(w)[0]:
@@ -91,6 +97,19 @@ def save_game_model(
                         None if bucket.variances is None else np.asarray(bucket.variances)
                     )
                     for r, eid in enumerate(bucket.entity_ids):
+                        if bucket.sketch is not None:
+                            # sketched space is non-invertible: save per-slot
+                            # coefficients under synthetic (SKETCH j) names
+                            rec = {
+                                "modelId": str(eid),
+                                "modelClass": "RandomEffectModel",
+                                "means": _sketch_records(coefs[r]),
+                                "variances": None if variances is None
+                                else _sketch_records(variances[r]),
+                                "lossFunction": model.task,
+                            }
+                            yield rec
+                            continue
                         valid = proj[r] >= 0
                         w = np.zeros(imap.size)
                         w[proj[r][valid]] = coefs[r][valid]
@@ -109,10 +128,15 @@ def save_game_model(
 
             write_avro_file(os.path.join(sub, "coefficients.avro"), records(),
                             BAYESIAN_LINEAR_MODEL_SCHEMA)
-            meta["coordinates"].append(
-                {"name": name, "type": "random", "feature_shard": coord.feature_shard,
-                 "entity_column": coord.entity_column}
-            )
+            entry = {"name": name, "type": "random",
+                     "feature_shard": coord.feature_shard,
+                     "entity_column": coord.entity_column}
+            sketches = [b.sketch for b in coord.buckets if b.sketch is not None]
+            if sketches:
+                entry["projection"] = {"type": "random",
+                                       "dim": sketches[0].dim,
+                                       "seed": sketches[0].seed}
+            meta["coordinates"].append(entry)
         # persist the shard's index map alongside the model
         imap.save(os.path.join(directory, f"index-map.{coord.feature_shard}.json"))
     with open(os.path.join(directory, "metadata.json"), "w") as f:
@@ -162,15 +186,19 @@ def load_game_model(directory: str) -> GameModel:
             records, _ = read_avro_file(path)
             coords[c["name"]] = _rebuild_random_effect(
                 c["name"], records, imap, meta["task"], shard,
-                c.get("entity_column", ""),
+                c.get("entity_column", ""), c.get("projection"),
             )
     return GameModel(coords, meta["task"])
 
 
 def _rebuild_random_effect(name, records, imap: IndexMap, task, shard,
-                           entity_column="") -> RandomEffectModel:
+                           entity_column="", projection_meta=None) -> RandomEffectModel:
     """Rebuild bucketed per-entity coefficients from per-entity records,
     grouping entities with equal support size into buckets."""
+    if projection_meta and projection_meta.get("type") == "random":
+        return _rebuild_sketched_random_effect(
+            name, records, task, shard, entity_column, projection_meta
+        )
     entities: List[tuple] = []
     for rec in records:
         ids, vals, variances = [], [], {}
@@ -209,3 +237,41 @@ def _rebuild_random_effect(name, records, imap: IndexMap, task, shard,
             eids.append(eid)
         buckets.append(RandomEffectBucket(eids, coefs, proj, variances))
     return RandomEffectModel(name, buckets, task, shard, entity_column=entity_column)
+
+
+def _rebuild_sketched_random_effect(name, records, task, shard, entity_column,
+                                    projection_meta) -> RandomEffectModel:
+    """Rebuild a random-projection effect: coefficients live in the sketched
+    space, addressed by (SKETCH j) slot names; one bucket, constant width."""
+    from photon_ml_tpu.game.data import SketchProjection
+
+    dim = int(projection_meta["dim"])
+    sketch = SketchProjection(dim, int(projection_meta.get("seed", 0)))
+    eids, coefs_list, var_list = [], [], []
+    has_var = False
+    for rec in records:
+        w = np.zeros(dim)
+        for coef in rec["means"]:
+            nm = coef["name"]
+            if nm.startswith("(SKETCH ") and nm.endswith(")"):
+                w[int(nm[len("(SKETCH "):-1])] = coef["value"]
+        v = np.zeros(dim)
+        if rec.get("variances"):
+            has_var = True
+            for coef in rec["variances"]:
+                nm = coef["name"]
+                if nm.startswith("(SKETCH ") and nm.endswith(")"):
+                    v[int(nm[len("(SKETCH "):-1])] = coef["value"]
+        eids.append(rec["modelId"])
+        coefs_list.append(w)
+        var_list.append(v)
+    E = len(eids)
+    bucket = RandomEffectBucket(
+        eids,
+        np.stack(coefs_list) if E else np.zeros((0, dim)),
+        np.full((E, dim), -1, np.int32),
+        np.stack(var_list) if has_var else None,
+        sketch=sketch,
+    )
+    return RandomEffectModel(name, [bucket], task, shard,
+                             entity_column=entity_column)

@@ -87,6 +87,9 @@ class RandomEffectBucket:
     coefficients: np.ndarray | jax.Array
     projection: np.ndarray | jax.Array
     variances: Optional[np.ndarray] = None
+    # set when the bucket's local space is a count-sketch (random
+    # projection) instead of an exact subspace; projection is then all -1
+    sketch: Optional[object] = None
 
 
 @dataclasses.dataclass(frozen=True)

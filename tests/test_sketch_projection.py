@@ -1,0 +1,100 @@
+"""Random-projection (count-sketch) projector for random effects: the
+reference's RandomProjection role (SURVEY.md §3.2 projector row). Training,
+scoring, save/load round-trip, and warm start in the sketched space."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.estimators import GameTransformer
+from photon_ml_tpu.evaluation import get_evaluator
+from photon_ml_tpu.game.data import SketchProjection, build_random_effect_data
+from photon_ml_tpu.game.descent import CoordinateConfig, CoordinateDescent
+from photon_ml_tpu.testing import game_dataset_from_synthetic, synthetic_game_data
+
+
+def test_sketch_projection_stable_and_signed():
+    sk = SketchProjection(64, seed=1)
+    gids = np.arange(1000)
+    s1, sg1 = sk.slots_signs(gids)
+    s2, sg2 = sk.slots_signs(gids)
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(sg1, sg2)
+    assert s1.min() >= 0 and s1.max() < 64
+    assert set(np.unique(sg1)) == {-1.0, 1.0}
+    # roughly balanced signs and spread slots
+    assert 0.4 < (sg1 > 0).mean() < 0.6
+    assert len(np.unique(s1)) == 64
+    # different seed, different mapping
+    s3, _ = SketchProjection(64, seed=2).slots_signs(gids)
+    assert (s1 != s3).any()
+
+
+def test_build_random_effect_data_sketch_shapes(rng):
+    X = rng.normal(size=(60, 12)) * (rng.random((60, 12)) < 0.5)
+    y = (rng.random(60) < 0.5).astype(float)
+    ents = rng.integers(0, 5, size=60)
+    data = build_random_effect_data(
+        X, y, np.ones(60), ents, num_buckets=2,
+        projection="random", projection_dim=8,
+    )
+    for b in data.buckets:
+        assert b.local_dim == 8
+        assert (b.projection == -1).all()
+        assert isinstance(b.local_maps[0], SketchProjection)
+    with pytest.raises(ValueError, match="projection_dim"):
+        build_random_effect_data(X, y, np.ones(60), ents, projection="random")
+
+
+def _game_configs(projection_dim=None):
+    re_kwargs = {}
+    if projection_dim:
+        re_kwargs = {"projection": "random", "projection_dim": projection_dim}
+    return [
+        CoordinateConfig("fixed", coordinate_type="fixed",
+                         feature_shard="global", reg_type="l2",
+                         reg_weight=0.1, max_iters=50),
+        CoordinateConfig("per-user", coordinate_type="random",
+                         feature_shard="entity", entity_column="userId",
+                         reg_type="l2", reg_weight=1.0, max_iters=30,
+                         **re_kwargs),
+    ]
+
+
+def test_sketched_random_effect_learns(tmp_path):
+    data = synthetic_game_data({"userId": 12}, seed=4)
+    train = game_dataset_from_synthetic(data)
+    # sketch width 8 over a 3-dim entity space: projection loses little
+    model, _ = CoordinateDescent(_game_configs(projection_dim=8),
+                                 task="logistic", n_iterations=2).run(train)
+    auc = get_evaluator("auc").evaluate(
+        np.asarray(GameTransformer(model).transform(train)),
+        train.labels, train.weights)
+    assert auc > 0.8, auc
+
+    bucket = model["per-user"].buckets[0]
+    assert bucket.sketch is not None
+
+    # save / load round-trip preserves scores exactly
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+
+    d_g = data.features["global"].shape[1]
+    d_u = data.features["entity"].shape[1]
+    imaps = {
+        "global": IndexMap({f"g{j}": j for j in range(d_g)}),
+        "entity": IndexMap({f"u{j}": j for j in range(d_u)}),
+    }
+    save_game_model(model, str(tmp_path / "m"), imaps)
+    loaded = load_game_model(str(tmp_path / "m"))
+    assert loaded["per-user"].buckets[0].sketch == bucket.sketch
+    s_orig = np.asarray(GameTransformer(model).transform(train))
+    s_loaded = np.asarray(GameTransformer(loaded).transform(train))
+    np.testing.assert_allclose(s_loaded, s_orig, rtol=1e-6, atol=1e-7)
+
+    # warm start from the loaded sketched model reproduces its scores at init
+    cd = CoordinateDescent(_game_configs(projection_dim=8), task="logistic",
+                          n_iterations=1)
+    model2, history = cd.run(train, warm_start=loaded,
+                             locked=["fixed", "per-user"])
+    s_warm = np.asarray(GameTransformer(model2).transform(train))
+    np.testing.assert_allclose(s_warm, s_orig, rtol=1e-5, atol=1e-6)
