@@ -35,6 +35,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--evaluators", nargs="*", default=())
     p.add_argument("--per-coordinate-scores", action="store_true",
                    help="include a per-coordinate score breakdown")
+    p.add_argument("--input-columns", default=None,
+                   help="JSON (inline or path) remapping record field names")
     p.add_argument("--batch-rows", type=int, default=None,
                    help="score in row batches of this size (bounds device "
                         "memory for large scoring sets)")
@@ -69,9 +71,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         if isinstance(c, RandomEffectModel) and c.entity_column
     ]
 
+    from photon_ml_tpu.cli.game_training_driver import _load_input_columns
+
     with Timed(logger, "read_data"):
         feats, labels, offsets, weights, ents, uids = read_training_examples(
-            args.data, index_maps, entity_columns=entity_columns
+            args.data, index_maps, entity_columns=entity_columns,
+            columns=_load_input_columns(args.input_columns),
         )
     logger.log("data_read", num_rows=len(labels))
 

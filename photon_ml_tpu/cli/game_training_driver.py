@@ -69,6 +69,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "name prefixes (per-shard feature bags); shards not "
                         "listed get all features")
     p.add_argument("--min-feature-count", type=int, default=1)
+    p.add_argument("--input-columns", default=None,
+                   help="JSON (inline or path) remapping record field names "
+                        "(response/offset/weight/uid/features/metadata_map)")
     p.add_argument("--add-intercept", action="store_true", default=True)
     p.add_argument("--no-intercept", dest="add_intercept", action="store_false")
     p.add_argument("--normalization", default="none",
@@ -106,6 +109,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _load_input_columns(spec):
+    from photon_ml_tpu.io.data_reader import InputColumnsNames
+
+    if not spec:
+        return InputColumnsNames()
+    if os.path.exists(spec):
+        with open(spec) as f:
+            return InputColumnsNames.from_dict(json.load(f))
+    return InputColumnsNames.from_dict(json.loads(spec))
+
+
 def _load_coordinate_grid(spec: str) -> List[List[CoordinateConfig]]:
     if os.path.exists(spec):
         with open(spec) as f:
@@ -137,9 +151,9 @@ def _entity_columns(grid) -> List[str]:
     return cols
 
 
-def _read_dataset(paths, index_maps, entity_columns) -> GameDataset:
+def _read_dataset(paths, index_maps, entity_columns, columns=None) -> GameDataset:
     feats, labels, offsets, weights, ents, uids = read_training_examples(
-        paths, index_maps, entity_columns=entity_columns
+        paths, index_maps, entity_columns=entity_columns, columns=columns
     )
     return GameDataset(feats, labels, weights, offsets, ents, None)
 
@@ -157,6 +171,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     logger.log("driver_start", driver="game_training", args=vars(args),
                distributed=distributed, **runtime_info())
 
+    columns = _load_input_columns(args.input_columns)
     grid = _load_coordinate_grid(args.coordinates)
     shards = sorted({cfg.feature_shard for cfg in grid[0]})
     entity_columns = _entity_columns(grid)
@@ -199,6 +214,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                 iter_avro_records(args.train_data),
                 add_intercept=args.add_intercept,
                 min_count=args.min_feature_count,
+                features_field=columns.features,
             )
         shard_defs = {}
         if args.feature_shards:
@@ -222,12 +238,13 @@ def main(argv: Sequence[str] | None = None) -> int:
                 index_maps[s] = base_map
 
     with Timed(logger, "read_train_data"):
-        train = _read_dataset(args.train_data, index_maps, entity_columns)
+        train = _read_dataset(args.train_data, index_maps, entity_columns,
+                              columns)
     validation = None
     if args.validation_data:
         with Timed(logger, "read_validation_data"):
             validation = _read_dataset(args.validation_data, index_maps,
-                                       entity_columns)
+                                       entity_columns, columns)
     logger.log("data_read", num_train=train.num_samples,
                num_validation=0 if validation is None else validation.num_samples,
                num_features={s: m.size for s, m in index_maps.items()})

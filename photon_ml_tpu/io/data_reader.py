@@ -22,12 +22,38 @@ from photon_ml_tpu.io.schemas import (
     TRAINING_EXAMPLE_SCHEMA,
     feature_key,
 )
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class InputColumnsNames:
+    """Record-field name overrides — the reference's ``InputColumnsNames``
+    (SURVEY.md §3.2 GAME data layer row): datasets whose response / offset /
+    weight / uid / features / metadata fields use different names are read
+    without rewriting."""
+
+    response: str = "response"
+    offset: str = "offset"
+    weight: str = "weight"
+    uid: str = "uid"
+    features: str = "features"
+    metadata_map: str = "metadataMap"
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "InputColumnsNames":
+        if not d:
+            return cls()
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ValueError(f"unknown input column keys: {sorted(unknown)}")
+        return cls(**d)
 
 
 def read_training_examples(
     paths,
     index_maps: IndexMap | Dict[str, IndexMap],
     entity_columns: Sequence[str] = (),
+    columns: Optional[InputColumnsNames] = None,
 ):
     """Read Avro training examples into per-shard sparse features.
 
@@ -46,20 +72,24 @@ def read_training_examples(
     uids: List = []
     entity_vals: Dict[str, List] = {c: [] for c in entity_columns}
 
+    cols = columns or InputColumnsNames()
     for rec in iter_avro_records(paths):
-        labels.append(float(rec["response"]))
-        offsets.append(float(rec["offset"]) if rec.get("offset") is not None else 0.0)
-        weights.append(float(rec["weight"]) if rec.get("weight") is not None else 1.0)
-        uids.append(rec.get("uid"))
-        meta = rec.get("metadataMap") or {}
+        labels.append(float(rec[cols.response]))
+        offsets.append(float(rec[cols.offset])
+                       if rec.get(cols.offset) is not None else 0.0)
+        weights.append(float(rec[cols.weight])
+                       if rec.get(cols.weight) is not None else 1.0)
+        uids.append(rec.get(cols.uid))
+        meta = rec.get(cols.metadata_map) or {}
         for c in entity_columns:
             if c not in meta:
-                raise ValueError(f"record uid={rec.get('uid')} missing entity "
-                                 f"column '{c}' in metadataMap")
+                raise ValueError(f"record uid={rec.get(cols.uid)} missing "
+                                 f"entity column '{c}' in "
+                                 f"{cols.metadata_map}")
             entity_vals[c].append(meta[c])
         for shard, imap in index_maps.items():
             row: List[Tuple[int, float]] = []
-            for feat in rec["features"]:
+            for feat in rec[cols.features]:
                 idx = imap.index_of(feat["name"], feat.get("term", ""))
                 if idx is not None:
                     row.append((idx, float(feat["value"])))

@@ -57,13 +57,14 @@ def build_index_map(
     records: Iterable,
     add_intercept: bool = True,
     min_count: int = 1,
+    features_field: str = "features",
 ) -> IndexMap:
     """Scan training example records (dicts with a ``features`` list of
     name/term/value) and assign dense indices — the FeatureIndexingDriver
     role. ``min_count`` drops rare features."""
     counts: Dict[str, int] = {}
     for rec in records:
-        for feat in rec["features"]:
+        for feat in rec[features_field]:
             key = feature_key(feat["name"], feat.get("term", ""))
             counts[key] = counts.get(key, 0) + 1
     keys = sorted(k for k, c in counts.items() if c >= min_count)
