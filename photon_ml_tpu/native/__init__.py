@@ -26,13 +26,26 @@ def _source_digest(src_path: str) -> str:
         return hashlib.sha256(f.read()).hexdigest()[:16]
 
 
+def _build_dir() -> str:
+    """Writable cache dir for compiled libraries: the package tree when
+    writable (repo checkouts), else a per-user cache (pip installs into
+    root-owned site-packages must not be written to)."""
+    in_tree = os.path.join(_NATIVE_DIR, "_build")
+    probe_dir = in_tree if os.path.isdir(in_tree) else _NATIVE_DIR
+    if os.access(probe_dir, os.W_OK):
+        return in_tree
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "photon_ml_tpu", "native")
+
+
 def build_library(name: str, *, cxx: str | None = None) -> str:
     """Compile ``<name>.cpp`` into a cached ``.so`` and return its path.
     The cache key includes a source digest, so editing the .cpp rebuilds."""
     src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
     if not os.path.exists(src):
         raise NativeBuildError(f"no such native source: {src}")
-    out_dir = os.path.join(_NATIVE_DIR, "_build")
+    out_dir = _build_dir()
     lib = os.path.join(out_dir, f"lib{name}-{_source_digest(src)}.so")
     with _BUILD_LOCK:
         if os.path.exists(lib):
