@@ -1,0 +1,69 @@
+"""Property-based fuzzing of the self-contained Avro codec: arbitrary
+records must round-trip bit-exact through write_avro_file/read_avro_file
+(the external data contract — SURVEY.md §3.4)."""
+
+import math
+
+from hypothesis import given, settings, strategies as st
+
+from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+
+
+FUZZ_SCHEMA = {
+    "type": "record",
+    "name": "Fuzz",
+    "fields": [
+        {"name": "uid", "type": ["null", "string", "long"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "flag", "type": "boolean"},
+        {"name": "count", "type": "long"},
+        {"name": "ratio", "type": "float"},
+        {"name": "blob", "type": "bytes"},
+        {"name": "features", "type": {"type": "array", "items": {
+            "type": "record", "name": "FeatureFuzz", "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": "string", "default": ""},
+                {"name": "value", "type": "double"},
+            ]}}},
+        {"name": "metadataMap", "type": {"type": "map", "values": "string"},
+         "default": {}},
+    ],
+}
+
+finite_doubles = st.floats(allow_nan=False, allow_infinity=False, width=64)
+text = st.text(max_size=30)
+
+feature = st.fixed_dictionaries({
+    "name": text, "term": text, "value": finite_doubles,
+})
+
+record = st.fixed_dictionaries({
+    "uid": st.one_of(st.none(), text,
+                     st.integers(-(2**62), 2**62)),
+    "response": finite_doubles,
+    "flag": st.booleans(),
+    "count": st.integers(-(2**63), 2**63 - 1),
+    "ratio": st.floats(allow_nan=False, allow_infinity=False, width=32),
+    "blob": st.binary(max_size=40),
+    "features": st.lists(feature, max_size=5),
+    "metadataMap": st.dictionaries(text, text, max_size=4),
+})
+
+
+@settings(max_examples=40, deadline=None)
+@given(records=st.lists(record, max_size=8),
+       codec=st.sampled_from(["null", "deflate"]))
+def test_avro_roundtrip_fuzz(tmp_path_factory, records, codec):
+    path = str(tmp_path_factory.mktemp("avro") / "fuzz.avro")
+    write_avro_file(path, records, FUZZ_SCHEMA, codec=codec)
+    got, schema = read_avro_file(path)
+    assert len(got) == len(records)
+    for a, b in zip(got, records):
+        assert a["uid"] == b["uid"]
+        assert a["response"] == b["response"]
+        assert a["flag"] == b["flag"]
+        assert a["count"] == b["count"]
+        assert math.isclose(a["ratio"], b["ratio"], rel_tol=1e-6, abs_tol=1e-30)
+        assert a["blob"] == b["blob"]
+        assert a["features"] == b["features"]
+        assert a["metadataMap"] == b["metadataMap"]
