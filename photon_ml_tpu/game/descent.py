@@ -64,6 +64,7 @@ from photon_ml_tpu.parallel.data_parallel import (
     distributed_hvp,
     distributed_value_and_grad,
 )
+from photon_ml_tpu.parallel.mesh import make_mesh
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures, margins as _margins
 
 
@@ -84,6 +85,9 @@ class CoordinateConfig:
     reg_weight: float = 0.0
     elastic_net_alpha: float = 0.5
     down_sampling_rate: float = 1.0  # fixed-effect only
+    # fixed-effect sparse gradient strategy: "scatter" (XLA scatter-add),
+    # "csc" or "csc_pallas" (scatter-free column-sorted — types.CSCTranspose)
+    sparse_grad: str = "scatter"
     active_cap: Optional[int] = None  # random-effect only
     num_buckets: int = 4  # random-effect entity size buckets
     # random-effect projector: "subspace" (exact per-entity maps) or
@@ -208,24 +212,55 @@ class _FixedState:
         if cfg.intercept_index >= 0:
             l1_mask = jnp.ones((d,), dtype).at[cfg.intercept_index].set(0.0)
 
-        if use_mesh:
-            sharding = NamedSharding(mesh, P("data"))
-            feats = jax.tree.map(lambda a: jax.device_put(a, sharding), feats)
-            labels = jax.device_put(labels, sharding)
-            weights = jax.device_put(weights, sharding)
-            self._offset_sharding = sharding
-            fg_dist = distributed_value_and_grad(self.obj, mesh)
-            hvp_dist = distributed_hvp(self.obj, mesh) if optimizer == "tron" else None
+        use_csc = cfg.sparse_grad in ("csc", "csc_pallas")
+        if use_csc and not isinstance(feats, SparseFeatures):
+            raise ValueError(f"sparse_grad='{cfg.sparse_grad}' needs sparse "
+                             "features")
+        if use_mesh or use_csc:
+            work_mesh = mesh if use_mesh else make_mesh({"data": 1})
+            if use_mesh:
+                sharding = NamedSharding(mesh, P("data"))
+                feats = jax.tree.map(lambda a: jax.device_put(a, sharding), feats)
+                labels = jax.device_put(labels, sharding)
+                weights = jax.device_put(weights, sharding)
+                self._offset_sharding = sharding
+            else:
+                self._offset_sharding = None
+            if use_csc:
+                from photon_ml_tpu.parallel.data_parallel import make_csc_path
 
-            def _fit(w0, offs, l2, l1):
-                batch = LabeledBatch(feats, labels, offs, weights)
-                fg = lambda w: fg_dist(w, batch, l2)
-                if optimizer == "owlqn":
-                    return opt(fg, w0, l1, cfg_opt, l1_mask=l1_mask)
-                if optimizer == "tron":
-                    return opt(fg, w0, cfg_opt,
-                               hvp=lambda w, v: hvp_dist(w, v, batch, l2))
-                return opt(fg, w0, cfg_opt)
+                build, fg_csc, hvp_csc = make_csc_path(
+                    self.obj, work_mesh,
+                    use_pallas=(cfg.sparse_grad == "csc_pallas"),
+                )
+                # sorted once here; offsets change per CD iteration, the
+                # sparsity pattern never does
+                csc = jax.jit(build)(
+                    LabeledBatch(feats, labels, jnp.zeros_like(labels), weights)
+                )
+
+                def _fit(w0, offs, l2, l1):
+                    batch = LabeledBatch(feats, labels, offs, weights)
+                    fg = lambda w: fg_csc(w, batch, csc, l2)
+                    if optimizer == "owlqn":
+                        return opt(fg, w0, l1, cfg_opt, l1_mask=l1_mask)
+                    if optimizer == "tron":
+                        return opt(fg, w0, cfg_opt,
+                                   hvp=lambda w, v: hvp_csc(w, v, batch, csc, l2))
+                    return opt(fg, w0, cfg_opt)
+            else:
+                fg_dist = distributed_value_and_grad(self.obj, mesh)
+                hvp_dist = distributed_hvp(self.obj, mesh) if optimizer == "tron" else None
+
+                def _fit(w0, offs, l2, l1):
+                    batch = LabeledBatch(feats, labels, offs, weights)
+                    fg = lambda w: fg_dist(w, batch, l2)
+                    if optimizer == "owlqn":
+                        return opt(fg, w0, l1, cfg_opt, l1_mask=l1_mask)
+                    if optimizer == "tron":
+                        return opt(fg, w0, cfg_opt,
+                                   hvp=lambda w, v: hvp_dist(w, v, batch, l2))
+                    return opt(fg, w0, cfg_opt)
         else:
             self._offset_sharding = None
 

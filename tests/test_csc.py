@@ -111,3 +111,25 @@ def test_csc_rejects_normalization(sparse_batch):
     obj = make_objective("logistic", normalization=ctx)
     with pytest.raises(ValueError, match="normalization"):
         make_csc_path(obj, make_mesh())
+
+def test_game_fixed_coordinate_csc_matches_scatter():
+    from photon_ml_tpu.estimators import GameTransformer
+    from photon_ml_tpu.game.descent import CoordinateConfig, CoordinateDescent
+    from photon_ml_tpu.testing import game_dataset_from_synthetic, synthetic_game_data
+
+    data = synthetic_game_data({"userId": 8}, seed=6)
+    train = game_dataset_from_synthetic(data)
+
+    def run(sparse_grad):
+        cd = CoordinateDescent([
+            CoordinateConfig("fixed", coordinate_type="fixed",
+                             feature_shard="global", reg_type="l2",
+                             reg_weight=0.5, max_iters=60,
+                             sparse_grad=sparse_grad),
+        ], task="logistic", dtype=jnp.float64)
+        model, _ = cd.run(train)
+        return np.asarray(GameTransformer(model).transform(train))
+
+    s_scatter = run("scatter")
+    s_csc = run("csc")
+    np.testing.assert_allclose(s_csc, s_scatter, rtol=1e-6, atol=1e-8)
