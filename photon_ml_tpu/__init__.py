@@ -9,21 +9,68 @@ Design stance (TPU-first, not a port):
 
 * Examples live in batched, device-resident arrays (``LabeledBatch``) instead
   of per-row JVM objects; sparse features use a padded ELL layout that XLA
-  tiles well.
+  tiles well, with an optional scatter-free column-sorted gradient path
+  (``CSCTranspose``) and a Pallas fused-scan kernel for it.
 * The reference's Spark ``treeAggregate`` of gradient partials becomes an
-  on-device sharded sum + ``psum`` over ICI (``photon_ml_tpu.parallel``).
+  on-device sharded sum + ``psum`` over ICI (``photon_ml_tpu.parallel``);
+  multi-host scaling is the JAX multi-controller runtime
+  (``parallel.multihost``), and larger-than-HBM datasets stream host chunks
+  through the device (``parallel.streaming``).
 * The reference's per-entity random-effect solves (``mapValues`` of local
-  Breeze optimizers) become a ``vmap`` of fixed-shape local solves over
-  entity shards (``photon_ml_tpu.game`` — under construction; the GAME
-  layer is the next milestone after the GLM core).
+  Breeze optimizers) are a ``vmap`` of fixed-shape local solves over entity
+  shards (``photon_ml_tpu.game``), with subspace or count-sketch projectors.
 * Optimizers (L-BFGS / OWL-QN / TRON) are jitted ``lax.while_loop`` update
   steps with on-device convergence tracking (``photon_ml_tpu.optimize``).
+* Avro-in/Avro-out is preserved (``photon_ml_tpu.io``): training examples,
+  models, scores, and feature summaries use the reference's record shapes,
+  with JSON / native-mmap / hashing feature index backends.
 """
 
 __version__ = "0.1.0"
 
-from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+from photon_ml_tpu.estimators import GameEstimator, GameTransformer
+from photon_ml_tpu.game.descent import (
+    CoordinateConfig,
+    CoordinateDescent,
+    GameDataset,
+    make_game_dataset,
+)
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    GeneralizedLinearModel,
+    RandomEffectModel,
+)
 from photon_ml_tpu.ops.losses import get_loss
-from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.normalization import NormalizationContext, NormalizationType
+from photon_ml_tpu.ops.objective import GLMObjective, make_objective
 from photon_ml_tpu.ops.regularization import RegularizationContext, RegularizationType
+from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
+from photon_ml_tpu.types import LabeledBatch, SparseFeatures, make_batch
+
+__all__ = [
+    "Coefficients",
+    "CoordinateConfig",
+    "CoordinateDescent",
+    "FixedEffectModel",
+    "GLMObjective",
+    "GameDataset",
+    "GameEstimator",
+    "GameModel",
+    "GameTransformer",
+    "GeneralizedLinearModel",
+    "LabeledBatch",
+    "NormalizationContext",
+    "NormalizationType",
+    "OptimizerConfig",
+    "RandomEffectModel",
+    "RegularizationContext",
+    "RegularizationType",
+    "SparseFeatures",
+    "get_loss",
+    "get_optimizer",
+    "make_batch",
+    "make_game_dataset",
+    "make_objective",
+]
