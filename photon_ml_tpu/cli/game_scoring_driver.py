@@ -77,6 +77,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         feats, labels, offsets, weights, ents, uids = read_training_examples(
             args.data, index_maps, entity_columns=entity_columns,
             columns=_load_input_columns(args.input_columns),
+            require_response=False,
         )
     logger.log("data_read", num_rows=len(labels))
 
@@ -109,7 +110,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                 yield {
                     "uid": uid,
                     "predictionScore": float(scores[i]),
-                    "label": float(labels[i]),
+                    "label": None if np.isnan(labels[i]) else float(labels[i]),
                     "scoreComponents": {
                         k: float(v[i]) for k, v in parts.items()
                     },
@@ -118,10 +119,15 @@ def main(argv: Sequence[str] | None = None) -> int:
         write_avro_file(os.path.join(args.output_dir, "scores.avro"),
                         records(), SCORING_RESULT_SCHEMA)
 
+    labeled = ~np.isnan(labels)
     metrics = {}
-    for name in args.evaluators:
-        ev = get_evaluator(name)
-        metrics[name] = ev.evaluate(scores, labels, weights)
+    if args.evaluators and not labeled.any():
+        logger.log("evaluation_skipped", reason="no labeled rows")
+    else:
+        for name in args.evaluators:
+            ev = get_evaluator(name)
+            metrics[name] = ev.evaluate(scores[labeled], labels[labeled],
+                                        weights[labeled])
     if metrics:
         logger.log("evaluation", **metrics)
     logger.log("driver_done", num_scored=len(scores))

@@ -54,6 +54,7 @@ def read_training_examples(
     index_maps: IndexMap | Dict[str, IndexMap],
     entity_columns: Sequence[str] = (),
     columns: Optional[InputColumnsNames] = None,
+    require_response: bool = True,
 ):
     """Read Avro training examples into per-shard sparse features.
 
@@ -74,7 +75,19 @@ def read_training_examples(
 
     cols = columns or InputColumnsNames()
     for rec in iter_avro_records(paths):
-        labels.append(float(rec[cols.response]))
+        if require_response:
+            val = rec.get(cols.response)
+            if val is None:
+                raise ValueError(
+                    f"record uid={rec.get(cols.uid)} has no "
+                    f"'{cols.response}' — training data must be labeled"
+                )
+            labels.append(float(val))
+        else:
+            # scoring data may be unlabeled (the reference scores label-less
+            # rows); NaN marks "no label" downstream
+            val = rec.get(cols.response)
+            labels.append(float("nan") if val is None else float(val))
         offsets.append(float(rec[cols.offset])
                        if rec.get(cols.offset) is not None else 0.0)
         weights.append(float(rec[cols.weight])
@@ -126,7 +139,7 @@ def _rows_to_host_sparse(rows: List[List[Tuple[int, float]]], dim: int) -> HostS
 def write_training_examples(
     path: str,
     features: Iterable[Iterable[Tuple[str, str, float]]],
-    labels: Sequence[float],
+    labels: Optional[Sequence[float]] = None,
     offsets: Optional[Sequence[float]] = None,
     weights: Optional[Sequence[float]] = None,
     entity_ids: Optional[Dict[str, Sequence]] = None,
@@ -134,14 +147,15 @@ def write_training_examples(
     codec: str = "deflate",
 ) -> None:
     """Write TrainingExampleAvro records; ``features`` yields per-row lists
-    of (name, term, value)."""
+    of (name, term, value). ``labels=None`` writes unlabeled scoring data."""
     entity_ids = entity_ids or {}
 
     def records():
-        for i, (row, label) in enumerate(zip(features, labels)):
+        for i, row in enumerate(features):
+            label = None if labels is None else labels[i]
             yield {
                 "uid": str(uids[i]) if uids is not None else str(i),
-                "response": float(label),
+                "response": None if label is None else float(label),
                 "offset": float(offsets[i]) if offsets is not None else None,
                 "weight": float(weights[i]) if weights is not None else None,
                 "features": [

@@ -24,7 +24,8 @@ TRAINING_EXAMPLE_SCHEMA = {
     "name": "TrainingExampleAvro",
     "fields": [
         {"name": "uid", "type": ["null", "string", "long"], "default": None},
-        {"name": "response", "type": "double"},
+        # nullable: scoring inputs may be unlabeled; training requires it
+        {"name": "response", "type": ["null", "double"], "default": None},
         {"name": "offset", "type": ["null", "double"], "default": None},
         {"name": "weight", "type": ["null", "double"], "default": None},
         {"name": "features", "type": {"type": "array", "items": FEATURE_SCHEMA}},

@@ -68,3 +68,41 @@ def test_scoring_hashed_model(tmp_path, rng):
            for l in (sout / "photon.log.jsonl").read_text().splitlines()]
     ev = [r for r in log if r["event"] == "evaluation"][0]
     assert ev["auc"] > 0.75  # training-set AUC through the hashed space
+
+
+def test_scoring_unlabeled_data(tmp_path, rng):
+    X, y = _fixture(tmp_path, rng)
+    out = tmp_path / "model"
+    assert glm_main([
+        "--train-data", str(tmp_path / "train.avro"),
+        "--output-dir", str(out), "--reg-weights", "1.0",
+        "--dtype", "float64",
+    ]) == 0
+    # unlabeled scoring set (labels=None)
+    from photon_ml_tpu.io.data_reader import feature_tuples_from_dense as ftd
+    write_training_examples(str(tmp_path / "unlabeled.avro"), ftd(X[:50]),
+                            labels=None)
+    sout = tmp_path / "scores-unlabeled"
+    assert score_main([
+        "--data", str(tmp_path / "unlabeled.avro"),
+        "--model-dir", str(out / "best"),
+        "--output-dir", str(sout),
+        "--evaluators", "auc",  # skipped: nothing labeled
+        "--dtype", "float64",
+    ]) == 0
+    recs, _ = read_avro_file(str(sout / "scores.avro"))
+    assert len(recs) == 50
+    assert all(r["label"] is None for r in recs)
+    assert all(np.isfinite(r["predictionScore"]) for r in recs)
+    log_text = (sout / "photon.log.jsonl").read_text()
+    assert "evaluation_skipped" in log_text
+
+    # training on unlabeled data must fail loudly
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="must be labeled"):
+        glm_main([
+            "--train-data", str(tmp_path / "unlabeled.avro"),
+            "--output-dir", str(tmp_path / "bad"),
+            "--reg-weights", "1.0",
+        ])
