@@ -33,6 +33,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-dir", required=True)
     p.add_argument("--output-dir", required=True)
     p.add_argument("--evaluators", nargs="*", default=())
+    p.add_argument("--group-column", default=None,
+                   help="metadataMap column keying grouped (Multi-) "
+                        "evaluators, e.g. a query id for per_group_auc")
     p.add_argument("--per-coordinate-scores", action="store_true",
                    help="include a per-coordinate score breakdown")
     p.add_argument("--input-columns", default=None,
@@ -70,6 +73,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         c.entity_column for c in model.coordinates.values()
         if isinstance(c, RandomEffectModel) and c.entity_column
     ]
+    if args.group_column and args.group_column not in entity_columns:
+        entity_columns = entity_columns + [args.group_column]
 
     from photon_ml_tpu.cli.game_training_driver import _load_input_columns
 
@@ -124,10 +129,12 @@ def main(argv: Sequence[str] | None = None) -> int:
     if args.evaluators and not labeled.any():
         logger.log("evaluation_skipped", reason="no labeled rows")
     else:
+        group_ids = (ents[args.group_column][labeled]
+                     if args.group_column else None)
         for name in args.evaluators:
             ev = get_evaluator(name)
             metrics[name] = ev.evaluate(scores[labeled], labels[labeled],
-                                        weights[labeled])
+                                        weights[labeled], group_ids)
     if metrics:
         logger.log("evaluation", **metrics)
     logger.log("driver_done", num_scored=len(scores))

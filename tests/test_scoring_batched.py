@@ -106,3 +106,35 @@ def test_scoring_unlabeled_data(tmp_path, rng):
             "--output-dir", str(tmp_path / "bad"),
             "--reg-weights", "1.0",
         ])
+
+
+def test_scoring_grouped_evaluator(tmp_path, rng):
+    n, d = 300, 8
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
+    queries = rng.integers(0, 10, size=n)
+    write_training_examples(
+        str(tmp_path / "train.avro"), feature_tuples_from_dense(X), y,
+        entity_ids={"queryId": queries},
+    )
+    out = tmp_path / "model"
+    assert glm_main([
+        "--train-data", str(tmp_path / "train.avro"),
+        "--output-dir", str(out), "--reg-weights", "1.0",
+        "--dtype", "float64",
+    ]) == 0
+    sout = tmp_path / "scores-grouped"
+    assert score_main([
+        "--data", str(tmp_path / "train.avro"),
+        "--model-dir", str(out / "best"),
+        "--output-dir", str(sout),
+        "--evaluators", "auc", "per_group_auc",
+        "--group-column", "queryId",
+        "--dtype", "float64",
+    ]) == 0
+    log = [json.loads(l)
+           for l in (sout / "photon.log.jsonl").read_text().splitlines()]
+    ev = [r for r in log if r["event"] == "evaluation"][0]
+    assert ev["auc"] > 0.75
+    assert 0.5 < ev["per_group_auc"] <= 1.0
