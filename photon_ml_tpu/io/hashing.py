@@ -13,9 +13,12 @@ is FNV-1a 64 over the utf-8 feature key — the same function the native
 store uses, and stable by construction (Python's ``hash`` is per-process
 randomized and unusable here).
 
-Saved models name hashed coefficients ``(HASH <index>)``; ``index_of``
-recognizes that form, so model save/load round-trips without the original
-feature names (which a hashing map never sees).
+Saved models name hashed coefficients ``(HASH <index>)``; the model-load
+path calls ``model_index_of`` which recognizes that form, so model
+save/load round-trips without the original feature names (which a hashing
+map never sees). Plain ``index_of`` always hashes — a real data feature
+that happens to be literally named ``(HASH n)`` is treated like any other
+feature, never routed directly to slot ``n``.
 """
 
 from __future__ import annotations
@@ -59,16 +62,21 @@ class HashingIndexMap:
     def index_of(self, name: str, term: str = "") -> Optional[int]:
         if name == INTERCEPT_KEY:
             return self._intercept if self._intercept >= 0 else None
+        key = feature_key(name, term)
+        return fnv1a_64(key.encode("utf-8")) % self._hash_dim
+
+    def model_index_of(self, name: str, term: str = "") -> Optional[int]:
+        """``index_of`` plus recognition of the synthetic ``(HASH n)`` names
+        this map writes into saved models. Only the model-load path calls
+        this, so user data named ``(HASH n)`` cannot alias slot ``n``."""
         if name.startswith(_HASH_NAME_PREFIX) and name.endswith(")") and not term:
-            # round-trip of a saved hashed-model coefficient name
             try:
                 idx = int(name[len(_HASH_NAME_PREFIX):-1])
             except ValueError:
                 idx = -1
             if 0 <= idx < self.size:
                 return idx
-        key = feature_key(name, term)
-        return fnv1a_64(key.encode("utf-8")) % self._hash_dim
+        return self.index_of(name, term)
 
     def inverse(self) -> Dict[int, str]:
         """Synthetic names — hashing is not invertible."""

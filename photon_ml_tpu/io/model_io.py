@@ -46,6 +46,14 @@ def _sketch_records(w: np.ndarray):
             for j in np.nonzero(w)[0]]
 
 
+def _model_index_of(imap, name: str, term: str):
+    """Model-load index resolution: lets backends recognize synthetic
+    coefficient names they wrote (e.g. HashingIndexMap's ``(HASH n)``)
+    without exposing that aliasing to data ingestion."""
+    fn = getattr(imap, "model_index_of", None)
+    return fn(name, term) if fn is not None else imap.index_of(name, term)
+
+
 def _coef_records(w: np.ndarray, inverse: Dict[int, str]):
     out = []
     for idx in np.nonzero(w)[0]:
@@ -163,14 +171,14 @@ def load_game_model(directory: str) -> GameModel:
             rec = records[0]
             w = np.zeros(imap.size)
             for coef in rec["means"]:
-                idx = imap.index_of(coef["name"], coef.get("term", ""))
+                idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
                 if idx is not None:
                     w[idx] = coef["value"]
             var = None
             if rec.get("variances"):
                 var = np.zeros(imap.size)
                 for coef in rec["variances"]:
-                    idx = imap.index_of(coef["name"], coef.get("term", ""))
+                    idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
                     if idx is not None:
                         var[idx] = coef["value"]
             coords[c["name"]] = FixedEffectModel(
@@ -203,13 +211,13 @@ def _rebuild_random_effect(name, records, imap: IndexMap, task, shard,
     for rec in records:
         ids, vals, variances = [], [], {}
         for coef in rec["means"]:
-            idx = imap.index_of(coef["name"], coef.get("term", ""))
+            idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
             if idx is not None:
                 ids.append(idx)
                 vals.append(coef["value"])
         if rec.get("variances"):
             for coef in rec["variances"]:
-                idx = imap.index_of(coef["name"], coef.get("term", ""))
+                idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
                 if idx is not None:
                     variances[idx] = coef["value"]
         order = np.argsort(ids)

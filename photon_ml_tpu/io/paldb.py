@@ -181,13 +181,19 @@ class PersistentIndexMap:
 
 
 def load_index_map(path: str):
-    """Open either backend by sniffing the file: native store (magic bytes)
-    or JSON. Drivers use this so --index-map takes either format."""
+    """Open either backend by sniffing the file: native store (binary magic)
+    or JSON, dispatched on the parsed top-level key — never on raw-byte
+    substrings, which key order/whitespace or feature names could fool.
+    Drivers use this so --index-map takes any format."""
     with open(path, "rb") as f:
-        head = f.read(16)
-    if head[:1] != b"{":  # native store starts with its binary magic
+        head = f.read(1)
+    if head != b"{":  # native store starts with its binary magic
         return PersistentIndexMap(path)
-    if b'"hashing"' in head:
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    if "hashing" in doc:
         from photon_ml_tpu.io.hashing import HashingIndexMap
 
         return HashingIndexMap.load(path)

@@ -19,8 +19,13 @@ def test_hashing_map_basics(tmp_path):
     assert m.index_of("age") == i1  # deterministic
     assert m.index_of("age", "25") != i1 or True  # name+term hashes the pair
     assert m.index_of("(INTERCEPT)") == 1000
-    # synthetic coefficient names round-trip (model save/load path)
-    assert m.index_of(f"(HASH {i1})") == i1
+    # synthetic coefficient names round-trip — but only on the model-load
+    # path (model_index_of); plain index_of must treat a user feature
+    # literally named "(HASH n)" like any other feature (no slot aliasing)
+    assert m.model_index_of(f"(HASH {i1})") == i1
+    assert m.index_of(f"(HASH {i1})") == (
+        fnv1a_64(f"(HASH {i1})".encode()) % 1000
+    )
     # save/load
     p = str(tmp_path / "hash.json")
     m.save(p)
