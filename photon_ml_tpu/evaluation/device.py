@@ -1,0 +1,113 @@
+"""On-device (jit/shard_map-friendly) evaluation metrics.
+
+The host evaluators (``evaluators.py``) are exact f64 references, but at
+10⁹ scored rows a single-threaded host mergesort is a wall (SURVEY.md
+§3.2: the reference evaluates with Spark jobs). Device-side equivalents:
+
+- ``device_auc``: exact weighted mid-rank AUC as one jitted XLA program
+  (device sort + segment ops). Single-device; use for up to ~10⁸ rows
+  resident in HBM.
+- ``histogram_auc_contrib`` / ``histogram_auc``: sharded AUC by weighted
+  score histograms. The per-shard contribution is two fixed-width
+  histograms (positives / negatives), which are ``psum``-reducible over
+  the mesh — the `treeAggregate`-replacement pattern (SURVEY.md §5.8) —
+  after which the AUC follows from cumulative sums with the standard
+  within-bin tie (trapezoid) correction. Exact when every tied-score pair
+  lands in one bin (in particular for discrete/quantized scores); error is
+  otherwise O(within-bin mass²). Use ``device_auc`` when exactness
+  matters and the data fits on one device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def device_auc(scores, labels, weights):
+    """Exact weighted AUC with average-rank tie handling, on device.
+
+    Matches ``evaluators.auc`` (the host f64 reference) up to dtype: ties
+    share the weighted mid-rank of their tied-score block. Returns nan for
+    single-class inputs.
+    """
+    pos = labels > 0.5
+    w_pos = jnp.sum(jnp.where(pos, weights, 0.0))
+    w_neg = jnp.sum(jnp.where(pos, 0.0, weights))
+    order = jnp.argsort(scores, stable=True)
+    s, w, p = scores[order], weights[order], pos[order]
+    cw = jnp.cumsum(w)
+    ranks = cw - w / 2.0
+    block_start = jnp.concatenate(
+        (jnp.ones((1,), bool), s[1:] != s[:-1]))
+    block_id = jnp.cumsum(block_start) - 1
+    n = s.shape[0]
+    block_w = jnp.zeros(n, w.dtype).at[block_id].add(w)
+    block_rw = jnp.zeros(n, w.dtype).at[block_id].add(ranks * w)
+    ranks = (block_rw / block_w)[block_id]
+    r_pos = jnp.sum(jnp.where(p, w * ranks, 0.0))
+    out = (r_pos - w_pos * w_pos / 2.0) / (w_pos * w_neg)
+    return jnp.where((w_pos > 0) & (w_neg > 0), out, jnp.nan)
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def histogram_auc_contrib(scores, labels, weights, lo, hi, n_bins=4096):
+    """Per-shard AUC contribution: weighted histograms of positive and
+    negative scores over [lo, hi] with ``n_bins`` equal bins. The outputs
+    are elementwise-additive across shards — reduce with ``psum`` (inside
+    shard_map) or plain ``+`` (host), then finish with
+    ``histogram_auc_from_hists``. Rows may carry weight 0 (padding)."""
+    pos = labels > 0.5
+    width = (hi - lo) / n_bins
+    bins = jnp.clip(((scores - lo) / width).astype(jnp.int32), 0, n_bins - 1)
+    pos_hist = jnp.zeros(n_bins, weights.dtype).at[bins].add(
+        jnp.where(pos, weights, 0.0))
+    neg_hist = jnp.zeros(n_bins, weights.dtype).at[bins].add(
+        jnp.where(pos, 0.0, weights))
+    return pos_hist, neg_hist
+
+
+@jax.jit
+def histogram_auc_from_hists(pos_hist, neg_hist):
+    """AUC from reduced histograms: P(score⁺ > score⁻) + ½P(tie), treating
+    all mass within one bin as tied (trapezoid / mid-rank rule)."""
+    w_pos = jnp.sum(pos_hist)
+    w_neg = jnp.sum(neg_hist)
+    neg_below = jnp.concatenate(
+        (jnp.zeros((1,), neg_hist.dtype), jnp.cumsum(neg_hist)[:-1]))
+    pairs = jnp.sum(pos_hist * (neg_below + neg_hist / 2.0))
+    return jnp.where((w_pos > 0) & (w_neg > 0),
+                     pairs / (w_pos * w_neg), jnp.nan)
+
+
+def histogram_auc(scores, labels, weights=None, n_bins=4096, mesh=None):
+    """Sharded/histogram AUC driver. With a mesh, the histogram reduction
+    rides the mesh's collectives via sharded inputs; XLA turns the
+    segment-sum over sharded rows into per-shard sums + all-reduce."""
+    scores = jnp.asarray(scores)
+    labels = jnp.asarray(labels)
+    weights = (jnp.ones_like(scores) if weights is None
+               else jnp.asarray(weights))
+    lo = jnp.min(scores)
+    hi = jnp.max(scores)
+    hi = jnp.where(hi > lo, hi, lo + 1.0)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_axis = mesh.axis_names[0]
+        sharding = NamedSharding(mesh, P(data_axis))
+        n_dev = mesh.devices.size
+        pad = (-len(scores)) % n_dev
+        if pad:
+            scores = jnp.concatenate((scores, jnp.zeros(pad, scores.dtype)))
+            labels = jnp.concatenate((labels, jnp.zeros(pad, labels.dtype)))
+            weights = jnp.concatenate((weights, jnp.zeros(pad, weights.dtype)))
+        scores = jax.device_put(scores, sharding)
+        labels = jax.device_put(labels, sharding)
+        weights = jax.device_put(weights, sharding)
+    ph, nh = histogram_auc_contrib(scores, labels, weights, lo, hi,
+                                   n_bins=n_bins)
+    return histogram_auc_from_hists(ph, nh)

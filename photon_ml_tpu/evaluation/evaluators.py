@@ -24,6 +24,12 @@ class Evaluator:
     fn: Callable  # (scores, labels, weights) -> float
     higher_is_better: bool
     grouped: bool = False  # average the metric over groups (Multi- variant)
+    # vectorized grouped implementation: (scores, labels, weights,
+    # inverse_group_indices, n_groups) -> per-group value array (nan = skip).
+    # Grouped evaluation is segment-op based — a Python loop over np.unique
+    # groups walls at 1e5+ query groups (SURVEY.md §3.2) — with the loop
+    # kept only for fns without a registered vectorized form.
+    grouped_fn: Optional[Callable] = None
 
     def evaluate(self, scores, labels, weights=None, group_ids=None) -> float:
         scores = np.asarray(scores, np.float64)
@@ -37,9 +43,15 @@ class Evaluator:
         if group_ids is None:
             raise ValueError(f"evaluator '{self.name}' needs group_ids")
         group_ids = np.asarray(group_ids)
+        _, inv = np.unique(group_ids, return_inverse=True)
+        n_groups = int(inv.max()) + 1 if len(inv) else 0
+        if self.grouped_fn is not None:
+            vals = self.grouped_fn(scores, labels, weights, inv, n_groups)
+            vals = vals[np.isfinite(vals)]
+            return float(np.mean(vals)) if len(vals) else float("nan")
         vals = []
-        for g in np.unique(group_ids):
-            m = group_ids == g
+        for g in range(n_groups):
+            m = inv == g
             v = self.fn(scores[m], labels[m], weights[m])
             if v is not None and np.isfinite(v):
                 vals.append(v)
@@ -89,6 +101,74 @@ def auc(scores, labels, weights):
     return (r_pos - w_pos * w_pos / 2.0) / (w_pos * w_neg)
 
 
+def grouped_auc(scores, labels, weights, inv, n_groups):
+    """Per-group weighted mid-rank AUC, fully vectorized: one lexsort by
+    (group, score) then segment ops — no per-group Python. Exactly matches
+    ``auc`` applied per group (ties share the weighted average rank within
+    a group's tied-score block); single-class groups come back nan."""
+    if n_groups == 0:
+        return np.empty(0)
+    pos = labels > 0.5
+    order = np.lexsort((scores, inv))
+    g, s, w, p = inv[order], scores[order], weights[order], pos[order]
+    counts = np.bincount(g, minlength=n_groups)
+    cw = np.cumsum(w)
+    starts = np.concatenate(([0], np.cumsum(counts[:-1])))
+    # cumulative weight before each group, broadcast to its rows
+    group_offset = np.repeat(np.concatenate(([0.0], cw[starts[1:] - 1]))
+                             if n_groups > 1 else np.zeros(1), counts)
+    ranks = cw - group_offset - w / 2.0
+    # collapse ties within a group: same average rank per tied-score block
+    block_start = np.concatenate(
+        ([True], (g[1:] != g[:-1]) | (s[1:] != s[:-1])))
+    block_id = np.cumsum(block_start) - 1
+    block_w = np.bincount(block_id, w)
+    block_rw = np.bincount(block_id, ranks * w)
+    ranks = (block_rw / block_w)[block_id]
+    w_pos = np.bincount(g, w * p, minlength=n_groups)
+    w_neg = np.bincount(g, w * ~p, minlength=n_groups)
+    r_pos = np.bincount(g, w * p * ranks, minlength=n_groups)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = (r_pos - w_pos * w_pos / 2.0) / (w_pos * w_neg)
+    out[(w_pos == 0) | (w_neg == 0)] = np.nan
+    return out
+
+
+def _grouped_weighted_mean(pointwise, post=None):
+    """Lift a pointwise loss row->value into a vectorized per-group
+    weighted-mean implementation (segment sums via bincount); ``post``
+    maps the per-group mean (e.g. sqrt for RMSE)."""
+
+    def fn(scores, labels, weights, inv, n_groups):
+        loss = pointwise(scores, labels)
+        num = np.bincount(inv, weights * loss, minlength=n_groups)
+        den = np.bincount(inv, weights, minlength=n_groups)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = num / den
+        return out if post is None else post(out)
+
+    return fn
+
+
+def grouped_precision_at_k(k: int):
+    """Vectorized per-group precision@k: one stable lexsort by
+    (group, -score), rank-within-group via segment offsets."""
+
+    def fn(scores, labels, weights, inv, n_groups):
+        if n_groups == 0:
+            return np.empty(0)
+        order = np.lexsort((-scores, inv))
+        g, lab = inv[order], labels[order]
+        counts = np.bincount(g, minlength=n_groups)
+        starts = np.concatenate(([0], np.cumsum(counts[:-1])))
+        rank = np.arange(len(g)) - np.repeat(starts, counts)
+        top = rank < k
+        hits = np.bincount(g[top], lab[top] > 0.5, minlength=n_groups)
+        return hits / np.minimum(counts, k)
+
+    return fn
+
+
 def rmse(scores, labels, weights):
     return np.sqrt(np.sum(weights * (scores - labels) ** 2) / weights.sum())
 
@@ -106,10 +186,13 @@ def squared_loss_metric(scores, labels, weights):
     return np.sum(weights * 0.5 * (scores - labels) ** 2) / weights.sum()
 
 
-def smoothed_hinge_loss_metric(scores, labels, weights):
+def _smoothed_hinge_pointwise(scores, labels):
     z = (2.0 * labels - 1.0) * scores
-    loss = np.where(z <= 0, 0.5 - z, np.where(z < 1, 0.5 * (1 - z) ** 2, 0.0))
-    return np.sum(weights * loss) / weights.sum()
+    return np.where(z <= 0, 0.5 - z, np.where(z < 1, 0.5 * (1 - z) ** 2, 0.0))
+
+
+def smoothed_hinge_loss_metric(scores, labels, weights):
+    return np.sum(weights * _smoothed_hinge_pointwise(scores, labels)) / weights.sum()
 
 
 def precision_at_k(k: int):
@@ -123,14 +206,26 @@ def precision_at_k(k: int):
 
 
 _BASE = {
-    "auc": Evaluator("auc", auc, higher_is_better=True),
-    "rmse": Evaluator("rmse", rmse, higher_is_better=False),
-    "logistic_loss": Evaluator("logistic_loss", logistic_loss_metric, higher_is_better=False),
-    "poisson_loss": Evaluator("poisson_loss", poisson_loss_metric, higher_is_better=False),
-    "squared_loss": Evaluator("squared_loss", squared_loss_metric, higher_is_better=False),
+    "auc": Evaluator("auc", auc, higher_is_better=True,
+                     grouped_fn=grouped_auc),
+    "rmse": Evaluator(
+        "rmse", rmse, higher_is_better=False,
+        grouped_fn=_grouped_weighted_mean(
+            lambda s, l: (s - l) ** 2, post=np.sqrt)),
+    "logistic_loss": Evaluator(
+        "logistic_loss", logistic_loss_metric, higher_is_better=False,
+        grouped_fn=_grouped_weighted_mean(
+            lambda s, l: np.logaddexp(0.0, s) - l * s)),
+    "poisson_loss": Evaluator(
+        "poisson_loss", poisson_loss_metric, higher_is_better=False,
+        grouped_fn=_grouped_weighted_mean(lambda s, l: np.exp(s) - l * s)),
+    "squared_loss": Evaluator(
+        "squared_loss", squared_loss_metric, higher_is_better=False,
+        grouped_fn=_grouped_weighted_mean(lambda s, l: 0.5 * (s - l) ** 2)),
     "smoothed_hinge_loss": Evaluator(
-        "smoothed_hinge_loss", smoothed_hinge_loss_metric, higher_is_better=False
-    ),
+        "smoothed_hinge_loss", smoothed_hinge_loss_metric,
+        higher_is_better=False,
+        grouped_fn=_grouped_weighted_mean(_smoothed_hinge_pointwise)),
 }
 
 # default evaluator per task (the reference ties it to TaskType)
@@ -154,6 +249,7 @@ def get_evaluator(name: str) -> Evaluator:
         return dataclasses.replace(inner, name=key, grouped=True)
     if key.startswith("precision_at_"):
         k = int(key[len("precision_at_") :])
-        return Evaluator(key, precision_at_k(k), higher_is_better=True)
+        return Evaluator(key, precision_at_k(k), higher_is_better=True,
+                         grouped_fn=grouped_precision_at_k(k))
     raise ValueError(f"unknown evaluator '{name}'; known: {sorted(_BASE)}, "
                      "per_group_<name>, precision_at_<k>")

@@ -10,6 +10,7 @@ for GAME random effects.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -61,9 +62,27 @@ def read_training_examples(
     Returns (features: dict shard->HostSparse, labels, offsets, weights,
     entity_ids: dict column->np.ndarray, uids: list). Features absent from a
     shard's index map are dropped for that shard (per-shard feature
-    selection, as in the reference's feature bags)."""
+    selection, as in the reference's feature bags).
+
+    Decoding runs through the native C++ decoder (io/native_reader.py —
+    the host-ingestion hot path, SURVEY.md §7) whenever the writer schema
+    and index-map backend support it, falling back to the pure-Python codec
+    otherwise. Set PHOTON_ML_TPU_NO_NATIVE=1 to force the Python path."""
     if not isinstance(index_maps, dict):  # any IndexMap-like backend
         index_maps = {"global": index_maps}
+    cols = columns or InputColumnsNames()
+    if not os.environ.get("PHOTON_ML_TPU_NO_NATIVE"):
+        from photon_ml_tpu.io.native_reader import (
+            NativeUnsupported,
+            read_training_examples_native,
+        )
+
+        try:
+            return read_training_examples_native(
+                paths, index_maps, entity_columns, cols, require_response
+            )
+        except NativeUnsupported:
+            pass
     rows_per_shard: Dict[str, List[List[Tuple[int, float]]]] = {
         s: [] for s in index_maps
     }
@@ -73,7 +92,6 @@ def read_training_examples(
     uids: List = []
     entity_vals: Dict[str, List] = {c: [] for c in entity_columns}
 
-    cols = columns or InputColumnsNames()
     for rec in iter_avro_records(paths):
         if require_response:
             val = rec.get(cols.response)

@@ -196,7 +196,8 @@ def load_index_map(path: str):
     if "hashing" in doc:
         from photon_ml_tpu.io.hashing import HashingIndexMap
 
-        return HashingIndexMap.load(path)
+        cfg = doc["hashing"]
+        return HashingIndexMap(cfg["dim"], add_intercept=cfg["add_intercept"])
     from photon_ml_tpu.io.index_map import IndexMap
 
-    return IndexMap.load(path)
+    return IndexMap(doc["features"])

@@ -21,9 +21,14 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _source_digest(src_path: str) -> str:
+def _source_digest(src_path: str, extra: tuple = ()) -> str:
+    """Digest of source + build flags — flag changes must rebuild too."""
+    h = hashlib.sha256()
     with open(src_path, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:16]
+        h.update(f.read())
+    for item in extra:
+        h.update(item.encode())
+    return h.hexdigest()[:16]
 
 
 def _build_dir() -> str:
@@ -39,6 +44,10 @@ def _build_dir() -> str:
     return os.path.join(base, "photon_ml_tpu", "native")
 
 
+# extra link flags per native library
+_LINK_FLAGS = {"avro_decoder": ("-lz",)}
+
+
 def build_library(name: str, *, cxx: str | None = None) -> str:
     """Compile ``<name>.cpp`` into a cached ``.so`` and return its path.
     The cache key includes a source digest, so editing the .cpp rebuilds."""
@@ -46,14 +55,15 @@ def build_library(name: str, *, cxx: str | None = None) -> str:
     if not os.path.exists(src):
         raise NativeBuildError(f"no such native source: {src}")
     out_dir = _build_dir()
-    lib = os.path.join(out_dir, f"lib{name}-{_source_digest(src)}.so")
+    flags = _LINK_FLAGS.get(name, ())
+    lib = os.path.join(out_dir, f"lib{name}-{_source_digest(src, flags)}.so")
     with _BUILD_LOCK:
         if os.path.exists(lib):
             return lib
         os.makedirs(out_dir, exist_ok=True)
         cxx = cxx or os.environ.get("CXX", "g++")
         cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", src, "-o",
-               lib + ".tmp"]
+               lib + ".tmp", *flags]
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True)
         except FileNotFoundError as e:

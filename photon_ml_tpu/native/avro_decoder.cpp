@@ -22,6 +22,10 @@
 //   0x07 CAP_FEATURES       array<record{name:string, term:string, value:double}>
 //   0x08 CAP_METADATA       map<string,string>; keys matched against the
 //                           requested entity columns
+//   0x09 CAP_UID u8 is_union, u8 n, then n branch kinds (0=null 1=string
+//                           2=long); a union uid reads a branch index from
+//                           the stream (Avro writes one even for 1-branch
+//                           unions), a plain uid does not
 //   0x10 SKIP_NULL  0x11 SKIP_BOOL  0x12 SKIP_VARINT  0x13 SKIP_FLOAT
 //   0x14 SKIP_DOUBLE  0x15 SKIP_BYTES (string/bytes)
 //   0x16 SKIP_UNION u8 n, then n sub-opcodes (branch dispatch)
@@ -40,23 +44,25 @@
 #include <vector>
 #include <zlib.h>
 
-extern "C" {
-int32_t fis_lookup(void* handle, const char* key, uint32_t len);
-}
+// Feature-index-store lookup, passed in as a function pointer by Python
+// (ctypes address of fis_lookup from the separately-loaded
+// feature_index_store library) so this library has no undefined externs
+// and dlopens standalone.
+using fis_lookup_fn = int32_t (*)(void*, const char*, uint32_t);
 
 namespace {
 
 constexpr uint8_t CAP_LABEL_D = 0x01, CAP_LABEL_ND = 0x02, CAP_OFFSET_D = 0x03,
                   CAP_OFFSET_ND = 0x04, CAP_WEIGHT_D = 0x05,
                   CAP_WEIGHT_ND = 0x06, CAP_FEATURES = 0x07,
-                  CAP_METADATA = 0x08;
+                  CAP_METADATA = 0x08, CAP_UID = 0x09;
 constexpr uint8_t SKIP_NULL = 0x10, SKIP_BOOL = 0x11, SKIP_VARINT = 0x12,
                   SKIP_FLOAT = 0x13, SKIP_DOUBLE = 0x14, SKIP_BYTES = 0x15,
                   SKIP_UNION = 0x16, SKIP_ARRAY = 0x17, SKIP_MAP = 0x18,
                   SKIP_RECORD = 0x19;
 
-constexpr uint64_t kFnvOffset = 1469598103934665603ULL;
-constexpr uint64_t kFnvPrime = 1099511628211ULL;
+constexpr uint64_t kFnvOffset = 0xCBF29CE484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001B3ULL;
 
 struct Cursor {
   const uint8_t* p;
@@ -103,15 +109,20 @@ struct EntityCol {
   // per-row value bytes (concatenated) + offsets
   std::vector<uint8_t> blob;
   std::vector<uint64_t> offsets;  // size rows+1
+  std::vector<uint8_t> present;   // per row: key present in metadataMap
 };
 
 struct Output {
   std::vector<double> labels, offsets, weights;
   std::vector<uint8_t> has_label;
-  std::vector<int32_t> feat_counts;   // per row
-  std::vector<int32_t> feat_indices;  // concatenated; -1 = dropped feature
+  std::vector<int32_t> feat_counts;  // per row
+  // per shard: one resolved index per feature occurrence (-1 = dropped);
+  // the name/term/value walk happens once, resolution fans out per shard
+  std::vector<std::vector<int32_t>> feat_indices;
   std::vector<double> feat_values;
   std::vector<EntityCol> entities;
+  EntityCol uid;                      // per-row uid bytes (string/long text)
+  std::vector<uint8_t> uid_kind;      // 0=null, 1=string, 2=long
   uint64_t rows = 0;
   std::string error;
 };
@@ -269,13 +280,14 @@ double read_nullable_double(Cursor& c, uint8_t null_branch, bool* present) {
 }
 
 struct FeatureResolver {
-  void* fis;          // feature_index_store handle, may be null
-  int64_t hash_dim;   // >0: FNV hash % dim when no store
-  char sep;           // name/term separator (\x01)
+  void* fis;             // feature_index_store handle, may be null
+  fis_lookup_fn lookup;  // its lookup entry point (ctypes-provided)
+  int64_t hash_dim;      // >0: FNV hash % dim when no store
+  char sep;              // name/term separator (\x01)
 
   int32_t resolve(const uint8_t* name, size_t nlen, const uint8_t* term,
                   size_t tlen) const {
-    if (fis) {
+    if (fis && lookup) {
       // key = name [sep term]
       char stack_buf[256];
       std::vector<char> heap_buf;
@@ -290,7 +302,7 @@ struct FeatureResolver {
         key[nlen] = sep;
         std::memcpy(key + nlen + 1, term, tlen);
       }
-      return fis_lookup(fis, key, static_cast<uint32_t>(klen));
+      return lookup(fis, key, static_cast<uint32_t>(klen));
     }
     if (hash_dim > 0) {
       uint64_t h = fnv1a(name, nlen);
@@ -305,8 +317,10 @@ struct FeatureResolver {
   }
 };
 
-// Decode the features array: record{name, term, value} items.
-void decode_features(Cursor& c, const FeatureResolver& fr, Output& out) {
+// Decode the features array: record{name, term, value} items, resolving
+// each feature against every shard's resolver in one walk.
+void decode_features(Cursor& c, const std::vector<FeatureResolver>& frs,
+                     Output& out) {
   int32_t count = 0;
   while (!c.fail) {
     int64_t n = c.read_long();
@@ -331,9 +345,11 @@ void decode_features(Cursor& c, const FeatureResolver& fr, Output& out) {
       const uint8_t* term = c.p;
       c.p += tlen;
       double value = c.read_double();
-      int32_t idx = fr.resolve(name, static_cast<size_t>(nlen), term,
-                               static_cast<size_t>(tlen));
-      out.feat_indices.push_back(idx);
+      for (size_t s = 0; s < frs.size(); ++s) {
+        out.feat_indices[s].push_back(
+            frs[s].resolve(name, static_cast<size_t>(nlen), term,
+                           static_cast<size_t>(tlen)));
+      }
       out.feat_values.push_back(value);
       ++count;
     }
@@ -371,6 +387,7 @@ void decode_metadata(Cursor& c, Output& out, uint64_t row) {
             std::memcmp(col.key.data(), key, klen) == 0) {
           col.blob.insert(col.blob.end(), val, val + vlen);
           col.offsets.push_back(col.blob.size());
+          col.present.push_back(1);
         }
       }
     }
@@ -378,7 +395,7 @@ void decode_metadata(Cursor& c, Output& out, uint64_t row) {
 }
 
 bool decode_record(Cursor& c, const uint8_t* prog, const uint8_t* prog_end,
-                   const FeatureResolver& fr, Output& out) {
+                   const std::vector<FeatureResolver>& frs, Output& out) {
   uint64_t row = out.rows;
   bool saw_features = false, saw_meta = false;
   double label = 0.0, offset = 0.0, weight = 1.0;
@@ -411,13 +428,44 @@ bool decode_record(Cursor& c, const uint8_t* prog, const uint8_t* prog_end,
         if (!present) weight = 1.0;
         break;
       case CAP_FEATURES:
-        decode_features(c, fr, out);
+        decode_features(c, frs, out);
         saw_features = true;
         break;
       case CAP_METADATA:
         decode_metadata(c, out, row);
         saw_meta = true;
         break;
+      case CAP_UID: {
+        // program: u8 is_union, u8 n, then n branch kinds (0=null 1=string
+        // 2=long); unions carry a branch index in the stream even when they
+        // have a single branch
+        uint8_t is_union = *p++;
+        uint8_t n = *p++;
+        int64_t branch = is_union ? c.read_long() : 0;
+        if (branch < 0 || branch >= n) {
+          c.fail = true;
+          break;
+        }
+        uint8_t kind = p[branch];
+        p += n;
+        if (kind == 1) {  // string
+          int64_t len = c.read_long();
+          if (len < 0 || !c.need(static_cast<size_t>(len))) {
+            c.fail = true;
+            break;
+          }
+          out.uid.blob.insert(out.uid.blob.end(), c.p, c.p + len);
+          c.p += len;
+        } else if (kind == 2) {  // long -> decimal text
+          char buf[24];
+          int len = std::snprintf(buf, sizeof(buf), "%lld",
+                                  static_cast<long long>(c.read_long()));
+          out.uid.blob.insert(out.uid.blob.end(), buf, buf + len);
+        }
+        out.uid.offsets.push_back(out.uid.blob.size());
+        out.uid_kind.push_back(kind);
+        break;
+      }
       default:
         --p;
         skip_value(c, p, prog_end);
@@ -426,8 +474,10 @@ bool decode_record(Cursor& c, const uint8_t* prog, const uint8_t* prog_end,
   if (c.fail) return false;
   if (!saw_features) out.feat_counts.push_back(0);
   for (auto& col : out.entities) {
-    if (col.offsets.size() == row + 1)  // column absent for this row
+    if (col.offsets.size() == row + 1) {  // column absent for this row
       col.offsets.push_back(col.blob.size());
+      col.present.push_back(0);
+    }
   }
   (void)saw_meta;
   out.labels.push_back(label);
@@ -476,8 +526,10 @@ extern "C" {
 //                    fis_handle, hash_dim) -> 0 on success
 //   getters + avd_free
 void* avd_create(const char* keys_blob, const uint32_t* key_lens,
-                 uint32_t n_keys) {
+                 uint32_t n_keys, uint32_t n_shards) {
   Output* out = new Output();
+  out->uid.offsets.push_back(0);
+  out->feat_indices.resize(n_shards ? n_shards : 1);
   size_t at = 0;
   for (uint32_t i = 0; i < n_keys; ++i) {
     EntityCol col;
@@ -489,10 +541,18 @@ void* avd_create(const char* keys_blob, const uint32_t* key_lens,
   return out;
 }
 
+// One resolver triple (fis handle, lookup fn, hash_dim) per feature shard;
+// the record walk happens once, feature resolution fans out to all shards.
 int avd_decode_block(void* handle, const uint8_t* data, uint64_t len,
                      int codec_deflate, int64_t n_records, const uint8_t* prog,
-                     uint32_t prog_len, void* fis_handle, int64_t hash_dim) {
+                     uint32_t prog_len, void* const* fis_handles,
+                     void* const* fis_lookup_ptrs, const int64_t* hash_dims,
+                     uint32_t n_shards) {
   Output* out = static_cast<Output*>(handle);
+  if (n_shards != out->feat_indices.size()) {
+    out->error = "shard count mismatch vs avd_create";
+    return -3;
+  }
   std::vector<uint8_t> scratch;
   const uint8_t* payload = data;
   size_t payload_len = static_cast<size_t>(len);
@@ -505,9 +565,15 @@ int avd_decode_block(void* handle, const uint8_t* data, uint64_t len,
     payload_len = scratch.size();
   }
   Cursor c{payload, payload + payload_len};
-  FeatureResolver fr{fis_handle, hash_dim, '\x01'};
+  std::vector<FeatureResolver> frs;
+  for (uint32_t s = 0; s < n_shards; ++s) {
+    frs.push_back(FeatureResolver{
+        fis_handles[s],
+        reinterpret_cast<fis_lookup_fn>(fis_lookup_ptrs[s]),
+        hash_dims[s], '\x01'});
+  }
   for (int64_t i = 0; i < n_records; ++i) {
-    if (!decode_record(c, prog, prog + prog_len, fr, *out)) {
+    if (!decode_record(c, prog, prog + prog_len, frs, *out)) {
       out->error = "record decode failed at row " +
                    std::to_string(out->rows);
       return -2;
@@ -518,7 +584,7 @@ int avd_decode_block(void* handle, const uint8_t* data, uint64_t len,
 
 uint64_t avd_rows(void* handle) { return static_cast<Output*>(handle)->rows; }
 uint64_t avd_nnz(void* handle) {
-  return static_cast<Output*>(handle)->feat_indices.size();
+  return static_cast<Output*>(handle)->feat_values.size();
 }
 const double* avd_labels(void* handle) {
   return static_cast<Output*>(handle)->labels.data();
@@ -535,8 +601,10 @@ const double* avd_weights(void* handle) {
 const int32_t* avd_feat_counts(void* handle) {
   return static_cast<Output*>(handle)->feat_counts.data();
 }
-const int32_t* avd_feat_indices(void* handle) {
-  return static_cast<Output*>(handle)->feat_indices.data();
+const int32_t* avd_feat_indices(void* handle, uint32_t shard) {
+  Output* out = static_cast<Output*>(handle);
+  if (shard >= out->feat_indices.size()) return nullptr;
+  return out->feat_indices[shard].data();
 }
 const double* avd_feat_values(void* handle) {
   return static_cast<Output*>(handle)->feat_values.data();
@@ -544,13 +612,24 @@ const double* avd_feat_values(void* handle) {
 const char* avd_error(void* handle) {
   return static_cast<Output*>(handle)->error.c_str();
 }
+int avd_uid(void* handle, const uint8_t** blob, const uint64_t** offsets,
+            const uint8_t** kinds, uint64_t* n) {
+  Output* out = static_cast<Output*>(handle);
+  *blob = out->uid.blob.data();
+  *offsets = out->uid.offsets.data();
+  *kinds = out->uid_kind.data();
+  *n = out->uid_kind.size();
+  return 0;
+}
 int avd_entity_col(void* handle, uint32_t col, const uint8_t** blob,
-                   const uint64_t** offsets, uint64_t* n) {
+                   const uint64_t** offsets, const uint8_t** present,
+                   uint64_t* n) {
   Output* out = static_cast<Output*>(handle);
   if (col >= out->entities.size()) return -1;
   EntityCol& e = out->entities[col];
   *blob = e.blob.data();
   *offsets = e.offsets.data();
+  *present = e.present.data();
   *n = e.offsets.size() - 1;
   return 0;
 }

@@ -1,0 +1,141 @@
+"""Scaled evaluation paths: vectorized grouped metrics (segment ops vs the
+per-group loop) and on-device / histogram AUC parity."""
+
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation import get_evaluator
+from photon_ml_tpu.evaluation.evaluators import Evaluator
+
+
+def _loop_reference(ev, scores, labels, weights, groups):
+    """The pre-vectorization semantics: metric per np.unique group, mean of
+    the finite values."""
+    import dataclasses
+
+    return dataclasses.replace(ev, grouped_fn=None).evaluate(
+        scores, labels, weights, groups)
+
+
+@pytest.mark.parametrize("name", [
+    "per_group_auc", "per_group_rmse", "per_group_logistic_loss",
+    "per_group_poisson_loss", "per_group_squared_loss",
+    "per_group_smoothed_hinge_loss", "per_group_precision_at_3",
+])
+def test_grouped_vectorized_matches_loop(rng, name):
+    n, n_groups = 2000, 60
+    scores = np.round(rng.normal(size=n), 1)  # ties within groups
+    labels = (rng.random(n) < 0.4).astype(float)
+    weights = rng.random(n) + 0.25
+    groups = rng.integers(0, n_groups, n).astype(str)
+    ev = get_evaluator(name)
+    assert ev.grouped_fn is not None, f"{name} should be vectorized"
+    got = ev.evaluate(scores, labels, weights, groups)
+    want = _loop_reference(ev, scores, labels, weights, groups)
+    assert np.isclose(got, want, rtol=1e-12, atol=1e-12)
+
+
+def test_grouped_auc_skips_degenerate_groups(rng):
+    # group 'a' all positive (skipped), group 'b' mixed
+    scores = np.array([0.1, 0.9, 0.2, 0.8, 0.3])
+    labels = np.array([1.0, 1.0, 0.0, 1.0, 0.0])
+    groups = np.array(["a", "a", "b", "b", "b"])
+    ev = get_evaluator("per_group_auc")
+    got = ev.evaluate(scores, labels, group_ids=groups)
+    want = get_evaluator("auc").evaluate(scores[2:], labels[2:])
+    assert np.isclose(got, want)
+
+
+def test_grouped_auc_scales(rng):
+    """1e6 rows / 1e5 groups in seconds, not minutes (the VERDICT target
+    scaled 10x down to keep CI fast — the loop version walls already here)."""
+    n, n_groups = 1_000_000, 100_000
+    scores = rng.normal(size=n)
+    labels = (rng.random(n) < 0.5).astype(float)
+    groups = rng.integers(0, n_groups, n)
+    ev = get_evaluator("per_group_auc")
+    t0 = time.perf_counter()
+    v = ev.evaluate(scores, labels, None, groups)
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(v) and 0.3 < v < 0.7
+    assert elapsed < 30, f"grouped AUC too slow: {elapsed:.1f}s"
+
+
+def test_custom_fn_falls_back_to_loop(rng):
+    """An evaluator without a vectorized form still works via the loop."""
+    calls = []
+
+    def fn(s, l, w):
+        calls.append(1)
+        return float(np.mean(s))
+
+    ev = Evaluator("custom", fn, higher_is_better=True, grouped=True)
+    scores = rng.normal(size=30)
+    groups = np.repeat(np.arange(5), 6)
+    v = ev.evaluate(scores, np.zeros(30), None, groups)
+    assert len(calls) == 5
+    assert np.isclose(v, np.mean([scores[groups == g].mean()
+                                  for g in range(5)]))
+
+
+# -- device-side AUC --------------------------------------------------------
+def test_device_auc_matches_host(rng):
+    from photon_ml_tpu.evaluation.device import device_auc
+
+    n = 4000
+    scores = np.round(rng.normal(size=n), 1)  # ties
+    labels = (rng.random(n) < 0.4).astype(float)
+    weights = rng.random(n) + 0.25
+    host = get_evaluator("auc").evaluate(scores, labels, weights)
+    dev = float(device_auc(scores, labels, weights))
+    assert np.isclose(dev, host, rtol=1e-9, atol=1e-9)
+
+
+def test_device_auc_degenerate():
+    from photon_ml_tpu.evaluation.device import device_auc
+
+    assert np.isnan(float(device_auc(
+        np.array([1.0, 2.0]), np.array([1.0, 1.0]), np.array([1.0, 1.0]))))
+
+
+def test_histogram_auc_exact_on_quantized_scores(rng):
+    """With discrete scores and bin edges that separate them, the histogram
+    AUC is exact (all ties share a bin)."""
+    from photon_ml_tpu.evaluation.device import histogram_auc
+
+    n = 3000
+    scores = rng.integers(0, 64, n).astype(float)
+    labels = (rng.random(n) < 0.5).astype(float)
+    weights = rng.random(n) + 0.5
+    host = get_evaluator("auc").evaluate(scores, labels, weights)
+    hist = float(histogram_auc(scores, labels, weights, n_bins=4096))
+    assert np.isclose(hist, host, rtol=1e-6, atol=1e-6)
+
+
+def test_histogram_auc_approximates_continuous(rng):
+    from photon_ml_tpu.evaluation.device import histogram_auc
+
+    n = 20000
+    scores = rng.normal(size=n)
+    labels = (rng.random(n) < 0.5 / (1 + np.exp(-scores))).astype(float)
+    host = get_evaluator("auc").evaluate(scores, labels)
+    hist = float(histogram_auc(scores, labels, n_bins=4096))
+    assert abs(hist - host) < 2e-3
+
+
+def test_histogram_auc_sharded_matches_single(rng):
+    """Sharded over the 8-device CPU mesh == single-device result (the
+    histogram reduction is exact under psum)."""
+    from photon_ml_tpu.evaluation.device import histogram_auc
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    n = 10000
+    scores = rng.normal(size=n)
+    labels = (rng.random(n) < 0.5).astype(float)
+    weights = rng.random(n) + 0.5
+    single = float(histogram_auc(scores, labels, weights))
+    sharded = float(histogram_auc(scores, labels, weights,
+                                  mesh=make_mesh()))
+    assert np.isclose(sharded, single, rtol=1e-10, atol=1e-10)

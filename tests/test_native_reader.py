@@ -1,0 +1,288 @@
+"""Native C++ Avro ingestion: parity with the pure-Python codec.
+
+The native decoder (native/avro_decoder.cpp + io/native_reader.py) is the
+host-side hot path (SURVEY.md §7); these tests pin its outputs to the
+Python reader's on randomized data across index-map backends, codecs and
+schema shapes, and check the fallback triggers for unsupported shapes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.data_reader import (
+    InputColumnsNames,
+    read_training_examples,
+    write_training_examples,
+)
+from photon_ml_tpu.io.hashing import HashingIndexMap
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.native_reader import (
+    NativeUnsupported,
+    read_training_examples_native,
+)
+
+
+def _random_rows(rng, n, vocab, max_k=8):
+    rows = []
+    for _ in range(n):
+        k = int(rng.integers(0, max_k))
+        feats = []
+        for _ in range(k):
+            name = f"f{int(rng.integers(0, vocab))}"
+            term = f"t{int(rng.integers(0, 3))}" if rng.random() < 0.5 else ""
+            feats.append((name, term, float(rng.normal())))
+        rows.append(feats)
+    return rows
+
+
+def _write(tmp_path, rng, n=200, codec="deflate", with_entities=True,
+           labels=True):
+    rows = _random_rows(rng, n, vocab=40)
+    path = str(tmp_path / "data.avro")
+    entity_ids = ({"userId": [f"u{int(rng.integers(0, 9))}" for _ in range(n)]}
+                  if with_entities else None)
+    write_training_examples(
+        path, rows,
+        labels=rng.integers(0, 2, n).astype(float) if labels else None,
+        offsets=rng.normal(size=n),
+        weights=rng.random(n) + 0.5,
+        entity_ids=entity_ids,
+        uids=[f"row-{i}" for i in range(n)],
+        codec=codec,
+    )
+    return path, rows
+
+
+def _build_index_map(rows, add_intercept=True):
+    from photon_ml_tpu.io.schemas import feature_key
+
+    keys = sorted({feature_key(name, term)
+                   for row in rows for name, term, _ in row})
+    return IndexMap({k: i for i, k in enumerate(keys)},
+                    add_intercept=add_intercept)
+
+
+def _assert_same(a, b):
+    fa, la, oa, wa, ea, ua = a
+    fb, lb, ob, wb, eb, ub = b
+    np.testing.assert_allclose(la, lb, rtol=0, atol=0)
+    np.testing.assert_allclose(oa, ob)
+    np.testing.assert_allclose(wa, wb)
+    assert ua == ub
+    assert set(ea) == set(eb)
+    for c in ea:
+        assert list(ea[c]) == list(eb[c])
+    assert set(fa) == set(fb)
+    for s in fa:
+        assert fa[s].dim == fb[s].dim
+        # padded layouts agree exactly (same per-row order and padding rule)
+        np.testing.assert_array_equal(fa[s].indices, fb[s].indices)
+        np.testing.assert_allclose(fa[s].values, fb[s].values)
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_native_parity_in_memory_map(tmp_path, rng, codec):
+    path, rows = _write(tmp_path, rng, codec=codec)
+    imap = _build_index_map(rows)
+    cols = InputColumnsNames()
+    native = read_training_examples_native(
+        path, {"global": imap}, ["userId"], cols, True)
+    os.environ["PHOTON_ML_TPU_NO_NATIVE"] = "1"
+    try:
+        python = read_training_examples(path, imap, ["userId"])
+    finally:
+        del os.environ["PHOTON_ML_TPU_NO_NATIVE"]
+    _assert_same(native, python)
+
+
+def test_native_parity_hashing_map(tmp_path, rng):
+    path, rows = _write(tmp_path, rng)
+    imap = HashingIndexMap(512)
+    cols = InputColumnsNames()
+    native = read_training_examples_native(
+        path, {"global": imap}, [], cols, True)
+    os.environ["PHOTON_ML_TPU_NO_NATIVE"] = "1"
+    try:
+        python = read_training_examples(path, imap)
+    finally:
+        del os.environ["PHOTON_ML_TPU_NO_NATIVE"]
+    _assert_same(native, python)
+
+
+def test_native_parity_persistent_store(tmp_path, rng):
+    from photon_ml_tpu.io.paldb import PersistentIndexMap
+
+    path, rows = _write(tmp_path, rng)
+    imap = _build_index_map(rows)
+    store = PersistentIndexMap.build(imap.forward,
+                                     str(tmp_path / "store.fis"))
+    cols = InputColumnsNames()
+    native = read_training_examples_native(
+        path, {"global": store}, ["userId"], cols, True)
+    os.environ["PHOTON_ML_TPU_NO_NATIVE"] = "1"
+    try:
+        python = read_training_examples(path, store, ["userId"])
+    finally:
+        del os.environ["PHOTON_ML_TPU_NO_NATIVE"]
+    _assert_same(native, python)
+
+
+def test_native_unlabeled_and_default_path(tmp_path, rng):
+    path, rows = _write(tmp_path, rng, labels=False)
+    imap = _build_index_map(rows)
+    # default read_training_examples dispatches to the native path
+    out = read_training_examples(path, imap, require_response=False)
+    assert np.isnan(out[1]).all()
+    with pytest.raises(ValueError, match="training data must be labeled"):
+        read_training_examples(path, imap, require_response=True)
+
+
+def test_native_multi_shard(tmp_path, rng):
+    path, rows = _write(tmp_path, rng)
+    full = _build_index_map(rows)
+    # second shard sees only even-numbered features (per-shard selection)
+    partial = _build_index_map(
+        [[(n, t, v) for n, t, v in row if int(n[1:]) % 2 == 0]
+         for row in rows])
+    maps = {"all": full, "even": partial}
+    cols = InputColumnsNames()
+    native = read_training_examples_native(path, maps, [], cols, True)
+    os.environ["PHOTON_ML_TPU_NO_NATIVE"] = "1"
+    try:
+        python = read_training_examples(path, maps)
+    finally:
+        del os.environ["PHOTON_ML_TPU_NO_NATIVE"]
+    _assert_same(native, python)
+
+
+def test_native_rejects_unsupported_schema(tmp_path, rng):
+    from photon_ml_tpu.io.avro import write_avro_file
+
+    # a record whose response is [null, string] cannot be captured natively
+    schema = {
+        "type": "record", "name": "Odd",
+        "fields": [
+            {"name": "response", "type": ["null", "string"]},
+            {"name": "features", "type": {"type": "array", "items": {
+                "type": "record", "name": "F",
+                "fields": [{"name": "name", "type": "string"},
+                           {"name": "term", "type": "string"},
+                           {"name": "value", "type": "double"}]}}},
+        ],
+    }
+    path = str(tmp_path / "odd.avro")
+    write_avro_file(path, [{"response": "yes", "features": []}], schema)
+    imap = IndexMap({"f0": 0})
+    with pytest.raises(NativeUnsupported):
+        read_training_examples_native(
+            path, {"global": imap}, [], InputColumnsNames(), False)
+
+
+def test_native_accepts_empty_entity_value(tmp_path, rng):
+    """A present-but-empty entity id must round-trip as '' (only truly
+    absent keys raise), matching the Python path."""
+    path = str(tmp_path / "empty-ent.avro")
+    write_training_examples(
+        path, [[("f0", "", 1.0)], [("f1", "", 2.0)]], labels=[0.0, 1.0],
+        entity_ids={"userId": ["", "u1"]})
+    imap = _build_index_map([[("f0", "", 1.0)], [("f1", "", 2.0)]])
+    native = read_training_examples_native(
+        path, {"global": imap}, ["userId"], InputColumnsNames(), True)
+    assert list(native[4]["userId"]) == ["", "u1"]
+
+
+def test_native_missing_features_field_falls_back(tmp_path):
+    """Schema without a features field: native path must refuse (fallback
+    then raises the Python KeyError) rather than yield intercept-only rows."""
+    from photon_ml_tpu.io.avro import write_avro_file
+
+    schema = {"type": "record", "name": "NoFeat",
+              "fields": [{"name": "response", "type": "double"}]}
+    path = str(tmp_path / "nofeat.avro")
+    write_avro_file(path, [{"response": 1.0}], schema)
+    imap = _build_index_map([])
+    with pytest.raises(NativeUnsupported):
+        read_training_examples_native(
+            path, {"global": imap}, [], InputColumnsNames(), True)
+    with pytest.raises(KeyError):
+        read_training_examples(path, imap)
+
+
+def test_native_no_temp_store_leak(tmp_path, rng):
+    """Temp .fis stores built for in-memory maps are removed even when a
+    later shard's backend is unsupported."""
+    import glob
+    import tempfile
+
+    class Opaque:
+        size = 3
+        intercept_index = -1
+
+        def index_of(self, name, term=""):
+            return None
+
+    path, rows = _write(tmp_path, rng, n=10)
+    imap = _build_index_map(rows)
+    before = set(glob.glob(os.path.join(tempfile.gettempdir(), "*.fis")))
+    with pytest.raises(NativeUnsupported):
+        read_training_examples_native(
+            path, {"a": imap, "b": Opaque()}, [], InputColumnsNames(), True)
+    after = set(glob.glob(os.path.join(tempfile.gettempdir(), "*.fis")))
+    assert before == after
+
+
+def test_native_uid_shapes(tmp_path):
+    """uid as plain string, single-branch union, and [null,string,long]
+    union all decode correctly (Avro writes a branch index for every union,
+    even 1-branch ones)."""
+    from photon_ml_tpu.io.avro import write_avro_file
+
+    feat = {"type": "array", "items": {
+        "type": "record", "name": "F",
+        "fields": [{"name": "name", "type": "string"},
+                   {"name": "term", "type": "string"},
+                   {"name": "value", "type": "double"}]}}
+    for uid_type, uid_val, expect in [
+        ("string", "u1", "u1"),
+        (["string"], "u2", "u2"),
+        (["long"], 7, 7),
+        (["null", "string", "long"], 42, 42),
+        (["null", "string", "long"], None, None),
+    ]:
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "uid", "type": uid_type},
+            {"name": "response", "type": "double"},
+            {"name": "features", "type": feat},
+        ]}
+        path = str(tmp_path / "uid.avro")
+        write_avro_file(path, [{
+            "uid": uid_val, "response": 1.0,
+            "features": [{"name": "f0", "term": "", "value": 3.0}],
+        }], schema)
+        imap = _build_index_map([[("f0", "", 3.0)]])
+        out = read_training_examples_native(
+            path, {"global": imap}, [], InputColumnsNames(), True)
+        assert out[5] == [expect], f"uid_type={uid_type}"
+        assert out[1][0] == 1.0
+        np.testing.assert_allclose(out[0]["global"].values[0][0], 3.0)
+
+
+def test_native_fuzz_many_shapes(tmp_path, rng):
+    """Randomized round-trips across sizes (incl. empty feature rows)."""
+    for trial in range(4):
+        n = int(rng.integers(1, 60))
+        path, rows = _write(tmp_path, rng, n=n,
+                            codec="null" if trial % 2 else "deflate",
+                            with_entities=trial % 2 == 0)
+        imap = _build_index_map(rows)
+        ents = ["userId"] if trial % 2 == 0 else []
+        native = read_training_examples_native(
+            path, {"global": imap}, ents, InputColumnsNames(), True)
+        os.environ["PHOTON_ML_TPU_NO_NATIVE"] = "1"
+        try:
+            python = read_training_examples(path, imap, ents)
+        finally:
+            del os.environ["PHOTON_ML_TPU_NO_NATIVE"]
+        _assert_same(native, python)
