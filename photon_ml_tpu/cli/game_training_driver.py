@@ -86,6 +86,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--summarize-features", action="store_true",
                    help="write FeatureSummarizationResultAvro output")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    p.add_argument("--streaming", action="store_true",
+                   help="larger-than-HBM mode for fixed-effect coordinates: "
+                        "features stay in host RAM, each optimizer pass "
+                        "streams fixed-shape chunks through the device")
+    p.add_argument("--chunk-rows", type=int, default=1 << 16,
+                   help="rows per streamed chunk (--streaming)")
     p.add_argument("--tuning-mode", default="none",
                    choices=["none", "random", "bayesian"],
                    help="auto-tune reg weights after the grid (SURVEY.md §4.5)")
@@ -173,6 +179,15 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     columns = _load_input_columns(args.input_columns)
     grid = _load_coordinate_grid(args.coordinates)
+    if args.streaming:
+        import dataclasses as _dc
+
+        grid = [
+            [_dc.replace(cfg, streaming=True, chunk_rows=args.chunk_rows)
+             if cfg.coordinate_type == "fixed" else cfg
+             for cfg in configs]
+            for configs in grid
+        ]
     shards = sorted({cfg.feature_shard for cfg in grid[0]})
     entity_columns = _entity_columns(grid)
 

@@ -27,6 +27,7 @@ import json
 import os
 from typing import Dict, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -238,18 +239,27 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     # -- stage: summarize + normalization ------------------------------------
     streaming = args.streaming
-    if streaming and reg.needs_owlqn:
-        raise SystemExit("--streaming supports smooth objectives only "
-                         "(L-BFGS); L1/elastic_net needs the in-memory "
-                         "OWL-QN path")
     dim = host_feats.dim
     if streaming:
+        from photon_ml_tpu.parallel.multihost import process_span
         from photon_ml_tpu.parallel.streaming import make_host_chunks
 
         # training set stays in host RAM; only fixed-shape chunks ever
-        # touch the device
-        chunks, _ = make_host_chunks(host_feats, labels, offsets, weights,
-                                     chunk_rows=args.chunk_rows)
+        # touch the device. Distributed: each process streams only its own
+        # contiguous row span (the reference's input-split assignment); the
+        # per-chunk partials then psum over the full mesh.
+        span = process_span(len(labels)) if distributed else (0, len(labels))
+        sl = slice(*span)
+        from photon_ml_tpu.game.data import HostSparse
+
+        local_feats = HostSparse(np.asarray(host_feats.indices)[sl],
+                                 np.asarray(host_feats.values)[sl],
+                                 host_feats.dim)
+        n_local_dev = max(len(jax.local_devices()), 1)
+        chunk_rows = -(-args.chunk_rows // n_local_dev) * n_local_dev
+        chunks, _ = make_host_chunks(
+            local_feats, np.asarray(labels)[sl], np.asarray(offsets)[sl],
+            np.asarray(weights)[sl], chunk_rows=chunk_rows)
         batch = LabeledBatch(host_feats, labels, offsets, weights)
         feats = None
     else:
@@ -281,6 +291,11 @@ def main(argv: Sequence[str] | None = None) -> int:
     objective = make_objective(task, normalization=normalization,
                                intercept_index=intercept_index)
     mesh = make_mesh()
+    # streamed chunks shard over THIS process's devices only; the global
+    # mesh is for the in-memory fit_distributed path
+    stream_mesh = (mesh if not distributed
+                   else make_mesh({"data": len(jax.local_devices())},
+                                  devices=jax.local_devices()))
     opt_config = OptimizerConfig(max_iters=args.max_iters,
                                  tolerance=args.tolerance)
 
@@ -298,9 +313,14 @@ def main(argv: Sequence[str] | None = None) -> int:
             if streaming:
                 from photon_ml_tpu.parallel.streaming import fit_streaming
 
+                # distributed: chunks hold this process's span only and the
+                # partials allgather-reduce across processes; chunk sharding
+                # uses the process-LOCAL mesh so per-process partials stay
+                # local sums while all local chips work each pass
                 res = fit_streaming(
                     objective, chunks, dim, w0=w, l2=reg.l2_weight(lam),
-                    config=opt_config, dtype=dtype,
+                    l1=reg.l1_weight(lam), optimizer=optimizer,
+                    config=opt_config, dtype=dtype, mesh=stream_mesh,
                 )
             else:
                 res = fit_distributed(
@@ -337,7 +357,7 @@ def main(argv: Sequence[str] | None = None) -> int:
 
                     variances = streaming_coefficient_variances(
                         objective, chunks, dim, res.w,
-                        l2=reg.l2_weight(lam), dtype=dtype,
+                        l2=reg.l2_weight(lam), dtype=dtype, mesh=stream_mesh,
                     )
                 else:
                     variances = objective.coefficient_variances(

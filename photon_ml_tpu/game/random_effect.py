@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from photon_ml_tpu.game.data import RandomEffectTrainData, REScoreBucket
+from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures
@@ -36,12 +37,24 @@ class RandomEffectFitResult:
 
 
 def _solver_for_bucket(local_dim: int, task: str, optimizer: str,
-                       config: OptimizerConfig, compute_variance: bool):
-    """Build the vmapped per-bucket solve function."""
-    obj = make_objective(task)
+                       config: OptimizerConfig, compute_variance: bool,
+                       norm_mode: int = 0):
+    """Build the vmapped per-bucket solve function.
+
+    ``norm_mode``: 0 = no normalization; 1 = per-entity scale factors;
+    2 = factors + shifts. Each entity carries its own local factor/shift
+    vectors (the global context gathered through its subspace projection,
+    with the intercept slot pre-pinned to 1/0, so ``intercept_index=-1``)."""
     opt = get_optimizer(optimizer)
 
-    def solve_one(indices, values, labels, weights, offs, w0, l2, l1):
+    def solve_one(indices, values, labels, weights, offs, w0, f_loc, s_loc,
+                  l2, l1):
+        ctx = None
+        if norm_mode == 1:
+            ctx = NormalizationContext(f_loc, None, -1)
+        elif norm_mode == 2:
+            ctx = NormalizationContext(f_loc, s_loc, -1)
+        obj = make_objective(task, normalization=ctx)
         batch = LabeledBatch(
             SparseFeatures(indices, values, dim=local_dim), labels, offs, weights
         )
@@ -57,27 +70,89 @@ def _solver_for_bucket(local_dim: int, task: str, optimizer: str,
         )
         return res.w, var, res.converged, res.iterations
 
-    return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))
+    return jax.vmap(solve_one, in_axes=(0,) * 8 + (None, None))
 
 
 @functools.lru_cache(maxsize=256)
-def _jitted_solver(local_dim, task, optimizer, config, compute_variance):
+def _jitted_solver(local_dim, task, optimizer, config, compute_variance,
+                   norm_mode=0):
     """Cache the jitted per-bucket solver so repeated coordinate-descent
     steps with identical shapes reuse one XLA compilation."""
     return jax.jit(_solver_for_bucket(local_dim, task, optimizer, config,
-                                      compute_variance))
+                                      compute_variance, norm_mode))
 
 
 @functools.lru_cache(maxsize=256)
 def _jitted_sharded_solver(local_dim, task, optimizer, config, compute_variance,
-                           mesh, axis):
-    solver = _solver_for_bucket(local_dim, task, optimizer, config, compute_variance)
-    spec = (P(axis),) * 6 + (P(), P())
+                           mesh, axis, norm_mode=0):
+    solver = _solver_for_bucket(local_dim, task, optimizer, config,
+                                compute_variance, norm_mode)
+    spec = (P(axis),) * 8 + (P(), P())
     sharded = jax.shard_map(
         solver, mesh=mesh, in_specs=spec,
         out_specs=(P(axis), P(axis), P(axis), P(axis)),
     )
     return jax.jit(sharded)
+
+
+def _local_normalization(buckets, norm: NormalizationContext):
+    """Gather the global normalization context into per-entity local
+    vectors: for each bucket, (f_loc [E,D], s_loc [E,D] | None,
+    intercept_pos [E] | None). Padding slots (projection -1) get f=1, s=0;
+    the global intercept slot is pinned (f=1, s=0) so the local context
+    runs with ``intercept_index=-1`` and the fold-back is explicit."""
+    f_g = None if norm.factors is None else np.asarray(norm.factors).copy()
+    s_g = None if norm.shifts is None else np.asarray(norm.shifts).copy()
+    ii = norm.intercept_index
+    if f_g is not None and ii >= 0:
+        f_g[ii] = 1.0
+    if s_g is not None and ii >= 0:
+        s_g[ii] = 0.0
+    out = []
+    for bucket in buckets:
+        from photon_ml_tpu.game.data import SketchProjection
+
+        if any(isinstance(lm, SketchProjection) for lm in bucket.local_maps):
+            raise ValueError(
+                "normalization is not supported with projection='random' "
+                "(count-sketch slots mix features); use projection='subspace'")
+        proj = np.asarray(bucket.projection)
+        safe = np.maximum(proj, 0)
+        f_loc = (np.where(proj >= 0, f_g[safe], 1.0) if f_g is not None
+                 else np.ones_like(proj, np.float64))
+        s_loc = None
+        pos = None
+        if s_g is not None:
+            s_loc = np.where(proj >= 0, s_g[safe], 0.0)
+            has = proj == ii
+            if ii < 0 or not has.any(axis=1).all():
+                raise ValueError(
+                    "shift normalization requires the intercept feature in "
+                    "every entity's feature subspace")
+            pos = has.argmax(axis=1)
+        out.append((f_loc, s_loc, pos))
+    return out
+
+
+def _re_to_training_space(W_raw: np.ndarray, f_loc, s_loc, pos) -> np.ndarray:
+    """Per-entity inverse of the model-space fold (warm starts)."""
+    W = np.array(W_raw, np.float64, copy=True)
+    E = W.shape[0]
+    if s_loc is not None:
+        w_noint = W.copy()
+        w_noint[np.arange(E), pos] = 0.0
+        W[np.arange(E), pos] += np.sum(s_loc * w_noint, axis=1)
+    return W / f_loc
+
+
+def _re_to_model_space(W_opt: np.ndarray, f_loc, s_loc, pos) -> np.ndarray:
+    """Optimizer-space bucket coefficients -> raw-feature space."""
+    W = np.asarray(W_opt, np.float64) * f_loc
+    if s_loc is not None:
+        E = W.shape[0]
+        adjust = -np.sum(s_loc * W, axis=1)  # s_loc is 0 at the intercept
+        W[np.arange(E), pos] += adjust
+    return W
 
 
 def train_random_effect(
@@ -93,13 +168,24 @@ def train_random_effect(
     axis: str = "entity",
     compute_variance: bool = False,
     dtype=jnp.float32,
+    normalization: Optional[NormalizationContext] = None,
 ) -> RandomEffectFitResult:
     """Solve every entity's local GLM. ``offsets`` is the full-dataset
     residual-offset vector [n] from the coordinate-descent loop. L1 weight
-    requires (and auto-routes to) the OWL-QN optimizer."""
+    requires (and auto-routes to) the OWL-QN optimizer.
+
+    ``normalization`` (the shard's global context) is applied inside each
+    per-entity objective via gathered local factor/shift vectors; incoming
+    ``w0`` and returned coefficients stay in raw feature space (conversion
+    happens here), so scoring/saving/warm-start paths are unchanged."""
     if np.asarray(l1).item() > 0 and optimizer != "owlqn":
         optimizer = "owlqn"
     offsets = jnp.asarray(offsets, dtype)
+    local_norm = (None if normalization is None
+                  else _local_normalization(data.buckets, normalization))
+    norm_mode = 0
+    if normalization is not None:
+        norm_mode = 2 if normalization.shifts is not None else 1
     coeffs, variances = [], []
     conv_sum, iter_sum, total = 0.0, 0.0, 0
     for b, bucket in enumerate(data.buckets):
@@ -107,13 +193,29 @@ def train_random_effect(
         sidx = jnp.asarray(bucket.sample_idx)
         # padding rows (sidx == -1) carry weight 0, offset value irrelevant
         off = jnp.take(offsets, jnp.maximum(sidx, 0), axis=0) * (sidx >= 0)
+        if w0 is not None:
+            w_init = np.asarray(w0[b])
+            if local_norm is not None:
+                w_init = _re_to_training_space(w_init, *local_norm[b])
+            w_init = jnp.asarray(w_init, dtype)
+        else:
+            w_init = jnp.zeros((E, D), dtype)
+        if local_norm is not None:
+            f_loc = jnp.asarray(local_norm[b][0], dtype)
+            s_loc = (jnp.zeros((E, 1), dtype) if local_norm[b][1] is None
+                     else jnp.asarray(local_norm[b][1], dtype))
+        else:  # unused dummies (dead-code-eliminated under jit)
+            f_loc = jnp.zeros((E, 1), dtype)
+            s_loc = jnp.zeros((E, 1), dtype)
         args = (
             jnp.asarray(bucket.indices),
             jnp.asarray(bucket.values, dtype),
             jnp.asarray(bucket.labels, dtype),
             jnp.asarray(bucket.weights, dtype),
             off.astype(dtype),
-            jnp.asarray(w0[b], dtype) if w0 is not None else jnp.zeros((E, D), dtype),
+            w_init,
+            f_loc,
+            s_loc,
             jnp.asarray(l2, dtype),
             jnp.asarray(l1, dtype),
         )
@@ -123,18 +225,23 @@ def train_random_effect(
             if pad:
                 args = tuple(
                     jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
-                    if i < 6
+                    if i < 8
                     else a
                     for i, a in enumerate(args)
                 )
             run = _jitted_sharded_solver(D, task, optimizer, config,
-                                         compute_variance, mesh, axis)
+                                         compute_variance, mesh, axis,
+                                         norm_mode)
             W, V, conv, iters = run(*args)
             W, V, conv, iters = W[:E], V[:E], conv[:E], iters[:E]
         else:
-            run = _jitted_solver(D, task, optimizer, config, compute_variance)
+            run = _jitted_solver(D, task, optimizer, config, compute_variance,
+                                 norm_mode)
             W, V, conv, iters = run(*args)
-        coeffs.append(np.asarray(W))
+        W = np.asarray(W)
+        if local_norm is not None:
+            W = _re_to_model_space(W, *local_norm[b])
+        coeffs.append(W)
         variances.append(np.asarray(V) if compute_variance else None)
         conv_sum += float(jnp.sum(conv))
         iter_sum += float(jnp.sum(iters))

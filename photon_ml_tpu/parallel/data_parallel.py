@@ -99,11 +99,45 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     ``fg(w, batch, csc, l2)`` / ``hvp(w, v, batch, csc, l2)`` evaluate the
     objective with explicit margin-space derivatives — forward is the ELL
     gather, backward is the CSC prefix-sum, reductions are explicit psums.
-    Requires SparseFeatures and no normalization context (the normalized
-    chain rule still routes through the autodiff/scatter path)."""
-    if objective.normalization is not None:
-        raise ValueError("CSC sparse-gradient path does not support "
-                         "normalization contexts; use sparse_grad='scatter'")
+    Requires SparseFeatures.
+
+    Normalization composes with the coefficient-space trick: margins use
+    ``w_eff = f̃·w`` plus the scalar shift adjustment, and the transposed
+    chain rule maps the raw-space contraction back to optimizer space as
+    ``g = f̃ ⊙ (Xᵀd) − f̃ s̃ Σd`` (f̃/s̃ have the intercept slot pinned to
+    1/0) — both linear, so they commute with the per-shard psum."""
+    norm = objective.normalization
+
+    def _eff(w):
+        """Optimizer-space w -> (raw-space effective w, scalar margin adj)."""
+        if norm is None:
+            return w, jnp.zeros((), w.dtype)
+        return norm.model_coefficients(w)
+
+    def _fixed_fs(dtype):
+        f = s = None
+        if norm is not None and norm.factors is not None:
+            f = norm.factors.astype(dtype)
+            if norm.intercept_index >= 0:
+                f = f.at[norm.intercept_index].set(1.0)
+        if norm is not None and norm.shifts is not None:
+            s = norm.shifts.astype(dtype)
+            if norm.intercept_index >= 0:
+                s = s.at[norm.intercept_index].set(0.0)
+        return f, s
+
+    def _chain_t(gx, d_sum):
+        """Raw-space Xᵀd (plus Σd) -> optimizer-space gradient."""
+        if norm is None:
+            return gx
+        f, s = _fixed_fs(gx.dtype)
+        if f is not None:
+            gx = gx * f
+        if s is not None:
+            fs = s if f is None else f * s
+            gx = gx - fs * d_sum
+        return gx
+
     if use_pallas:
         from photon_ml_tpu.ops.pallas_kernels import csc_transpose_apply_pallas
 
@@ -129,7 +163,8 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         return _build(feats.indices, feats.values)
 
     def _margin_value_and_d(w, batch):
-        m = ell_margins(batch.features, w) + batch.offsets
+        w_eff, adjust = _eff(w)
+        m = ell_margins(batch.features, w_eff) + batch.offsets + adjust
         per_ex = lambda m: jnp.sum(batch.weights * objective.loss.loss(m, batch.labels))
         f, d = jax.value_and_grad(per_ex)(m)
         return f, d
@@ -148,7 +183,7 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
 
         f, d = _margin_value_and_d(w, batch)
         csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
-        g = apply_t(csc, d)
+        g = _chain_t(apply_t(csc, d), jnp.sum(d))
         return lax.psum(f, axis), lax.psum(g, axis)
 
     @functools.partial(
@@ -160,11 +195,16 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     def shard_hvp(w, v, batch, t_values, t_rows, t_col_starts):
         from photon_ml_tpu.types import CSCTranspose
 
-        m = ell_margins(batch.features, w) + batch.offsets
-        mv = ell_margins(batch.features, v)  # directional margin, no offset
+        w_eff, adjust = _eff(w)
+        m = ell_margins(batch.features, w_eff) + batch.offsets + adjust
+        # directional margin: the margin is linear in w, so the same
+        # effective-coefficient map applies to v (no offset term)
+        v_eff, v_adjust = _eff(v)
+        mv = ell_margins(batch.features, v_eff) + v_adjust
         d2 = batch.weights * objective.loss.d2(m, batch.labels)
         csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
-        return lax.psum(apply_t(csc, d2 * mv), axis)
+        dv = d2 * mv
+        return lax.psum(_chain_t(apply_t(csc, dv), jnp.sum(dv)), axis)
 
     def fg(w, batch, csc, l2=0.0):
         l2 = jnp.asarray(l2, w.dtype)

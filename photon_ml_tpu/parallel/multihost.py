@@ -45,17 +45,44 @@ def initialize_multihost(
     return True
 
 
+def span_of(total_rows: int, index: int, count: int) -> Tuple[int, int]:
+    """Process ``index``'s [start, stop) slice of a globally-ordered
+    dataset under near-equal contiguous assignment (the reference's
+    input-split assignment)."""
+    base, extra = divmod(total_rows, count)
+    start = index * base + min(index, extra)
+    return start, start + base + (1 if index < extra else 0)
+
+
 def process_span(total_rows: int) -> Tuple[int, int]:
-    """This process's [start, stop) slice of a globally-ordered dataset:
-    near-equal contiguous ranges per process (the reference's input-split
-    assignment)."""
+    """This process's [start, stop) slice of a globally-ordered dataset."""
     import jax
 
+    return span_of(total_rows, jax.process_index(), jax.process_count())
+
+
+def allgather_spans(local: "np.ndarray", total_rows: int) -> "np.ndarray":
+    """Reassemble a globally-ordered [total_rows] vector from per-process
+    ``process_span`` slices (each process passes its own slice). Spans are
+    padded to a common length for the allgather, then re-trimmed."""
+    import jax
+    import numpy as np
+
     p = jax.process_count()
-    i = jax.process_index()
-    base, extra = divmod(total_rows, p)
-    start = i * base + min(i, extra)
-    return start, start + base + (1 if i < extra else 0)
+    if p == 1:
+        return np.asarray(local)
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(local)
+    max_len = -(-total_rows // p)  # ceil: no span is longer
+    padded = np.zeros((max_len,) + local.shape[1:], local.dtype)
+    padded[: len(local)] = local
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    parts = []
+    for i in range(p):
+        start, stop = span_of(total_rows, i, p)
+        parts.append(gathered[i, : stop - start])
+    return np.concatenate(parts)
 
 
 def runtime_info() -> dict:

@@ -100,6 +100,22 @@ def make_host_chunks(
     return chunks, dim
 
 
+def _cross_process_sum(tree):
+    """Sum accumulator pytrees across processes (multi-controller runtime).
+
+    Single-process: identity. Multi-process: each process streams only its
+    own row span (``multihost.process_span``), then the per-process partials
+    are reduced here — the DCN leg of the reference's ``treeAggregate``
+    (SURVEY.md §5.8). Uses allgather+sum of [d]-sized partials, negligible
+    next to the per-chunk compute."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(tree)
+    return jax.tree.map(lambda a: jnp.asarray(a).sum(axis=0), gathered)
+
+
 def _chunk_to_device(chunk: HostChunk, dim: int, dtype, sharding) -> LabeledBatch:
     put = (lambda a: jax.device_put(a, sharding)) if sharding else jax.device_put
     return LabeledBatch(
@@ -145,11 +161,42 @@ def streaming_value_and_grad(
             pending = dev
         if pending is not None:
             f_acc, g_acc = chunk_fg(w, pending, f_acc, g_acc)
+        f_acc, g_acc = _cross_process_sum((f_acc, g_acc))
         wr = objective._reg_mask(w)
         l2 = jnp.asarray(l2, dtype)
         return f_acc + 0.5 * l2 * jnp.sum(wr * wr), g_acc + l2 * wr
 
     return fg
+
+
+def streaming_hvp(
+    objective: GLMObjective,
+    chunks: Sequence[HostChunk],
+    dim: int,
+    dtype=jnp.float32,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+) -> Callable:
+    """Returns hvp(w, v, l2) computed in one streamed pass — the cost model
+    of the reference's HessianVectorAggregator treeAggregate per CG step
+    (SURVEY.md §4.2), with chunks instead of cluster partitions."""
+    sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
+
+    @jax.jit
+    def chunk_hvp(w, v, batch, acc):
+        return acc + objective.hvp(w, v, batch, 0.0)
+
+    def hvp(w, v, l2=0.0):
+        w = jnp.asarray(w, dtype)
+        v = jnp.asarray(v, dtype)
+        acc = jnp.zeros((dim,), dtype)
+        for chunk in chunks:
+            acc = chunk_hvp(w, v, _chunk_to_device(chunk, dim, dtype, sharding),
+                            acc)
+        acc = _cross_process_sum(acc)
+        return acc + jnp.asarray(l2, dtype) * objective._reg_mask(v)
+
+    return hvp
 
 
 def streaming_coefficient_variances(
@@ -176,6 +223,7 @@ def streaming_coefficient_variances(
     acc = jnp.zeros((dim,), dtype)
     for chunk in chunks:
         acc = chunk_diag(w, _chunk_to_device(chunk, dim, dtype, sharding), acc)
+    acc = _cross_process_sum(acc)
     reg = jnp.full((dim,), jnp.asarray(l2, dtype))
     if not objective.regularize_intercept and objective.intercept_index >= 0:
         reg = reg.at[objective.intercept_index].set(0.0)
@@ -193,17 +241,30 @@ def fit_streaming(
     dtype=jnp.float32,
     mesh: Optional[Mesh] = None,
     axis: str = "data",
+    optimizer: str = "lbfgs",
+    l1=0.0,
 ) -> OptimizationResult:
-    """L-BFGS over a streamed full-batch objective.
+    """Streamed (larger-than-HBM) full-batch fit.
 
-    The direction (two-loop recursion over the device-resident (s, y)
-    history) and the vector updates stay on device; only the line-search
-    control flow runs on host, because each function evaluation is a full
-    streamed pass (exactly the reference's driver-side Breeze loop with one
-    ``treeAggregate`` per evaluation — SURVEY.md §4.2). Line search is
-    backtracking Armijo; pairs are stored only under a curvature guard, which
-    keeps the inverse-Hessian metric positive definite without paying extra
-    full passes for the Wolfe curvature condition."""
+    ``optimizer``: "lbfgs" (default), "tron" (trust-region Newton — each CG
+    step is one streamed HVP pass, exactly the reference's cost model), or
+    "owlqn" (L1; requires ``l1`` > 0 makes sense). Only the outer control
+    flow runs on host; direction/update vector math stays on device.
+    Line search is backtracking Armijo; pairs are stored only under a
+    curvature guard, which keeps the inverse-Hessian metric positive
+    definite without paying extra full passes for the Wolfe curvature
+    condition (a weaker (s,y) filter than the in-memory strong-Wolfe
+    optimizer — convergence contract documented in docs/PERF.md)."""
+    if np.asarray(l1).item() > 0 and optimizer != "owlqn":
+        optimizer = "owlqn"
+    if optimizer == "tron":
+        return _fit_streaming_tron(objective, chunks, dim, w0, l2, config,
+                                   dtype, mesh, axis)
+    if optimizer == "owlqn":
+        return _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1,
+                                    config, dtype, mesh, axis)
+    if optimizer != "lbfgs":
+        raise ValueError(f"unknown streaming optimizer '{optimizer}'")
     m = config.history
     if w0 is None:
         w0 = jnp.zeros((dim,), dtype)
@@ -276,6 +337,211 @@ def fit_streaming(
 
     return OptimizationResult(
         w=w, value=f, grad_norm=jnp.linalg.norm(g),
+        iterations=jnp.asarray(it), converged=jnp.asarray(converged),
+        loss_history=jnp.asarray(loss_hist),
+        grad_norm_history=jnp.asarray(gnorm_hist),
+    )
+
+
+# Lin-Moré / LIBLINEAR constants (same as optimize/tron.py)
+_ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
+_SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
+
+
+def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
+                        axis) -> OptimizationResult:
+    """Host-loop TRON mirroring ``optimize.tron``: Steihaug CG inner loop
+    where every Hessian-vector product is one streamed pass over the data —
+    the reference's one-treeAggregate-per-CG-step cost model (SURVEY.md
+    §4.2) with host chunks in place of cluster partitions."""
+    if w0 is None:
+        w0 = jnp.zeros((dim,), dtype)
+    w = jnp.asarray(w0, dtype)
+    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis)
+    hvp = streaming_hvp(objective, chunks, dim, dtype, mesh, axis)
+    max_cg = max(dim, 20)
+    eps = float(jnp.finfo(dtype).eps)
+
+    def cg(wc, g, delta, cg_tol):
+        """Steihaug CG; each hvp call is a full streamed pass."""
+        s = jnp.zeros_like(g)
+        r = -g
+        d = r
+        rr = float(jnp.sum(r * r))
+        for _ in range(max_cg):
+            Hd = hvp(wc, d, l2)
+            dHd = float(jnp.sum(d * Hd))
+            neg_curv = dHd <= 0
+            alpha = rr / (1.0 if neg_curv else dHd)
+            outside = float(jnp.linalg.norm(s + alpha * d)) >= delta
+            if neg_curv or outside:
+                sd = float(jnp.sum(s * d))
+                dd = float(jnp.sum(d * d))
+                ss = float(jnp.sum(s * s))
+                disc = np.sqrt(max(sd * sd + dd * (delta * delta - ss), 0.0))
+                tau = (-sd + disc) / max(dd, eps)
+                s = s + tau * d
+                r = r - tau * Hd
+                break
+            s = s + alpha * d
+            r = r - alpha * Hd
+            rr_new = float(jnp.sum(r * r))
+            if np.sqrt(rr_new) <= cg_tol:
+                break
+            d = r + (rr_new / max(rr, eps)) * d
+            rr = rr_new
+        return s, r
+
+    f, g = fg(w, l2)
+    f = float(f)
+    g0_norm = float(jnp.linalg.norm(g))
+    delta = g0_norm
+    tol = max(config.tolerance, eps)
+    loss_hist = np.full((config.max_iters,), np.nan)
+    gnorm_hist = np.full((config.max_iters,), np.nan)
+    it = 0
+    converged = False
+    for it in range(config.max_iters):
+        gnorm = float(jnp.linalg.norm(g))
+        step, r = cg(w, g, delta, 0.1 * gnorm)
+        w_try = w + step
+        f_try_j, g_try = fg(w_try, l2)
+        f_try = float(f_try_j)
+        gs = float(jnp.sum(g * step))
+        prered = 0.5 * (float(jnp.sum(step * r)) - gs)
+        actred = f - f_try
+        snorm = float(jnp.linalg.norm(step))
+
+        denom = f_try - f - gs
+        alpha = _SIGMA3 if denom <= 0 else max(_SIGMA1, -0.5 * (gs / denom))
+        if actred < _ETA0 * prered:
+            delta = min(max(alpha, _SIGMA1) * snorm, _SIGMA2 * delta)
+        elif actred < _ETA1 * prered:
+            delta = max(_SIGMA1 * delta, min(alpha * snorm, _SIGMA2 * delta))
+        elif actred < _ETA2 * prered:
+            delta = max(_SIGMA1 * delta, min(alpha * snorm, _SIGMA3 * delta))
+        else:
+            delta = max(delta, min(alpha * snorm, _SIGMA3 * delta))
+
+        accept = actred > _ETA0 * prered
+        if accept:
+            prev_f = f
+            w, f, g = w_try, f_try, g_try
+            gnorm = float(jnp.linalg.norm(g))
+            rel = abs(prev_f - f) / max(abs(prev_f), eps)
+            if rel < tol or gnorm < tol * max(g0_norm, eps):
+                converged = True
+        loss_hist[it] = f
+        gnorm_hist[it] = gnorm
+        if prered <= eps * max(abs(f), 1.0):  # model predicts no gain left
+            converged = True
+        if converged or delta < eps * max(float(jnp.linalg.norm(w)), 1.0):
+            it += 1
+            break
+    else:
+        it = config.max_iters
+
+    return OptimizationResult(
+        w=w, value=jnp.asarray(f, dtype), grad_norm=jnp.linalg.norm(g),
+        iterations=jnp.asarray(it), converged=jnp.asarray(converged),
+        loss_history=jnp.asarray(loss_hist),
+        grad_norm_history=jnp.asarray(gnorm_hist),
+    )
+
+
+def _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1, config, dtype,
+                         mesh, axis) -> OptimizationResult:
+    """Host-loop OWL-QN mirroring ``optimize.owlqn`` (Andrew & Gao 2007):
+    pseudo-gradient from the streamed smooth gradient, L-BFGS direction on
+    device, orthant projection of direction and iterates; every line-search
+    evaluation is one streamed pass."""
+    from photon_ml_tpu.optimize.owlqn import pseudo_gradient
+
+    m = config.history
+    if w0 is None:
+        w0 = jnp.zeros((dim,), dtype)
+    w = jnp.asarray(w0, dtype)
+    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis)
+    mask = jnp.ones((dim,), dtype)
+    if objective.intercept_index >= 0 and not objective.regularize_intercept:
+        mask = mask.at[objective.intercept_index].set(0.0)
+    lam = jnp.asarray(l1, dtype) * mask
+
+    direction = jax.jit(functools.partial(two_loop_direction, m=m))
+
+    @jax.jit
+    def project_direction(p, pg):
+        p = jnp.where(p * (-pg) > 0, p, 0.0)
+        dg = jnp.sum(p * pg)
+        return jnp.where(dg < 0, p, -pg), jnp.minimum(dg, jnp.sum(-pg * pg))
+
+    @jax.jit
+    def project_point(w_trial, xi):
+        return jnp.where(w_trial * xi > 0, w_trial, 0.0)
+
+    def full_F(f_smooth, w_at):
+        return float(f_smooth) + float(jnp.sum(lam * jnp.abs(w_at)))
+
+    f, g = fg(w, l2)
+    F = full_F(f, w)
+    pg = pseudo_gradient(w, g, lam)
+    pg0_norm = float(jnp.linalg.norm(pg))
+    eps = float(jnp.finfo(dtype).eps)
+    tol = max(config.tolerance, eps)
+    s_hist = jnp.zeros((m, dim), dtype)
+    y_hist = jnp.zeros((m, dim), dtype)
+    rho = jnp.zeros((m,), dtype)
+    k = 0
+    loss_hist = np.full((config.max_iters,), np.nan)
+    gnorm_hist = np.full((config.max_iters,), np.nan)
+    it = 0
+    converged = False
+    for it in range(config.max_iters):
+        pg = pseudo_gradient(w, g, lam)
+        p = direction(pg, s_hist, y_hist, rho, jnp.asarray(k))
+        p, _ = project_direction(p, pg)
+        xi = jnp.where(w != 0, jnp.sign(w), jnp.sign(-pg))
+        alpha = 1.0 if k > 0 else 1.0 / max(float(jnp.linalg.norm(pg)), 1.0)
+        accepted = False
+        for _ in range(config.max_line_search_steps):
+            w_try = project_point(w + alpha * p, xi)
+            f_try, g_try = fg(w_try, l2)
+            F_try = full_F(f_try, w_try)
+            dgtest = float(jnp.sum(pg * (w_try - w)))
+            if F_try <= F + 1e-4 * dgtest and np.isfinite(F_try):
+                accepted = True
+                break
+            alpha *= 0.5
+        if not accepted:
+            break
+        step = w_try - w
+        yv = g_try - g
+        sy = float(jnp.sum(step * yv))
+        if sy > 1e-10 * max(
+            float(jnp.linalg.norm(step)) * float(jnp.linalg.norm(yv)), eps
+        ):
+            slot = k % m
+            s_hist = s_hist.at[slot].set(step)
+            y_hist = y_hist.at[slot].set(yv)
+            rho = rho.at[slot].set(1.0 / sy)
+            k += 1
+        F_prev = F
+        w, g, F = w_try, g_try, F_try
+        pg_norm = float(jnp.linalg.norm(pseudo_gradient(w, g, lam)))
+        loss_hist[it] = F
+        gnorm_hist[it] = pg_norm
+        rel = abs(F_prev - F) / max(abs(F_prev), eps)
+        if rel < tol or pg_norm < tol * max(pg0_norm, eps):
+            converged = True
+            it += 1
+            break
+    else:
+        it = config.max_iters
+
+    final_pg = pseudo_gradient(w, g, lam)
+    return OptimizationResult(
+        w=w, value=jnp.asarray(F, dtype),
+        grad_norm=jnp.linalg.norm(final_pg),
         iterations=jnp.asarray(it), converged=jnp.asarray(converged),
         loss_history=jnp.asarray(loss_hist),
         grad_norm_history=jnp.asarray(gnorm_hist),

@@ -97,20 +97,70 @@ def test_fit_csc_matches_scatter(sparse_batch, optimizer, l1):
                                rtol=1e-5, atol=1e-8)
 
 
-def test_csc_rejects_normalization(sparse_batch):
-    from photon_ml_tpu.ops.normalization import (
-        NormalizationType,
-        build_normalization_context,
-    )
-    from photon_ml_tpu.ops.statistics import summarize_features
+def _normalized_batch(rng, norm_type):
+    """Sparse batch with an explicit intercept column (standardization
+    needs one) plus its NormalizationContext."""
+    import scipy.sparse as sp
 
+    from photon_ml_tpu.ops.normalization import build_normalization_context
+    from photon_ml_tpu.ops.statistics import summarize_features
+    from photon_ml_tpu.types import SparseFeatures
+
+    n, d = 256, 24
+    X = sp.random(n, d, density=0.2, random_state=5, format="csr").toarray()
+    X[:, 3] *= 40.0  # wild scales so normalization actually matters
+    X[:, 7] *= 0.01
+    Xi = np.concatenate([X, np.ones((n, 1))], axis=1)  # intercept col = d
+    w_true = rng.normal(size=d + 1)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(Xi @ w_true)))).astype(float)
+    feats = sparse_from_scipy(sp.csr_matrix(Xi), dtype=jnp.float64)
+    batch = make_batch(feats, y, weights=rng.uniform(0.5, 2.0, size=n),
+                       dtype=jnp.float64)
     ctx = build_normalization_context(
-        NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
-        summarize_features(sparse_batch),
-    )
-    obj = make_objective("logistic", normalization=ctx)
-    with pytest.raises(ValueError, match="normalization"):
-        make_csc_path(obj, make_mesh())
+        norm_type, summarize_features(batch), intercept_index=d)
+    return batch, ctx, d
+
+
+@pytest.mark.parametrize("norm_type", ["scale_with_standard_deviation",
+                                       "standardization"])
+@pytest.mark.parametrize("optimizer", ["lbfgs", "tron"])
+def test_csc_normalized_fit_matches_scatter(rng, norm_type, optimizer):
+    """Normalization on the CSC fast path: full fits match the autodiff/
+    scatter path (gradient chain rule + HVP both normalized)."""
+    batch, ctx, d = _normalized_batch(rng, norm_type)
+    obj = make_objective("logistic", normalization=ctx, intercept_index=d)
+    mesh = make_mesh()
+    w0 = jnp.zeros(d + 1, jnp.float64)
+    kw = dict(l2=0.3, optimizer=optimizer,
+              config=OptimizerConfig(max_iters=60, tolerance=1e-12))
+    res_sc = fit_distributed(obj, batch, mesh, w0, **kw)
+    res_csc = fit_distributed(obj, batch, mesh, w0, sparse_grad="csc", **kw)
+    np.testing.assert_allclose(float(res_csc.value), float(res_sc.value),
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(res_csc.w), np.asarray(res_sc.w),
+                               rtol=1e-4, atol=1e-7)
+
+
+def test_csc_normalized_fg_hvp_exact(rng):
+    """Pointwise value/grad/HVP parity (tighter than whole-fit parity)."""
+    batch, ctx, d = _normalized_batch(rng, "standardization")
+    obj = make_objective("logistic", normalization=ctx, intercept_index=d)
+    mesh = make_mesh()
+    sharded = shard_batch(batch, mesh)
+    fg_ref = distributed_value_and_grad(obj, mesh)
+    hvp_ref = distributed_hvp(obj, mesh)
+    build, fg_csc, hvp_csc = make_csc_path(obj, mesh)
+    csc = jax.jit(build)(sharded)
+    w = jnp.asarray(rng.normal(size=d + 1))
+    v = jnp.asarray(rng.normal(size=d + 1))
+    f_ref, g_ref = fg_ref(w, sharded, 0.2)
+    f_csc, g_csc = fg_csc(w, sharded, csc, 0.2)
+    np.testing.assert_allclose(float(f_csc), float(f_ref), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(g_csc), np.asarray(g_ref),
+                               rtol=1e-9, atol=1e-11)
+    np.testing.assert_allclose(
+        np.asarray(hvp_csc(w, v, sharded, csc, 0.2)),
+        np.asarray(hvp_ref(w, v, sharded, 0.2)), rtol=1e-9, atol=1e-11)
 
 def test_game_fixed_coordinate_csc_matches_scatter():
     from photon_ml_tpu.estimators import GameTransformer

@@ -257,11 +257,59 @@ def test_locked_without_warm_start_rejected(rng):
         cd.run(ds, locked=["fixed"])
 
 
-def test_random_coordinate_normalization_rejected():
+def test_random_coordinate_normalization_sketch_rejected():
     from photon_ml_tpu.ops.normalization import NormalizationContext
     import jax.numpy as jnp2
 
     ctx = NormalizationContext(jnp2.ones(3), None)
-    with pytest.raises(ValueError, match="not supported"):
+    with pytest.raises(ValueError, match="projection='random'"):
         CoordinateConfig("re", coordinate_type="random", entity_column="u",
-                         normalization=ctx)
+                         normalization=ctx, projection="random",
+                         projection_dim=8)
+
+
+def test_random_effect_normalization_matches_materialized(rng):
+    """Per-entity normalization inside the solve == training on explicitly
+    standardized features: identical predictions (coefficients come back in
+    raw feature space)."""
+    from photon_ml_tpu.game.data import build_random_effect_data, build_score_view
+    from photon_ml_tpu.game.random_effect import (
+        score_random_effect,
+        train_random_effect,
+    )
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+
+    n, d = 240, 6
+    X = rng.normal(size=(n, d)) * np.array([30.0, 0.05, 1.0, 4.0, 1.0, 2.0])
+    X = X * (rng.random((n, d)) < 0.7)
+    Xi = np.concatenate([X, np.ones((n, 1))], axis=1)  # intercept col = d
+    ids = rng.integers(0, 8, n)
+    u_eff = rng.normal(size=(8, d + 1))
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.sum(Xi * u_eff[ids], axis=1)))
+         ).astype(float)
+    weights = rng.uniform(0.5, 2.0, n)
+
+    mean = Xi.mean(axis=0)
+    std = np.where(Xi.std(axis=0) > 0, Xi.std(axis=0), 1.0)
+    ctx = NormalizationContext(jnp.asarray(1.0 / std), jnp.asarray(mean),
+                               intercept_index=d)
+
+    kw = dict(task="logistic", l2=0.5, optimizer="lbfgs", dtype=jnp.float64)
+    data_raw = build_random_effect_data(Xi, y, weights, ids, num_buckets=2)
+    fit_norm = train_random_effect(data_raw, np.zeros(n), normalization=ctx,
+                                   **kw)
+
+    # reference: explicitly standardized dense features, no context
+    Xn = (Xi - mean) / std
+    Xn[:, d] = 1.0  # intercept untouched
+    data_mat = build_random_effect_data(Xn, y, weights, ids, num_buckets=2)
+    fit_mat = train_random_effect(data_mat, np.zeros(n), **kw)
+
+    view_raw = build_score_view(data_raw, Xi, ids)
+    view_mat = build_score_view(data_mat, Xn, ids)
+    s_norm = np.asarray(score_random_effect(view_raw, fit_norm.coefficients,
+                                            n, jnp.float64))
+    s_mat = np.asarray(score_random_effect(view_mat, fit_mat.coefficients,
+                                           n, jnp.float64))
+    np.testing.assert_allclose(s_norm, s_mat, rtol=1e-6, atol=1e-8)
+    assert fit_norm.converged_fraction == 1.0

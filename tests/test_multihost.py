@@ -1,12 +1,17 @@
 """Multi-host wiring (single-process testable surface): the initialize
-no-op path, argument validation, and the per-process input-split math."""
+no-op path, argument validation, and the per-process input-split math.
+The REAL 2-process runtime (rendezvous, psum across processes, streamed
+GAME) is exercised in tests/test_multiprocess.py."""
 
+import numpy as np
 import pytest
 
 from photon_ml_tpu.parallel.multihost import (
+    allgather_spans,
     initialize_multihost,
     process_span,
     runtime_info,
+    span_of,
 )
 
 
@@ -33,18 +38,25 @@ def test_runtime_info_shape():
     assert info["platform"] == "cpu"  # conftest pins the test platform
 
 
-def test_span_partition_math():
-    # simulate the formula for p processes without a real multi-host runtime
-    def spans(total, p):
-        base, extra = divmod(total, p)
-        out = []
-        for i in range(p):
-            start = i * base + min(i, extra)
-            out.append((start, start + base + (1 if i < extra else 0)))
-        return out
+@pytest.mark.parametrize("total,p", [(10, 3), (0, 4), (7, 8), (64, 8),
+                                     (101, 7)])
+def test_span_partition_math(total, p):
+    # the production span_of itself (not a re-typed copy): contiguous,
+    # disjoint, covering, sizes within 1 of each other
+    s = [span_of(total, i, p) for i in range(p)]
+    assert s[0][0] == 0 and s[-1][1] == total
+    assert all(s[i][1] == s[i + 1][0] for i in range(p - 1))
+    sizes = [b - a for a, b in s]
+    assert max(sizes) - min(sizes) <= 1
+    if (total, p) == (10, 3):
+        assert s == [(0, 4), (4, 7), (7, 10)]
 
-    s = spans(10, 3)
-    assert s == [(0, 4), (4, 7), (7, 10)]
-    # contiguous, disjoint, covering
-    assert s[0][0] == 0 and s[-1][1] == 10
-    assert all(s[i][1] == s[i + 1][0] for i in range(2))
+
+def test_process_span_uses_span_of():
+    # single-process runtime: process_span must agree with span_of(., 0, 1)
+    assert process_span(100) == span_of(100, 0, 1)
+
+
+def test_allgather_spans_single_process_identity():
+    x = np.arange(7.0)
+    np.testing.assert_array_equal(allgather_spans(x, 7), x)
