@@ -91,7 +91,7 @@ def distributed_hvp(objective: GLMObjective, mesh: Mesh, axis: str = "data") -> 
 
 
 def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
-                  use_pallas: bool = False):
+                  use_pallas: bool = False, precise: bool = False):
     """Scatter-free sparse gradient path (see ``types.CSCTranspose``).
 
     Returns (build, fg, hvp): ``build(batch)`` sorts each shard's nonzeros by
@@ -141,7 +141,16 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     if use_pallas:
         from photon_ml_tpu.ops.pallas_kernels import csc_transpose_apply_pallas
 
+        if precise:
+            raise ValueError("precise (f64 prefix) accumulation is not "
+                             "available in the Pallas kernel; use "
+                             "sparse_grad='csc_precise'")
         apply_t = csc_transpose_apply_pallas
+    elif precise:
+        # f64 prefix accumulation: at TB-scale nnz an f32 prefix loses
+        # ~sqrt(nnz)*eps relative accuracy through boundary-difference
+        # cancellation, which can stall tight-tolerance convergence
+        apply_t = functools.partial(csc_transpose_apply, precise=True)
     else:
         apply_t = csc_transpose_apply
     def build(batch: LabeledBatch):
@@ -235,14 +244,16 @@ def fit_distributed(
     """Shard the batch over the mesh and run a full jitted fit — the
     ``DistributedOptimizationProblem.run`` equivalent (SURVEY.md §3.2).
 
-    ``sparse_grad``: "scatter" (XLA scatter-add via autodiff transpose) or
+    ``sparse_grad``: "scatter" (XLA scatter-add via autodiff transpose),
     "csc" (scatter-free column-sorted gradients — see ``make_csc_path``;
     sorts once per fit on device, best for many-iteration sparse fits on
-    TPU)."""
-    if sparse_grad in ("csc", "csc_pallas"):
+    TPU), "csc_pallas" (fused Pallas kernel), or "csc_precise" (CSC with
+    f64 prefix accumulation for very large nnz)."""
+    if sparse_grad in ("csc", "csc_pallas", "csc_precise"):
         return _fit_distributed_csc(
             objective, batch, mesh, w0, l2, l1, optimizer, config, axis,
             use_pallas=(sparse_grad == "csc_pallas"),
+            precise=(sparse_grad == "csc_precise"),
         )
     batch = shard_batch(batch, mesh, axis)
     fg = distributed_value_and_grad(objective, mesh, axis)
@@ -274,13 +285,14 @@ def fit_distributed(
 
 def _fit_distributed_csc(
     objective, batch, mesh, w0, l2, l1, optimizer, config, axis,
-    use_pallas: bool = False,
+    use_pallas: bool = False, precise: bool = False,
 ) -> OptimizationResult:
     """CSC-path fit: ONE jitted program that sorts the shard nonzeros by
     column, then runs the whole optimizer loop against the sorted view —
     sort cost amortizes over every iteration."""
     batch = shard_batch(batch, mesh, axis)
-    build, fg, hvp = make_csc_path(objective, mesh, axis, use_pallas=use_pallas)
+    build, fg, hvp = make_csc_path(objective, mesh, axis,
+                                   use_pallas=use_pallas, precise=precise)
     opt = get_optimizer(optimizer)
 
     if optimizer == "owlqn":

@@ -183,3 +183,25 @@ def test_game_fixed_coordinate_csc_matches_scatter():
     s_scatter = run("scatter")
     s_csc = run("csc")
     np.testing.assert_allclose(s_csc, s_scatter, rtol=1e-6, atol=1e-8)
+
+
+def test_csc_precise_fit_matches_scatter(sparse_batch):
+    """sparse_grad='csc_precise' (f64 prefix accumulation) is plumbed end to
+    end through fit_distributed and matches the scatter fit."""
+    obj = make_objective("logistic")
+    mesh = make_mesh()
+    w0 = jnp.zeros(sparse_batch.features.dim, jnp.float64)
+    kw = dict(l2=0.5, config=OptimizerConfig(max_iters=40, tolerance=1e-12))
+    res_sc = fit_distributed(obj, sparse_batch, mesh, w0, **kw)
+    res_pr = fit_distributed(obj, sparse_batch, mesh, w0,
+                             sparse_grad="csc_precise", **kw)
+    np.testing.assert_allclose(float(res_pr.value), float(res_sc.value),
+                               rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(res_pr.w), np.asarray(res_sc.w),
+                               rtol=1e-5, atol=1e-8)
+
+
+def test_csc_pallas_rejects_precise():
+    obj = make_objective("logistic")
+    with pytest.raises(ValueError, match="precise"):
+        make_csc_path(obj, make_mesh(), use_pallas=True, precise=True)

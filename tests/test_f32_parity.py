@@ -1,0 +1,25 @@
+"""f32-vs-f64 numerics parity (SURVEY.md §7 hard part): the harness the
+real-chip evidence uses, exercised CPU-vs-CPU in CI. The TPU leg runs the
+same script with the default platform (scripts/f32_parity.py compare)."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_f32_parity_harness_cpu():
+    script = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                          "scripts", "f32_parity.py")
+    out = subprocess.run(
+        [sys.executable, script, "compare", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=900,
+        env=dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout)
+    assert report["pass"]
+    assert report["delta_auc"] < 1e-3
+    assert report["rel_delta_val_loss"] < 1e-4
+    # both legs converged on the same problem
+    assert report["f64_cpu"]["converged"] and report["f32"]["converged"]
