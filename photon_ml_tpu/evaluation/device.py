@@ -83,10 +83,59 @@ def histogram_auc_from_hists(pos_hist, neg_hist):
                      pairs / (w_pos * w_neg), jnp.nan)
 
 
-def histogram_auc(scores, labels, weights=None, n_bins=4096, mesh=None):
+def make_device_evaluator(name: str, mesh=None):
+    """Device-side form of a host evaluator for per-iteration CD-loop
+    validation (VERDICT r2 #9: per-iteration metrics must not round-trip
+    full score vectors through host numpy at scale). Returns a callable
+    ``(scores, labels, weights) -> device scalar`` or None when the metric
+    has no device form (grouped / precision@k variants fall back to host).
+
+    AUC uses the exact ``device_auc`` on a single device and the psum-able
+    ``histogram_auc`` when scores are sharded over a >1-device mesh. The
+    pointwise losses mirror ``evaluators.py`` definitions exactly. Final
+    reported metrics should still come from the host f64 evaluators (the
+    CD loop recomputes its last record with them)."""
+    key = name.lower()
+    multi = mesh is not None and mesh.devices.size > 1
+
+    if key == "auc":
+        if multi:
+            axis = ("data" if "data" in mesh.shape else mesh.axis_names[0])
+            return lambda s, l, w: histogram_auc(s, l, w, mesh=mesh,
+                                                 axis=axis)
+        return device_auc
+
+    def wmean(point):
+        @jax.jit
+        def f(scores, labels, weights):
+            return (jnp.sum(weights * point(scores, labels))
+                    / jnp.sum(weights))
+        return f
+
+    if key == "rmse":
+        f = wmean(lambda s, l: (s - l) ** 2)
+        return lambda s, l, w: jnp.sqrt(f(s, l, w))
+    if key == "logistic_loss":
+        return wmean(lambda s, l: jnp.logaddexp(0.0, s) - l * s)
+    if key == "poisson_loss":
+        return wmean(lambda s, l: jnp.exp(s) - l * s)
+    if key == "squared_loss":
+        return wmean(lambda s, l: 0.5 * (s - l) ** 2)
+    if key == "smoothed_hinge_loss":
+        def point(s, l):
+            z = (2.0 * l - 1.0) * s
+            return jnp.where(z <= 0, 0.5 - z,
+                             jnp.where(z < 1, 0.5 * (1 - z) ** 2, 0.0))
+        return wmean(point)
+    return None
+
+
+def histogram_auc(scores, labels, weights=None, n_bins=4096, mesh=None,
+                  axis=None):
     """Sharded/histogram AUC driver. With a mesh, the histogram reduction
-    rides the mesh's collectives via sharded inputs; XLA turns the
-    segment-sum over sharded rows into per-shard sums + all-reduce."""
+    rides the mesh's collectives via inputs sharded over ``axis`` (default:
+    the mesh's first axis); XLA turns the segment-sum over sharded rows
+    into per-shard sums + all-reduce."""
     scores = jnp.asarray(scores)
     labels = jnp.asarray(labels)
     weights = (jnp.ones_like(scores) if weights is None
@@ -97,9 +146,9 @@ def histogram_auc(scores, labels, weights=None, n_bins=4096, mesh=None):
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        data_axis = mesh.axis_names[0]
+        data_axis = axis or mesh.axis_names[0]
         sharding = NamedSharding(mesh, P(data_axis))
-        n_dev = mesh.devices.size
+        n_dev = mesh.shape[data_axis]
         pad = (-len(scores)) % n_dev
         if pad:
             scores = jnp.concatenate((scores, jnp.zeros(pad, scores.dtype)))

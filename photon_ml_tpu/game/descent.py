@@ -100,7 +100,9 @@ class CoordinateConfig:
     projection: str = "subspace"
     projection_dim: Optional[int] = None
     projection_seed: int = 0
-    compute_variance: bool = False
+    # False | True/"diagonal" (1/diag(H), the reference's SIMPLE type) |
+    # "full" (diag(H^-1), small dims only — the reference's FULL type)
+    compute_variance: bool | str = False
     normalization: Optional[NormalizationContext] = None
     intercept_index: int = -1
 
@@ -128,6 +130,15 @@ class CoordinateConfig:
                 "supported with projection='random' (count-sketch slots mix "
                 "features); use projection='subspace'"
             )
+        if self.compute_variance not in (False, True, "diagonal", "full"):
+            raise ValueError(
+                f"compute_variance={self.compute_variance!r}; expected "
+                "False, True, 'diagonal' or 'full'")
+        # fail at config time, not after an hours-long streamed fit
+        if self.compute_variance == "full" and self.streaming:
+            raise ValueError(
+                "compute_variance='full' needs the d x d Hessian in device "
+                "memory; not available with streaming=True (use 'diagonal')")
 
 
 @dataclasses.dataclass
@@ -387,6 +398,11 @@ class _FixedState:
         self.w = res.w
         if self.cfg.compute_variance:
             if self.streaming:
+                if self.cfg.compute_variance == "full":
+                    raise ValueError(
+                        "compute_variance='full' needs the d x d Hessian in "
+                        "device memory; not available in streaming mode "
+                        "(use 'diagonal')")
                 from photon_ml_tpu.parallel.streaming import (
                     streaming_coefficient_variances,
                 )
@@ -398,8 +414,11 @@ class _FixedState:
             else:
                 feats, labels, weights = self._batch_parts
                 batch = LabeledBatch(feats, labels, offs, weights)
+                mode = ("full" if self.cfg.compute_variance == "full"
+                        else "diagonal")
                 self.variances = np.asarray(
-                    self.obj.coefficient_variances(res.w, batch, self.l2)
+                    self.obj.coefficient_variances(res.w, batch, self.l2,
+                                                   mode=mode)
                 )
         return res
 
@@ -556,6 +575,25 @@ class CoordinateDescent:
         entity_mesh = (self.mesh if self.mesh is not None
                        and "entity" in self.mesh.shape else None)
 
+        # Per-iteration validation metrics run on device where a device form
+        # exists (VERDICT r2 #9: no full score-vector round-trip to host
+        # numpy per iteration); the definitive host-f64 numbers are
+        # recomputed once for the final history record below.
+        device_evals: dict = {}
+        if validation is not None and evaluators:
+            from photon_ml_tpu.evaluation.device import make_device_evaluator
+
+            data_mesh = (self.mesh if self.mesh is not None
+                         and "data" in self.mesh.shape
+                         and self.mesh.shape["data"] > 1 else None)
+            for ev in evaluators:
+                device_evals[ev.name] = (
+                    None if ev.grouped
+                    else make_device_evaluator(ev.name, data_mesh))
+            val_labels_dev = jnp.asarray(validation.labels, dtype)
+            val_weights_dev = jnp.asarray(validation.weights, dtype)
+            val_offsets_dev = jnp.asarray(validation.offsets, dtype)
+
         for it in range(self.n_iterations):
             for cfg in self.configs:
                 st = states[cfg.name]
@@ -602,14 +640,21 @@ class CoordinateDescent:
                             )
                 record["seconds"] = time.time() - t0
                 if validation is not None and evaluators:
-                    v_total = np.asarray(
-                        jnp.asarray(validation.offsets, dtype) + sum(val_scores.values())
-                    )
+                    v_total_dev = val_offsets_dev + sum(val_scores.values())
+                    v_total_host = None
                     for ev in evaluators:
-                        record[ev.name] = ev.evaluate(
-                            v_total, validation.labels, validation.weights,
-                            validation.group_ids,
-                        )
+                        fn = device_evals.get(ev.name)
+                        if fn is not None:
+                            record[ev.name] = float(
+                                fn(v_total_dev, val_labels_dev,
+                                   val_weights_dev))
+                        else:  # grouped / precision@k: host path
+                            if v_total_host is None:
+                                v_total_host = np.asarray(v_total_dev)
+                            record[ev.name] = ev.evaluate(
+                                v_total_host, validation.labels,
+                                validation.weights, validation.group_ids,
+                            )
                 if self.verbose:
                     print(f"[CD] {record}")
                 history.append(record)
@@ -617,6 +662,17 @@ class CoordinateDescent:
                 # coarse-grained per-outer-iteration checkpoint (the
                 # reference's per-stage HDFS writes — SURVEY.md §5.4)
                 checkpoint_callback(it, self._build_model(states))
+
+        # Definitive final metrics: exact host f64 evaluators (per-iteration
+        # device values above are monitoring; model selection reads
+        # history[-1], which must be the reference numbers).
+        if history and validation is not None and evaluators:
+            v_total = np.asarray(val_offsets_dev + sum(val_scores.values()))
+            for ev in evaluators:
+                history[-1][ev.name] = ev.evaluate(
+                    v_total, validation.labels, validation.weights,
+                    validation.group_ids,
+                )
 
         model = self._build_model(states)
         return model, history

@@ -37,7 +37,7 @@ class RandomEffectFitResult:
 
 
 def _solver_for_bucket(local_dim: int, task: str, optimizer: str,
-                       config: OptimizerConfig, compute_variance: bool,
+                       config: OptimizerConfig, compute_variance: bool | str,
                        norm_mode: int = 0):
     """Build the vmapped per-bucket solve function.
 
@@ -63,11 +63,14 @@ def _solver_for_bucket(local_dim: int, task: str, optimizer: str,
             res = opt(fg, w0, l1, config)
         else:
             res = opt(fg, w0, config)
-        var = (
-            obj.coefficient_variances(res.w, batch, l2)
-            if compute_variance
-            else jnp.zeros((0,), res.w.dtype)
-        )
+        # compute_variance: False | True/"diagonal" | "full" — the FULL
+        # (d x d inverse) mode is feasible per entity because local dims
+        # are small; vmap batches the tiny solves.
+        if compute_variance:
+            mode = "full" if compute_variance == "full" else "diagonal"
+            var = obj.coefficient_variances(res.w, batch, l2, mode=mode)
+        else:
+            var = jnp.zeros((0,), res.w.dtype)
         return res.w, var, res.converged, res.iterations
 
     return jax.vmap(solve_one, in_axes=(0,) * 8 + (None, None))
@@ -166,7 +169,7 @@ def train_random_effect(
     w0: Optional[List[np.ndarray]] = None,
     mesh: Optional[Mesh] = None,
     axis: str = "entity",
-    compute_variance: bool = False,
+    compute_variance: bool | str = False,  # False | "diagonal" | "full"
     dtype=jnp.float32,
     normalization: Optional[NormalizationContext] = None,
 ) -> RandomEffectFitResult:

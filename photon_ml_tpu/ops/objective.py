@@ -28,11 +28,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from flax import struct
+from jax import lax
 
 from photon_ml_tpu.ops.losses import PointwiseLoss, get_loss
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.types import (
     LabeledBatch,
+    SparseFeatures,
     margins as _margins,
     row_squares_apply,
     transpose_apply,
@@ -119,8 +121,69 @@ class GLMObjective:
             reg = reg.at[self.intercept_index].set(0.0)
         return diag + reg
 
-    def coefficient_variances(self, w, batch, l2=0.0):
-        """Diagonal-inverse-Hessian coefficient variances (SURVEY.md §4.2)."""
+    def full_hessian(self, w, batch, l2=0.0, chunk_rows: int = 4096):
+        """Explicit d x d Hessian  X'^T diag(w_i l''(m_i)) X' + l2*mask  —
+        the matrix behind the reference's FULL VarianceComputationType
+        (SURVEY.md §3.2 optimization-problems row). Only sensible for small
+        dims (d up to a few thousand: O(d^2) memory, O(n d^2) FLOPs — dense
+        chunks ride the MXU). Rows stream in fixed-size chunks so the dense
+        [n, d] view never materializes."""
+        m = self.margins(w, batch)
+        d2 = batch.weights * self.loss.d2(m, batch.labels)
+        dim = batch.dim
+        n = batch.num_examples
+        c = min(chunk_rows, n)
+        n_chunks = -(-n // c)
+
+        norm = self.normalization
+        f_pin = s_pin = None
+        if norm is not None and norm.factors is not None:
+            f_pin = norm.factors
+            if norm.intercept_index >= 0:
+                f_pin = f_pin.at[norm.intercept_index].set(1.0)
+        if norm is not None and norm.shifts is not None:
+            s_pin = norm.shifts
+            if norm.intercept_index >= 0:
+                s_pin = s_pin.at[norm.intercept_index].set(0.0)
+
+        def chunk_h(i, acc):
+            # clamp the last chunk's start so the slice stays in bounds,
+            # and zero the d2 of rows the previous chunk already covered
+            s0 = jnp.minimum(i * c, n - c)
+            sl = batch.slice_rows(s0, c)
+            dc = lax.dynamic_slice_in_dim(d2, s0, c)
+            dc = dc * (s0 + jnp.arange(c) >= i * c)
+            X = (sl.features.todense()
+                 if isinstance(sl.features, SparseFeatures)
+                 else sl.features)
+            if s_pin is not None:
+                X = X - s_pin[None, :]
+            if f_pin is not None:
+                X = X * f_pin[None, :]
+            return acc + X.T @ (dc[:, None] * X)
+
+        H = lax.fori_loop(
+            0, n_chunks, chunk_h, jnp.zeros((dim, dim), d2.dtype))
+        reg = jnp.full((dim,), l2, H.dtype)
+        if not self.regularize_intercept and self.intercept_index >= 0:
+            reg = reg.at[self.intercept_index].set(0.0)
+        return H + jnp.diag(reg)
+
+    def coefficient_variances(self, w, batch, l2=0.0, mode: str = "diagonal"):
+        """Coefficient variances (SURVEY.md §4.2):
+
+        * ``"diagonal"`` — 1 / diag(H), the reference's SIMPLE type: exact
+          diagonal, cheap at any dim.
+        * ``"full"`` — diag(H^{-1}), the reference's FULL type: accounts
+          for feature correlations; O(d^3) solve, small dims only.
+        """
+        if mode == "full":
+            H = self.full_hessian(w, batch, l2)
+            # diag of the inverse via a full solve against I (d is small)
+            Hinv = jnp.linalg.solve(H, jnp.eye(H.shape[0], dtype=H.dtype))
+            return jnp.diagonal(Hinv)
+        if mode != "diagonal":
+            raise ValueError(f"unknown variance mode {mode!r}")
         diag = self.diagonal_hessian(w, batch, l2)
         return 1.0 / jnp.maximum(diag, jnp.finfo(diag.dtype).tiny)
 

@@ -139,3 +139,63 @@ def test_histogram_auc_sharded_matches_single(rng):
     sharded = float(histogram_auc(scores, labels, weights,
                                   mesh=make_mesh()))
     assert np.isclose(sharded, single, rtol=1e-10, atol=1e-10)
+
+
+def test_make_device_evaluator_parity(rng):
+    """Every device evaluator form matches its host f64 reference on the
+    same data (VERDICT r2 #9 parity requirement); grouped variants have no
+    device form and return None."""
+    from photon_ml_tpu.evaluation import get_evaluator
+    from photon_ml_tpu.evaluation.device import make_device_evaluator
+
+    n = 4000
+    scores = rng.normal(size=n)
+    labels = (rng.random(n) < 0.5).astype(float)
+    weights = rng.random(n) + 0.5
+    for name in ("auc", "rmse", "logistic_loss", "poisson_loss",
+                 "squared_loss", "smoothed_hinge_loss"):
+        fn = make_device_evaluator(name)
+        assert fn is not None, name
+        dev = float(fn(scores, labels, weights))
+        host = get_evaluator(name).evaluate(scores, labels, weights)
+        assert np.isclose(dev, host, rtol=1e-5), (name, dev, host)
+    assert make_device_evaluator("nonexistent_metric") is None
+
+
+def test_cd_loop_device_metrics_match_host(rng):
+    """CD-loop per-iteration device metrics track the host evaluator, and
+    the final history record carries the exact host-f64 value."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.evaluation import get_evaluator
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig, CoordinateDescent, make_game_dataset,
+    )
+
+    n, d = 400, 10
+    X = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-X @ w_true))).astype(float)
+    Xv = rng.normal(size=(n, d))
+    yv = (rng.random(n) < 1 / (1 + np.exp(-Xv @ w_true))).astype(float)
+    train = make_game_dataset({"global": X}, y)
+    val = make_game_dataset({"global": Xv}, yv)
+    cd = CoordinateDescent(
+        [CoordinateConfig(name="fe", feature_shard="global",
+                          reg_type="l2", reg_weight=1.0, max_iters=50)],
+        task="logistic", evaluators=["auc", "logistic_loss"],
+        n_iterations=2,
+    )
+    model, history = cd.run(train, val)
+    # final record == exact host evaluation of the final scores
+    v_scores = np.asarray(
+        model.coordinates["fe"].score(jnp.asarray(Xv)))
+    host_auc = get_evaluator("auc").evaluate(v_scores, yv, np.ones(n))
+    assert np.isclose(history[-1]["auc"], host_auc, atol=1e-9)
+    for rec in history:
+        assert "auc" in rec and "logistic_loss" in rec
+    # the single convex coordinate converges at iteration 0, so iteration
+    # 0's DEVICE-computed AUC scores the same model as the final HOST
+    # value: they must agree to f32 precision (catches argument-slot or
+    # formula regressions in the device path)
+    assert abs(history[0]["auc"] - history[-1]["auc"]) < 1e-4
+    assert abs(history[0]["logistic_loss"] - history[-1]["logistic_loss"]) < 1e-4

@@ -313,3 +313,34 @@ def test_random_effect_normalization_matches_materialized(rng):
                                            n, jnp.float64))
     np.testing.assert_allclose(s_norm, s_mat, rtol=1e-6, atol=1e-8)
     assert fit_norm.converged_fraction == 1.0
+
+
+def test_random_effect_full_variance(rng):
+    """compute_variance='full' on random effects: per-entity diag(H^-1),
+    distinct from the diagonal approximation but equal for a single-feature
+    entity (where H is 1x1)."""
+    n, d = 120, 5
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) < 0.5).astype(float)
+    ids = np.repeat(np.arange(4), n // 4)
+    data = build_random_effect_data(X, y, np.ones(n), ids, num_buckets=1)
+    kw = dict(l2=0.5, dtype=jnp.float64,
+              config=OptimizerConfig(max_iters=100, tolerance=1e-10))
+    fit_d = train_random_effect(data, np.zeros(n), compute_variance="diagonal", **kw)
+    fit_f = train_random_effect(data, np.zeros(n), compute_variance="full", **kw)
+    vd, vf = fit_d.variances[0], fit_f.variances[0]
+    assert vd.shape == vf.shape
+    np.testing.assert_allclose(fit_d.coefficients[0], fit_f.coefficients[0],
+                               rtol=1e-12)
+    assert not np.allclose(vd, vf, rtol=1e-12)  # correlations matter
+    np.testing.assert_allclose(vd, vf, rtol=1.0)  # but same scale
+
+
+def test_coordinate_config_validates_variance():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="compute_variance"):
+        CoordinateConfig(name="x", compute_variance="Full")
+    with _pytest.raises(ValueError, match="streaming"):
+        CoordinateConfig(name="x", compute_variance="full", streaming=True)
+    CoordinateConfig(name="x", compute_variance="full")  # ok

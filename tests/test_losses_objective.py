@@ -120,6 +120,44 @@ def test_diagonal_hessian_matches_full(rng):
     np.testing.assert_allclose(var, 1.0 / np.diagonal(np.asarray(H)), rtol=1e-8)
 
 
+def test_full_hessian_and_full_variance(rng):
+    """full_hessian == autodiff Hessian; coefficient_variances(mode='full')
+    == diag(H^-1) — the reference's FULL VarianceComputationType (SURVEY.md
+    §3.2). Chunking is exercised with chunk_rows < n (uneven last chunk)."""
+    batch, X, y = _rand_batch(rng, n=37, d=5)  # 37: ragged vs chunk_rows=8
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=5) * 0.3)
+    H_ad = jax.hessian(obj.value)(w, batch, 0.2)
+    H = obj.full_hessian(w, batch, 0.2, chunk_rows=8)
+    np.testing.assert_allclose(H, H_ad, rtol=1e-8, atol=1e-10)
+    var = obj.coefficient_variances(w, batch, 0.2, mode="full")
+    np.testing.assert_allclose(
+        var, np.diagonal(np.linalg.inv(np.asarray(H_ad))), rtol=1e-7)
+    # on a well-conditioned near-orthogonal design the diagonal approx and
+    # the full inverse agree to leading order but are NOT identical
+    var_diag = obj.coefficient_variances(w, batch, 0.2, mode="diagonal")
+    assert not np.allclose(var, var_diag, rtol=1e-12)
+    np.testing.assert_allclose(var, var_diag, rtol=0.5)
+
+
+def test_full_hessian_with_normalization(rng):
+    """full_hessian applies the (x - s) * f map exactly like the margin
+    path: compare against the autodiff Hessian of the normalized value."""
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+
+    batch, X, y = _rand_batch(rng, n=24, d=4)
+    norm = NormalizationContext(
+        factors=jnp.asarray(rng.uniform(0.5, 2.0, 4)),
+        shifts=jnp.asarray(rng.normal(size=4) * 0.2),
+        intercept_index=0,
+    )
+    obj = make_objective("logistic", normalization=norm, intercept_index=0)
+    w = jnp.asarray(rng.normal(size=4) * 0.3)
+    H_ad = jax.hessian(obj.value)(w, batch, 0.3)
+    H = obj.full_hessian(w, batch, 0.3, chunk_rows=7)
+    np.testing.assert_allclose(H, H_ad, rtol=1e-8, atol=1e-10)
+
+
 def test_normalization_margin_equivalence(rng):
     # margin over transformed coefficients on raw X == margin of w on normalized X'
     n, d = 40, 6
