@@ -1,0 +1,127 @@
+"""GAME / random-effect hardware bench (VERDICT r2 #5).
+
+Times the second HOT call stack (SURVEY.md §4.3) on the current backend:
+
+1. ``re_solve``: the vmap-of-solvers random-effect path — entities/sec for
+   one bucketed solve sweep at realistic shapes (many small entities).
+2. ``cd_iteration``: one full coordinate-descent iteration — fixed effect
+   (sparse, margin-space L-BFGS) + two random-effect coordinates —
+   wall-clock, compile excluded (one warm iteration first).
+
+Prints one JSON line per metric (these feed docs/PERF.md, not the driver's
+single-line BENCH contract — bench.py remains the headline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.data import build_random_effect_data
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig, CoordinateDescent, make_game_dataset,
+    )
+    from photon_ml_tpu.game.random_effect import train_random_effect
+    from photon_ml_tpu.optimize import OptimizerConfig
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        n_entities, rows_per, local_d = 2000, 32, 16
+        n_fixed, fixed_d, k = 1 << 14, 1 << 12, 24
+    else:
+        # per-member scale: 100k entities x 64 rows x 32 local features
+        n_entities, rows_per, local_d = 100_000, 64, 32
+        n_fixed, fixed_d, k = 1 << 19, 1 << 16, 39
+
+    rng = np.random.default_rng(0)
+
+    # -- 1. raw vmap-of-solvers throughput --------------------------------
+    n_re = n_entities * rows_per
+    ids = np.repeat(np.arange(n_entities), rows_per)
+    # each entity sees a random local_d-subset of a wider space; the
+    # subspace projector makes per-entity dims == local_d exactly
+    Xr_idx = rng.integers(0, local_d, size=(n_re, 8)).astype(np.int32)
+    Xr = np.zeros((n_re, local_d), np.float32)
+    Xr[np.arange(n_re)[:, None], Xr_idx] = rng.normal(
+        size=(n_re, 8)).astype(np.float32)
+    yr = (rng.random(n_re) < 0.5).astype(np.float64)
+    data = build_random_effect_data(Xr, yr, np.ones(n_re), ids,
+                                    num_buckets=1)
+    cfg = OptimizerConfig(max_iters=10, tolerance=0.0)
+
+    def re_solve():
+        fit = train_random_effect(data, np.zeros(n_re), l2=0.5, config=cfg)
+        jax.block_until_ready(fit.coefficients)
+        return fit
+
+    re_solve()  # compile
+    t0 = time.perf_counter()
+    re_solve()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "game_re_vmap_entities_per_sec",
+        "value": round(n_entities / dt, 1),
+        "unit": f"entities/sec ({platform}, E={n_entities}, "
+                f"rows/entity={rows_per}, d_local={local_d}, 10 iters)",
+    }), flush=True)
+
+    # -- 2. one full CD iteration (fixed + 2 random effects) --------------
+    users = rng.integers(0, n_entities, size=n_fixed)
+    items = rng.integers(0, max(n_entities // 10, 10), size=n_fixed)
+    Xf_idx = rng.integers(0, fixed_d, size=(n_fixed, k)).astype(np.int32)
+    Xf_val = np.ones((n_fixed, k), np.float32)
+    from photon_ml_tpu.game.data import HostSparse
+
+    feats = HostSparse(Xf_idx, Xf_val, fixed_d)
+    y = (rng.random(n_fixed) < 0.5).astype(np.float64)
+    train = make_game_dataset({"global": feats}, y,
+                              entity_ids={"user": users, "item": items})
+    cd = CoordinateDescent(
+        [
+            CoordinateConfig("fixed", coordinate_type="fixed",
+                             reg_type="l2", reg_weight=1.0, max_iters=10,
+                             tolerance=0.0),
+            CoordinateConfig("per_user", coordinate_type="random",
+                             entity_column="user", max_iters=5,
+                             num_buckets=2, reg_type="l2", reg_weight=1.0),
+            CoordinateConfig("per_item", coordinate_type="random",
+                             entity_column="item", max_iters=5,
+                             num_buckets=2, reg_type="l2", reg_weight=1.0),
+        ],
+        task="logistic", n_iterations=1,
+    )
+    t0 = time.perf_counter()
+    cd.run(train)  # includes data prep + compile
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _, hist = cd.run(train)
+    dt = time.perf_counter() - t0
+    per_coord = str([round(r["seconds"], 2) for r in hist])
+    print(json.dumps({
+        "metric": "game_cd_iteration_seconds",
+        "value": round(dt, 3),
+        "unit": (f"s/CD-iteration ({platform}, n={n_fixed}, d={fixed_d}, "
+                 f"2 RE coords E~{n_entities}; first(+compile)={warm:.1f}s; "
+                 f"per-coord s: {per_coord}"),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

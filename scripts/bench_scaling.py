@@ -1,0 +1,120 @@
+"""Device-count scaling curve on the virtual CPU mesh (VERDICT r2 #8).
+
+For n_devices in {1, 2, 4, 8}: throughput of the distributed fixed-effect
+fit (margin-space L-BFGS; scatter and csc transposes) and of the
+random-effect vmap-of-solvers sharded over an n-wide ``entity`` axis.
+
+Each width runs in a SUBPROCESS because the XLA host-device count is fixed
+at backend init. Results print as one table.
+
+Caveat recorded with the results: this box has ONE physical core, so all
+virtual devices serialize — the honest reading of the curve is "sharding
+works at every width and partition/collective overhead is X%", not a
+speedup measurement. On real hardware the same harness measures scaling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = r"""
+import json, os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+n_dev = int(os.environ["SCALING_N_DEV"])
+assert len(jax.devices()) == n_dev, (jax.devices(), n_dev)
+
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.parallel.data_parallel import build_csc, fit_distributed
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+n_rows, dim, k, iters = 1 << 15, 1 << 13, 24, 8
+rng = np.random.default_rng(0)
+indices = jnp.asarray(rng.integers(0, dim, (n_rows, k)), jnp.int32)
+values = jnp.ones((n_rows, k), jnp.float32)
+labels = jnp.asarray(rng.integers(0, 2, n_rows), jnp.float32)
+batch = LabeledBatch(SparseFeatures(indices, values, dim=dim), labels,
+                     jnp.zeros((n_rows,), jnp.float32),
+                     jnp.ones((n_rows,), jnp.float32))
+mesh = make_mesh({"data": n_dev})
+obj = make_objective("logistic")
+w0 = jnp.zeros((dim,), jnp.float32)
+cfg = OptimizerConfig(max_iters=iters, tolerance=0.0)
+out = {"n_dev": n_dev}
+
+csc = build_csc(obj, batch, mesh)
+for mode, pc in (("scatter", None), ("csc", csc)):
+    def fit():
+        res = fit_distributed(obj, batch, mesh, w0, l2=1.0, config=cfg,
+                              sparse_grad=mode, precomputed_csc=pc)
+        jax.block_until_ready(res.w)
+        return res
+    fit()  # compile
+    t0 = time.perf_counter(); fit(); dt = time.perf_counter() - t0
+    out[f"fixed_{mode}_rows_per_s"] = round(n_rows * iters / dt, 1)
+
+# random-effect: E entities sharded over an n_dev-wide entity axis
+from photon_ml_tpu.game.data import build_random_effect_data
+from photon_ml_tpu.game.random_effect import train_random_effect
+
+E, per = 512, 16
+ne = E * per
+Xr = rng.normal(size=(ne, 8))
+yr = (rng.random(ne) < 0.5).astype(float)
+ids = np.repeat(np.arange(E), per)
+data = build_random_effect_data(Xr, yr, np.ones(ne), ids, num_buckets=1)
+emesh = make_mesh({"entity": n_dev})
+def refit():
+    return train_random_effect(
+        data, np.zeros(ne), l2=0.5, mesh=emesh,
+        config=OptimizerConfig(max_iters=10, tolerance=0.0))
+refit()  # compile
+t0 = time.perf_counter(); refit(); dt = time.perf_counter() - t0
+out["re_entities_per_s"] = round(E / dt, 1)
+print("SCALING_RESULT " + json.dumps(out))
+"""
+
+
+def main():
+    rows = []
+    for n_dev in (1, 2, 4, 8):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=f"--xla_force_host_platform_device_count={n_dev}",
+                   SCALING_N_DEV=str(n_dev),
+                   PYTHONPATH=os.path.dirname(
+                       os.path.dirname(os.path.abspath(__file__))))
+        proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                              capture_output=True, text=True, timeout=1200)
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("SCALING_RESULT ")]
+        if not line:
+            print(f"n_dev={n_dev} FAILED:\n{proc.stderr[-2000:]}",
+                  file=sys.stderr)
+            continue
+        rows.append(json.loads(line[0][len("SCALING_RESULT "):]))
+
+    cols = ["n_dev", "fixed_scatter_rows_per_s", "fixed_csc_rows_per_s",
+            "re_entities_per_s"]
+    print("\t".join(cols))
+    for r in rows:
+        print("\t".join(str(r.get(c, "-")) for c in cols))
+    base = rows[0] if rows else {}
+    for r in rows[1:]:
+        rel = {c: round(r[c] / base[c], 3) for c in cols[1:]
+               if base.get(c) and r.get(c)}
+        print(f"n_dev={r['n_dev']} vs 1-dev ratio: {rel}")
+
+
+if __name__ == "__main__":
+    main()
