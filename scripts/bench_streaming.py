@@ -1,0 +1,147 @@
+"""Streamed (larger-than-HBM) fit throughput on the current backend
+(VERDICT r2 #3: the north star only runs in this mode and it has zero
+hardware measurements).
+
+Builds a Criteo-shaped dataset in HOST RAM as fixed-shape chunks, runs the
+streamed L-BFGS fit, and reports end-to-end examples/sec INCLUDING
+host->device transfer, next to the in-HBM fit on the same data for the
+streaming-overhead ratio.
+
+The axon tunnel historically wedges on bulk transfers, so chunk_rows
+starts small and the scale can be trimmed: the row count is set by
+--rows-log2 (default 19 on TPU = 512k rows; the r02 bench shape is 21).
+Each configuration runs in-process with a watchdog that reports a TIMEOUT
+line instead of hanging the session.
+
+Usage: python scripts/bench_streaming.py [--rows-log2 N] [--chunk-rows N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows-log2", type=int, default=None)
+    ap.add_argument("--chunk-rows", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    def fire():
+        print(json.dumps({"metric": "streaming_examples_per_sec",
+                          "value": 0.0,
+                          "unit": f"TIMEOUT after {args.timeout:.0f}s"}),
+              flush=True)
+        os._exit(2)
+
+    t = threading.Timer(args.timeout, fire)
+    t.daemon = True
+    t.start()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.data_parallel import fit_distributed
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.parallel.streaming import (
+        HostChunk, fit_streaming,
+    )
+    from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+    platform = jax.devices()[0].platform
+    rows_log2 = args.rows_log2 or (19 if platform != "cpu" else 14)
+    n, k = 1 << rows_log2, 39
+    dim = 1 << 18 if platform != "cpu" else 1 << 13
+    chunk_rows = args.chunk_rows or (1 << 14 if platform != "cpu"
+                                     else 1 << 12)
+    iters = args.iters
+
+    rng = np.random.default_rng(0)
+    indices = rng.integers(0, dim, (n, k)).astype(np.int32)
+    values = np.ones((n, k), np.float32)
+    labels = rng.integers(0, 2, n).astype(np.float32)
+    print(f"host dataset: n={n} k={k} dim={dim} "
+          f"({indices.nbytes/1e9:.2f} GB idx) chunk_rows={chunk_rows}",
+          file=sys.stderr, flush=True)
+
+    chunks = []
+    zeros = np.zeros(chunk_rows, np.float32)
+    ones = np.ones(chunk_rows, np.float32)
+    for s in range(0, n, chunk_rows):
+        e = s + chunk_rows
+        chunks.append(HostChunk(indices[s:e], values[s:e], labels[s:e],
+                                zeros, ones))
+
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=iters, tolerance=0.0)
+    w0 = jnp.zeros((dim,), jnp.float32)
+
+    def stream_fit():
+        res = fit_streaming(obj, chunks, dim, w0, l2=1.0, config=cfg)
+        jax.block_until_ready(res.w)
+        return res
+
+    res = stream_fit()  # compile
+    t0 = time.perf_counter()
+    res = stream_fit()
+    dt_stream = time.perf_counter() - t0
+    done = max(int(res.iterations), 1)
+    v_stream = n * done / dt_stream
+    print(json.dumps({
+        "metric": "streaming_examples_per_sec",
+        "value": round(v_stream, 1),
+        "unit": (f"example-passes/sec end-to-end incl transfer ({platform},"
+                 f" n={n}, d={dim}, k={k}, chunk_rows={chunk_rows},"
+                 f" iters={done})"),
+    }), flush=True)
+
+    # in-HBM comparison on the same data (may OOM at big shapes; guarded)
+    try:
+        batch = LabeledBatch(
+            SparseFeatures(jnp.asarray(indices), jnp.asarray(values),
+                           dim=dim),
+            jnp.asarray(labels), jnp.zeros((n,), jnp.float32),
+            jnp.ones((n,), jnp.float32))
+        mesh = make_mesh()
+
+        def mem_fit():
+            r = fit_distributed(obj, batch, mesh, w0, l2=1.0, config=cfg)
+            jax.block_until_ready(r.w)
+            return r
+
+        r = mem_fit()
+        t0 = time.perf_counter()
+        r = mem_fit()
+        dt_mem = time.perf_counter() - t0
+        v_mem = n * max(int(r.iterations), 1) / dt_mem
+        print(json.dumps({
+            "metric": "in_hbm_examples_per_sec_same_data",
+            "value": round(v_mem, 1),
+            "unit": (f"example-passes/sec ({platform}); streaming/in-HBM ="
+                     f" {v_stream / v_mem:.3f}"),
+        }), flush=True)
+    except Exception as e:
+        print(f"in-HBM comparison skipped: {e}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-3 hardware measurement session: run every prepared TPU experiment
+# in cost order, each with its own timeout so a tunnel wedge loses one
+# experiment, not the session. Logs under docs/tpu_r03_logs/.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR=docs/tpu_r03_logs
+mkdir -p "$LOGDIR"
+
+run() {
+  name=$1; tmo=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$tmo" "$@" >"$LOGDIR/$name.log" 2>&1
+  rc=$?
+  tail -5 "$LOGDIR/$name.log"
+  echo "--- $name rc=$rc"
+}
+
+# 1. Attribute the r02 utilization gap per op
+run profile_hot_loop 1800 python scripts/profile_hot_loop.py
+# 2. The headline bench (margin path + precomputed CSC; vs r02 17.77M)
+run bench 1800 python bench.py
+# 3. GAME / random-effect path
+run bench_game 1800 python scripts/bench_game.py
+# 4. Streamed (larger-than-HBM) fit, small chunks first
+run bench_streaming 1200 python scripts/bench_streaming.py --rows-log2 18 --chunk-rows 8192
+run bench_streaming_big 1800 python scripts/bench_streaming.py --rows-log2 21 --chunk-rows 65536
+# 5. f32-vs-f64 parity on hardware
+run f32_parity 1200 python scripts/f32_parity.py compare --platform axon
+echo "session done; logs in $LOGDIR"
