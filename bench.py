@@ -93,21 +93,21 @@ def main() -> None:
     def make_data(key):
         k_idx, k_w, k_lab = jax.random.split(key, 3)
         indices = jax.random.randint(k_idx, (n_rows, k), 0, dim, jnp.int32)
-        values = jnp.ones((n_rows, k), jnp.float32)
         w_true = jax.random.normal(k_w, (dim,), jnp.float32) * 0.5
         logits = jnp.sum(w_true[indices], axis=1)
         labels = (jax.random.uniform(k_lab, (n_rows,))
                   < jax.nn.sigmoid(logits)).astype(jnp.float32)
-        return indices, values, labels
+        return indices, labels
 
-    indices, values, labels = jax.block_until_ready(
-        make_data(jax.random.key(0))
-    )
+    indices, labels = jax.block_until_ready(make_data(jax.random.key(0)))
 
     mesh = make_mesh()
     obj = make_objective("logistic")
+    # Criteo rows are one-hot categorical: the implicit-ones layout
+    # (values=None) skips the values array entirely — half the bytes per
+    # sparse pass on the HBM-bound hot loop (types.SparseFeatures).
     batch = LabeledBatch(
-        SparseFeatures(indices, values, dim=dim),
+        SparseFeatures(indices, None, dim=dim),
         labels,
         jnp.zeros((n_rows,), jnp.float32),
         jnp.ones((n_rows,), jnp.float32),
@@ -178,14 +178,15 @@ def main() -> None:
     value = n_rows * max(done, 1) / elapsed
 
     # -- utilization model (documented, order-of-magnitude honest) ----------
-    # FLOPs/pass: margin gather-mult-add (2*nnz) + transposed contraction
-    # (2*nnz); pointwise loss math is O(n) and ignored. Bytes/pass: indices
-    # (4B) + values (4B) each read twice (forward gather + backward sort
-    # view), the d-vector traffic is negligible at these shapes.
+    # FLOPs/pass: margin gather-add (nnz) + transposed contraction (nnz);
+    # pointwise loss math is O(n) and ignored. Bytes/pass: int32 indices
+    # (4B) read twice (forward gather + backward transpose view); the
+    # implicit-ones layout has no values array and the d-vector traffic is
+    # negligible at these shapes.
     nnz = n_rows * k
     passes = max(done, 1)
-    flops = 4.0 * nnz * passes / elapsed
-    bytes_touched = 16.0 * nnz * passes / elapsed
+    flops = 2.0 * nnz * passes / elapsed
+    bytes_touched = 8.0 * nnz * passes / elapsed
     # v5e single-chip peaks: ~197 TFLOP/s bf16 MXU, ~819 GB/s HBM. The
     # sparse hot loop is VPU/HBM work, so bandwidth fraction is the real
     # utilization; MFU vs MXU peak is reported for completeness.

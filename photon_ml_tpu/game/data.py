@@ -39,12 +39,12 @@ class HostSparse:
     """Host-side padded sparse matrix (numpy twin of SparseFeatures)."""
 
     indices: np.ndarray  # [n, k] int32
-    values: np.ndarray  # [n, k]
+    values: Optional[np.ndarray]  # [n, k]; None = implicit-ones layout
     dim: int
 
     @property
     def num_rows(self) -> int:
-        return self.values.shape[0]
+        return self.indices.shape[0]
 
 
 def host_sparse_from_dense(X: np.ndarray) -> HostSparse:

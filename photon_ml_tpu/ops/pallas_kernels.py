@@ -106,8 +106,12 @@ def multiply_prefix_sum(
 
 def csc_transpose_apply_pallas(csc, d: jax.Array) -> jax.Array:
     """``X^T d`` from the column-sorted view with the fused Pallas scan
-    (drop-in for ``types.csc_transpose_apply``)."""
-    prefix_incl = multiply_prefix_sum(csc.values, d[csc.rows])
+    (drop-in for ``types.csc_transpose_apply``). The implicit-ones layout
+    materializes a ones vector here (the kernel is a two-operand scan);
+    prefer sparse_grad='csc' for binary data."""
+    values = (jnp.ones_like(d[csc.rows]) if csc.values is None
+              else csc.values)
+    prefix_incl = multiply_prefix_sum(values, d[csc.rows])
     prefix = jnp.concatenate([jnp.zeros((1,), prefix_incl.dtype), prefix_incl])
     out = prefix[csc.col_starts[1:]] - prefix[csc.col_starts[:-1]]
     return out.astype(d.dtype)

@@ -47,19 +47,29 @@ def summarize_features(batch: LabeledBatch) -> FeatureSummary:
     if hasattr(feats, "indices"):
         d = feats.dim
         flat_idx = np.asarray(feats.indices).reshape(-1)
-        flat_val = np.asarray(feats.values, np.float64).reshape(-1)
-        present = flat_val != 0.0
-        idx, val = flat_idx[present], flat_val[present]
-        s1 = np.zeros(d)
-        s2 = np.zeros(d)
-        nnz = np.zeros(d)
-        np.add.at(s1, idx, val)
-        np.add.at(s2, idx, val**2)
-        np.add.at(nnz, idx, 1.0)
-        mx = np.full(d, -np.inf)
-        mn = np.full(d, np.inf)
-        np.maximum.at(mx, idx, val)
-        np.minimum.at(mn, idx, val)
+        if feats.values is None:
+            # implicit-ones layout: every slot is a real 1.0 feature, so
+            # s1 == s2 == nnz == bincount and max == min == 1 where present
+            # (no n*k float materialization — the layout exists to avoid it)
+            nnz = np.bincount(flat_idx, minlength=d).astype(np.float64)
+            s1 = nnz.copy()
+            s2 = nnz.copy()
+            mx = np.where(nnz > 0, 1.0, -np.inf)
+            mn = np.where(nnz > 0, 1.0, np.inf)
+        else:
+            flat_val = np.asarray(feats.values, np.float64).reshape(-1)
+            present = flat_val != 0.0
+            idx, val = flat_idx[present], flat_val[present]
+            s1 = np.zeros(d)
+            s2 = np.zeros(d)
+            nnz = np.zeros(d)
+            np.add.at(s1, idx, val)
+            np.add.at(s2, idx, val**2)
+            np.add.at(nnz, idx, 1.0)
+            mx = np.full(d, -np.inf)
+            mn = np.full(d, np.inf)
+            np.maximum.at(mx, idx, val)
+            np.minimum.at(mn, idx, val)
         # features absent from a row are implicit zeros
         has_zero = nnz < n
         mx = np.where(has_zero, np.maximum(mx, 0.0), mx)

@@ -178,7 +178,8 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
             csc = build_csc_transpose(indices, values, dim)
             # lead with a shard axis so P(axis) concatenation keeps each
             # shard's arrays intact ([n_shards, ...] overall)
-            return (csc.values[None], csc.rows[None], csc.col_starts[None])
+            vals = None if csc.values is None else csc.values[None]
+            return (vals, csc.rows[None], csc.col_starts[None])
 
         return _build(feats.indices, feats.values)
 
@@ -202,7 +203,8 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         from photon_ml_tpu.types import CSCTranspose
 
         f, d = _margin_value_and_d(w, batch)
-        csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
+        csc = CSCTranspose(None if t_values is None else t_values[0],
+                           t_rows[0], t_col_starts[0])
         g = _chain_t(apply_t(csc, d), jnp.sum(d))
         return lax.psum(f, axis), lax.psum(g, axis)
 
@@ -222,7 +224,8 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         v_eff, v_adjust = _eff(v)
         mv = ell_margins(batch.features, v_eff) + v_adjust
         d2 = batch.weights * objective.loss.d2(m, batch.labels)
-        csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
+        csc = CSCTranspose(None if t_values is None else t_values[0],
+                           t_rows[0], t_col_starts[0])
         dv = d2 * mv
         return lax.psum(_chain_t(apply_t(csc, dv), jnp.sum(dv)), axis)
 
@@ -339,7 +342,8 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
 
         per_ex = lambda mm: jnp.sum(weights * loss.loss(mm, labels))
         d1 = jax.grad(per_ex)(m)
-        csc = CSCTranspose(t_values[0], t_rows[0], t_col_starts[0])
+        csc = CSCTranspose(None if t_values is None else t_values[0],
+                           t_rows[0], t_col_starts[0])
         g = _norm_chain_t(norm, apply_t(csc, d1), jnp.sum(d1))
         return lax.psum(g, axis)
 

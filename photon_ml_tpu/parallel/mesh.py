@@ -55,9 +55,13 @@ def pad_batch(batch: LabeledBatch, multiple: int) -> LabeledBatch:
         return batch
     pad0 = lambda a: jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], 0)
     if isinstance(batch.features, SparseFeatures):
+        # implicit-ones (values=None) rows stay value-free: padding rows'
+        # implicit 1.0 slots are neutralized by their weight-0 rows (every
+        # loss/gradient term is weight- or d1-multiplied)
         feats = SparseFeatures(
             indices=pad0(batch.features.indices),
-            values=pad0(batch.features.values),
+            values=(None if batch.features.values is None
+                    else pad0(batch.features.values)),
             dim=batch.features.dim,
         )
     else:

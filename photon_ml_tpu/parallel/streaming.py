@@ -38,7 +38,7 @@ class HostChunk:
     """One fixed-shape chunk resident in host RAM (numpy)."""
 
     indices: np.ndarray  # [rows, k] int32
-    values: np.ndarray  # [rows, k]
+    values: Optional[np.ndarray]  # [rows, k]; None = implicit-ones layout
     labels: np.ndarray  # [rows]
     offsets: np.ndarray  # [rows]
     weights: np.ndarray  # [rows]; padding rows have weight 0
@@ -68,7 +68,10 @@ def make_host_chunks(
 
     if hasattr(features, "indices"):
         indices = np.asarray(features.indices)
-        values = np.asarray(features.values)
+        # implicit-ones layout flows value-free all the way to the device:
+        # at streamed scale the halved chunk transfer is the whole point
+        values = (None if features.values is None
+                  else np.asarray(features.values))
         dim = features.dim
     else:
         dense = np.asarray(features)
@@ -80,9 +83,14 @@ def make_host_chunks(
     if pad_nnz is not None:
         if pad_nnz < k:
             raise ValueError(f"pad_nnz={pad_nnz} < chunk nnz width {k}")
+        if values is None and pad_nnz > k:
+            raise ValueError(
+                "pad_nnz slot padding is invalid for the implicit-ones "
+                "layout (every slot is a real 1.0 feature)")
         pad = pad_nnz - k
         indices = np.pad(indices, ((0, 0), (0, pad)))
-        values = np.pad(values, ((0, 0), (0, pad)))
+        if values is not None:
+            values = np.pad(values, ((0, 0), (0, pad)))
         k = pad_nnz
 
     chunks: List[HostChunk] = []
@@ -92,7 +100,8 @@ def make_host_chunks(
         pad = chunk_rows - rows
         chunks.append(HostChunk(
             indices=np.pad(indices[start:stop], ((0, pad), (0, 0))),
-            values=np.pad(values[start:stop], ((0, pad), (0, 0))),
+            values=(None if values is None
+                    else np.pad(values[start:stop], ((0, pad), (0, 0)))),
             labels=np.pad(labels[start:stop], (0, pad)),
             offsets=np.pad(offsets[start:stop], (0, pad)),
             weights=np.pad(weights[start:stop], (0, pad)),  # pad weight = 0
@@ -120,7 +129,8 @@ def _chunk_to_device(chunk: HostChunk, dim: int, dtype, sharding) -> LabeledBatc
     put = (lambda a: jax.device_put(a, sharding)) if sharding else jax.device_put
     return LabeledBatch(
         SparseFeatures(put(chunk.indices.astype(np.int32)),
-                       put(chunk.values.astype(dtype)), dim=dim),
+                       (None if chunk.values is None
+                        else put(chunk.values.astype(dtype))), dim=dim),
         put(chunk.labels.astype(dtype)),
         put(chunk.offsets.astype(dtype)),
         put(chunk.weights.astype(dtype)),

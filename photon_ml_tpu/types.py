@@ -15,7 +15,7 @@ the hot ops — margin gather and gradient scatter-add — vectorized.
 
 from __future__ import annotations
 
-from typing import Union
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -30,30 +30,40 @@ class SparseFeatures:
     Attributes:
       indices: int32 ``[n, k]`` column ids; padding slots may hold any valid
         index (conventionally 0) because their value is 0.
-      values: ``[n, k]`` feature values; 0.0 in padding slots.
+      values: ``[n, k]`` feature values; 0.0 in padding slots. ``None``
+        declares the implicit-ones (binary/categorical) layout: every slot
+        is a real feature of value 1.0 — Criteo-style one-hot rows with a
+        uniform slot count. This halves the bytes every sparse pass touches
+        (the TPU hot loop is HBM-bound — docs/PERF.md) and is only valid
+        when NO slot is padding (row-level padding with weight-0 rows stays
+        safe: their loss/gradient contributions are weight-multiplied).
       dim: static number of feature columns (the dense width).
     """
 
     indices: jax.Array
-    values: jax.Array
+    values: Optional[jax.Array]
     dim: int = struct.field(pytree_node=False)
 
     @property
     def num_rows(self) -> int:
-        return self.values.shape[0]
+        return self.indices.shape[0]
 
     def slice_rows(self, start: int, size: int) -> "SparseFeatures":
         return SparseFeatures(
             indices=jax.lax.dynamic_slice_in_dim(self.indices, start, size, 0),
-            values=jax.lax.dynamic_slice_in_dim(self.values, start, size, 0),
+            values=(None if self.values is None else
+                    jax.lax.dynamic_slice_in_dim(self.values, start, size, 0)),
             dim=self.dim,
         )
 
     def todense(self) -> jax.Array:
-        n, k = self.values.shape
-        out = jnp.zeros((n, self.dim), self.values.dtype)
+        n, k = self.indices.shape
+        dtype = jnp.float32 if self.values is None else self.values.dtype
+        out = jnp.zeros((n, self.dim), dtype)
         rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
-        return out.at[rows, self.indices].add(self.values)
+        vals = (jnp.ones((n, k), dtype) if self.values is None
+                else self.values)
+        return out.at[rows, self.indices].add(vals)
 
 
 Features = Union[jax.Array, SparseFeatures]
@@ -79,20 +89,22 @@ class CSCTranspose:
         ``values[col_starts[j]:col_starts[j+1]]``.
     """
 
-    values: jax.Array
+    values: Optional[jax.Array]  # None under the implicit-ones layout
     rows: jax.Array
     col_starts: jax.Array
 
 
-def build_csc_transpose(indices: jax.Array, values: jax.Array, dim: int) -> CSCTranspose:
+def build_csc_transpose(indices: jax.Array, values: Optional[jax.Array],
+                        dim: int) -> CSCTranspose:
     """Sort the padded ELL nonzeros by column (pure jax; jit/shard_map safe).
     Padding slots (value 0) are kept — they land in their index's run and
-    contribute 0 to every product."""
+    contribute 0 to every product. ``values=None`` (implicit ones) keeps
+    the sorted view value-free too."""
     n, k = indices.shape
     flat_idx = indices.reshape(-1)
     order = jnp.argsort(flat_idx)
     return CSCTranspose(
-        values=values.reshape(-1)[order],
+        values=None if values is None else values.reshape(-1)[order],
         rows=(order // k).astype(jnp.int32),
         col_starts=jnp.searchsorted(
             flat_idx[order], jnp.arange(dim + 1, dtype=jnp.int32), side="left"
@@ -106,7 +118,8 @@ def csc_transpose_apply(csc: CSCTranspose, d: jax.Array, precise: bool = False) 
     column boundaries. ``precise=True`` runs the prefix sum in f64 (the
     boundary difference of a long f32 prefix loses ~sqrt(nnz)*eps relative
     accuracy; f64 restores it at ~2x cumsum cost)."""
-    contrib = csc.values * d[csc.rows]
+    contrib = (d[csc.rows] if csc.values is None
+               else csc.values * d[csc.rows])
     acc_dtype = jnp.float64 if precise else contrib.dtype
     prefix = jnp.concatenate([
         jnp.zeros((1,), acc_dtype),
@@ -119,6 +132,8 @@ def csc_transpose_apply(csc: CSCTranspose, d: jax.Array, precise: bool = False) 
 def margins(features: Features, w: jax.Array) -> jax.Array:
     """Per-row margin ``x_i . w`` for dense ``[n, d]`` or sparse features."""
     if isinstance(features, SparseFeatures):
+        if features.values is None:  # implicit ones: no value read
+            return jnp.sum(w[features.indices], axis=-1)
         return jnp.sum(features.values * w[features.indices], axis=-1)
     return features @ w
 
@@ -127,11 +142,17 @@ def transpose_apply(features: Features, d: jax.Array) -> jax.Array:
     """``X^T d`` — the gradient-side contraction.
 
     Dense path is a plain matmul (MXU); sparse path is a scatter-add over the
-    padded layout (padding contributes 0 because its value is 0).
+    padded layout (padding contributes 0 because its value is 0; the
+    implicit-ones layout scatters ``d`` directly).
     """
     if isinstance(features, SparseFeatures):
-        contrib = features.values * d[:, None]
-        out = jnp.zeros((features.dim,), contrib.dtype)
+        if features.values is None:
+            n, k = features.indices.shape
+            contrib = jnp.broadcast_to(d[:, None], (n, k))
+            out = jnp.zeros((features.dim,), d.dtype)
+        else:
+            contrib = features.values * d[:, None]
+            out = jnp.zeros((features.dim,), contrib.dtype)
         return out.at[features.indices.reshape(-1)].add(contrib.reshape(-1))
     return features.T @ d
 
@@ -152,6 +173,8 @@ def row_squares_apply(features: Features, d: jax.Array) -> jax.Array:
     """``sum_i d_i * x_i^2`` (elementwise square) — used for diagonal Hessians
     and per-feature second moments (variance computation, SURVEY.md §3.2)."""
     if isinstance(features, SparseFeatures):
+        if features.values is None:  # 1^2 == 1
+            return transpose_apply(features, d)
         contrib = (features.values**2) * d[:, None]
         out = jnp.zeros((features.dim,), contrib.dtype)
         return out.at[features.indices.reshape(-1)].add(contrib.reshape(-1))

@@ -190,3 +190,45 @@ def test_summary_statistics_sparse(rng):
     np.testing.assert_allclose(s.max, X.max(0), atol=1e-12)
     np.testing.assert_allclose(s.min, X.min(0), atol=1e-12)
     np.testing.assert_allclose(s.num_nonzeros, (X != 0).sum(0), atol=0)
+
+
+def test_implicit_ones_layout_matches_explicit(rng):
+    """SparseFeatures(values=None) == the same features with explicit 1.0
+    values across every op the hot loop uses (types.py implicit-ones
+    layout: half the sparse-pass bytes for one-hot/categorical rows)."""
+    from photon_ml_tpu.types import (
+        LabeledBatch, SparseFeatures, build_csc_transpose,
+        csc_transpose_apply, margins, row_squares_apply, transpose_apply,
+    )
+
+    n, d, k = 64, 40, 6
+    indices = jnp.asarray(rng.integers(0, d, (n, k)), jnp.int32)
+    ones = jnp.ones((n, k))
+    binary = SparseFeatures(indices, None, dim=d)
+    explicit = SparseFeatures(indices, ones, dim=d)
+    w = jnp.asarray(rng.normal(size=d))
+    dvec = jnp.asarray(rng.normal(size=n))
+    np.testing.assert_allclose(margins(binary, w), margins(explicit, w))
+    np.testing.assert_allclose(transpose_apply(binary, dvec),
+                               transpose_apply(explicit, dvec))
+    np.testing.assert_allclose(row_squares_apply(binary, dvec),
+                               row_squares_apply(explicit, dvec))
+    np.testing.assert_allclose(binary.todense(), explicit.todense())
+    csc_b = build_csc_transpose(indices, None, d)
+    csc_e = build_csc_transpose(indices, ones, d)
+    assert csc_b.values is None
+    np.testing.assert_allclose(csc_transpose_apply(csc_b, dvec),
+                               csc_transpose_apply(csc_e, dvec))
+    # objective-level parity incl. autodiff through the value-free margin
+    y = (np.asarray(rng.random(n)) < 0.5).astype(float)
+    obj = make_objective("logistic")
+    bb = LabeledBatch(binary, jnp.asarray(y), jnp.zeros(n), jnp.ones(n))
+    be = LabeledBatch(explicit, jnp.asarray(y), jnp.zeros(n), jnp.ones(n))
+    fb, gb = obj.value_and_grad(w, bb, 0.5)
+    fe, ge = obj.value_and_grad(w, be, 0.5)
+    np.testing.assert_allclose(fb, fe)
+    np.testing.assert_allclose(gb, ge)
+    np.testing.assert_allclose(obj.diagonal_hessian(w, bb, 0.5),
+                               obj.diagonal_hessian(w, be, 0.5))
+    np.testing.assert_allclose(obj.full_hessian(w, bb, 0.5, chunk_rows=16),
+                               obj.full_hessian(w, be, 0.5, chunk_rows=16))

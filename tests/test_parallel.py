@@ -187,3 +187,29 @@ def test_tolerance_zero_disables_convergence_tests(rng, mesh):
                               config=OptimizerConfig(max_iters=8, tolerance=0.0),
                               line_search=ls)
         assert int(res.iterations) == 8, ls
+
+
+def test_fit_distributed_implicit_ones(rng, mesh):
+    """The implicit-ones layout fits identically to explicit 1.0 values on
+    every sparse_grad mode, through row padding (weight-0 pad rows
+    neutralize the implicit 1.0 slots) and the margin line search."""
+    from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+    n, d, k = 203, 32, 5  # 203: forces row padding to the 8-way mesh
+    indices = jnp.asarray(rng.integers(0, d, (n, k)), jnp.int32)
+    y = (rng.random(n) < 0.5).astype(float)
+    mk = lambda vals: LabeledBatch(
+        SparseFeatures(indices, vals, dim=d), jnp.asarray(y),
+        jnp.zeros(n), jnp.ones(n))
+    bb, be = mk(None), mk(jnp.ones((n, k)))
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-10)
+    for mode in ("scatter", "csc", "csc_pallas"):
+        rb = fit_distributed(make_objective("logistic"), bb, mesh,
+                             jnp.zeros(d), l2=0.5, config=cfg,
+                             sparse_grad=mode)
+        re = fit_distributed(make_objective("logistic"), be, mesh,
+                             jnp.zeros(d), l2=0.5, config=cfg,
+                             sparse_grad=mode)
+        np.testing.assert_allclose(rb.w, re.w, rtol=1e-9, err_msg=mode)
+        np.testing.assert_allclose(rb.value, re.value, rtol=1e-11,
+                                   err_msg=mode)

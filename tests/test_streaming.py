@@ -270,3 +270,49 @@ def test_game_training_driver_streaming_end_to_end(tmp_path, rng):
         return done[0]["best_metrics"]["auc"]
 
     assert np.isclose(best_auc("out-stream"), best_auc("out-mem"), atol=1e-4)
+
+
+def test_streaming_implicit_ones_matches_explicit(rng):
+    """Value-free (implicit-ones) chunks stream identically to explicit 1.0
+    values — the halved chunk transfer is the layout's whole point at
+    streamed scale."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.game.data import HostSparse
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.streaming import fit_streaming, make_host_chunks
+
+    n, d, k = 500, 40, 6
+    indices = rng.integers(0, d, (n, k)).astype(np.int32)
+    y = (rng.random(n) < 0.5).astype(float)
+    fb = HostSparse(indices, None, d)
+    fe = HostSparse(indices, np.ones((n, k)), d)
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=30, tolerance=1e-10)
+    cb, _ = make_host_chunks(fb, y, chunk_rows=128)
+    ce, _ = make_host_chunks(fe, y, chunk_rows=128)
+    assert cb[0].values is None
+    rb = fit_streaming(obj, cb, d, l2=0.5, config=cfg, dtype=jnp.float64)
+    re = fit_streaming(obj, ce, d, l2=0.5, config=cfg, dtype=jnp.float64)
+    np.testing.assert_allclose(rb.w, re.w, rtol=1e-12)
+    # slot padding is meaningless for implicit ones: loud error
+    with pytest.raises(ValueError, match="implicit-ones"):
+        make_host_chunks(fb, y, chunk_rows=128, pad_nnz=k + 2)
+
+
+def test_summarize_features_implicit_ones(rng):
+    """Implicit-ones summarization == explicit 1.0-values summarization."""
+    from photon_ml_tpu.ops.statistics import summarize_features
+    from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+    import jax.numpy as jnp
+
+    n, d, k = 200, 30, 4
+    indices = jnp.asarray(rng.integers(0, d, (n, k)), jnp.int32)
+    y = jnp.zeros(n)
+    mk = lambda v: LabeledBatch(SparseFeatures(indices, v, dim=d), y,
+                                jnp.zeros(n), jnp.ones(n))
+    sb = summarize_features(mk(None))
+    se = summarize_features(mk(jnp.ones((n, k))))
+    for f in ("mean", "variance", "std", "min", "max", "num_nonzeros"):
+        np.testing.assert_allclose(getattr(sb, f), getattr(se, f),
+                                   err_msg=f)
