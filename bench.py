@@ -144,7 +144,7 @@ def main() -> None:
     mode = os.environ.get("BENCH_SPARSE_GRAD", "auto")
     if mode == "auto":
         times = {}
-        for m in ("scatter", "csc", "csc_pallas"):
+        for m in ("scatter", "csc", "csc_segment", "csc_pallas"):
             try:
                 run(m, 3)  # compile + warm-up
                 t0 = time.perf_counter()

@@ -13,7 +13,8 @@ the loop. The entire optimizer (L-BFGS/TRON/OWL-QN ``while_loop``) jits
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from collections import OrderedDict
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -91,6 +92,36 @@ def distributed_hvp(objective: GLMObjective, mesh: Mesh, axis: str = "data") -> 
     return hvp
 
 
+# Jitted-runner cache: one jit wrapper per (objective, fit configuration),
+# so repeated fits — regularization grids, bench warm-up + timed runs,
+# calibration sweeps — reuse one compiled executable instead of re-tracing
+# and RECOMPILING per call (a fresh ``jax.jit(lambda ...)`` every call made
+# the round-2 bench time compile, not compute, and silently broke the
+# "l2 is traced so a grid reuses one compilation" contract). Keyed by
+# objective identity (objectives hold unhashable arrays) then by the
+# hashable fit configuration; jit's own per-wrapper cache handles argument
+# shapes/dtypes. The runners' closures strongly reference the objective,
+# so entries hold it strongly too (identity stays valid) and growth is
+# bounded by LRU eviction — evicting an entry drops its executables and
+# its objective together.
+_RUNNER_CACHE: "OrderedDict[int, tuple]" = OrderedDict()
+_RUNNER_CACHE_MAX = 16
+
+
+def _runner_cache_for(objective) -> dict:
+    oid = id(objective)
+    entry = _RUNNER_CACHE.get(oid)
+    if entry is not None and entry[0] is objective:
+        _RUNNER_CACHE.move_to_end(oid)
+        return entry[1]
+    runners: dict = {}
+    _RUNNER_CACHE[oid] = (objective, runners)
+    _RUNNER_CACHE.move_to_end(oid)
+    while len(_RUNNER_CACHE) > _RUNNER_CACHE_MAX:
+        _RUNNER_CACHE.popitem(last=False)
+    return runners
+
+
 def _eff_coeffs(norm, w):
     """Optimizer-space w -> (raw-space effective w, scalar margin adj)."""
     if norm is None:
@@ -126,7 +157,8 @@ def _norm_chain_t(norm, gx, d_sum):
 
 
 def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
-                  use_pallas: bool = False, precise: bool = False):
+                  use_pallas: bool = False, precise: bool = False,
+                  segment: bool = False, with_cols: Optional[bool] = None):
     """Scatter-free sparse gradient path (see ``types.CSCTranspose``).
 
     Returns (build, fg, hvp): ``build(batch)`` sorts each shard's nonzeros by
@@ -142,6 +174,8 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     ``g = f̃ ⊙ (Xᵀd) − f̃ s̃ Σd`` (f̃/s̃ have the intercept slot pinned to
     1/0) — both linear, so they commute with the per-shard psum."""
     norm = objective.normalization
+    if with_cols is None:
+        with_cols = segment
 
     def _eff(w):
         return _eff_coeffs(norm, w)
@@ -157,6 +191,10 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
                              "available in the Pallas kernel; use "
                              "sparse_grad='csc_precise'")
         apply_t = csc_transpose_apply_pallas
+    elif segment:
+        from photon_ml_tpu.types import csc_segment_apply
+
+        apply_t = csc_segment_apply
     elif precise:
         # f64 prefix accumulation: at TB-scale nnz an f32 prefix loses
         # ~sqrt(nnz)*eps relative accuracy through boundary-difference
@@ -172,14 +210,20 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
 
         @functools.partial(
             jax.shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
-            out_specs=(P(axis), P(axis), P(axis)),
+            out_specs=P(axis),
         )
         def _build(indices, values):
-            csc = build_csc_transpose(indices, values, dim)
+            # cols only when the segment apply will read them (the rest of
+            # a precomputed view shouldn't carry +4 B/nnz of dead weight;
+            # build_csc passes with_cols=True so one artifact serves every
+            # calibration mode)
+            csc = build_csc_transpose(indices, values, dim,
+                                      with_cols=with_cols)
             # lead with a shard axis so P(axis) concatenation keeps each
-            # shard's arrays intact ([n_shards, ...] overall)
-            vals = None if csc.values is None else csc.values[None]
-            return (vals, csc.rows[None], csc.col_starts[None])
+            # shard's arrays intact ([n_shards, ...] leaves overall); the
+            # whole CSCTranspose travels as one pytree so new fields (cols)
+            # flow through every consumer
+            return jax.tree.map(lambda a: a[None], csc)
 
         return _build(feats.indices, feats.values)
 
@@ -195,28 +239,23 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     # here are explicit psums, so nothing relies on vma-driven transposes)
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P(axis)),
+        in_specs=(P(), P(axis), P(axis)),
         out_specs=(P(), P()),
         check_vma=not use_pallas,
     )
-    def shard_fg(w, batch, t_values, t_rows, t_col_starts):
-        from photon_ml_tpu.types import CSCTranspose
-
+    def shard_fg(w, batch, csc_sh):
         f, d = _margin_value_and_d(w, batch)
-        csc = CSCTranspose(None if t_values is None else t_values[0],
-                           t_rows[0], t_col_starts[0])
+        csc = jax.tree.map(lambda a: a[0], csc_sh)
         g = _chain_t(apply_t(csc, d), jnp.sum(d))
         return lax.psum(f, axis), lax.psum(g, axis)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis)),
+        in_specs=(P(), P(), P(axis), P(axis)),
         out_specs=P(),
         check_vma=not use_pallas,
     )
-    def shard_hvp(w, v, batch, t_values, t_rows, t_col_starts):
-        from photon_ml_tpu.types import CSCTranspose
-
+    def shard_hvp(w, v, batch, csc_sh):
         w_eff, adjust = _eff(w)
         m = ell_margins(batch.features, w_eff) + batch.offsets + adjust
         # directional margin: the margin is linear in w, so the same
@@ -224,27 +263,26 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         v_eff, v_adjust = _eff(v)
         mv = ell_margins(batch.features, v_eff) + v_adjust
         d2 = batch.weights * objective.loss.d2(m, batch.labels)
-        csc = CSCTranspose(None if t_values is None else t_values[0],
-                           t_rows[0], t_col_starts[0])
+        csc = jax.tree.map(lambda a: a[0], csc_sh)
         dv = d2 * mv
         return lax.psum(_chain_t(apply_t(csc, dv), jnp.sum(dv)), axis)
 
     def fg(w, batch, csc, l2=0.0):
         l2 = jnp.asarray(l2, w.dtype)
-        f, g = shard_fg(w, batch, *csc)
+        f, g = shard_fg(w, batch, csc)
         wr = objective._reg_mask(w)
         return f + 0.5 * l2 * jnp.sum(wr * wr), g + l2 * wr
 
     def hvp(w, v, batch, csc, l2=0.0):
         l2 = jnp.asarray(l2, w.dtype)
-        hv = shard_hvp(w, v, batch, *csc)
+        hv = shard_hvp(w, v, batch, csc)
         return hv + l2 * objective._reg_mask(v)
 
     return build, fg, hvp
 
 
 def build_csc(objective: GLMObjective, batch: LabeledBatch, mesh: Mesh,
-              axis: str = "data"):
+              axis: str = "data", with_cols: bool = True):
     """Precompute the column-sorted (CSC) view of a sharded batch ONCE for
     reuse across fits (``fit_distributed(..., precomputed_csc=...)``) —
     regularization grids, hyperparameter calibration, and repeated bench
@@ -252,7 +290,7 @@ def build_csc(objective: GLMObjective, batch: LabeledBatch, mesh: Mesh,
     paid per dataset, not per fit. The batch is padded/sharded exactly as
     ``fit_distributed`` will pad it, so the views line up."""
     batch = shard_batch(batch, mesh, axis)
-    build = make_csc_path(objective, mesh, axis)[0]
+    build = make_csc_path(objective, mesh, axis, with_cols=with_cols)[0]
     return jax.jit(build)(batch)
 
 
@@ -284,6 +322,10 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         from photon_ml_tpu.ops.pallas_kernels import csc_transpose_apply_pallas
 
         apply_t = csc_transpose_apply_pallas
+    elif transpose == "csc_segment":
+        from photon_ml_tpu.types import csc_segment_apply
+
+        apply_t = csc_segment_apply
     elif precise:
         apply_t = functools.partial(csc_transpose_apply, precise=True)
     else:
@@ -333,17 +375,14 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(),
         check_vma=check_vma,
     )
-    def s_grad_csc(m, labels, weights, t_values, t_rows, t_col_starts):
-        from photon_ml_tpu.types import CSCTranspose
-
+    def s_grad_csc(m, labels, weights, csc_sh):
         per_ex = lambda mm: jnp.sum(weights * loss.loss(mm, labels))
         d1 = jax.grad(per_ex)(m)
-        csc = CSCTranspose(None if t_values is None else t_values[0],
-                           t_rows[0], t_col_starts[0])
+        csc = jax.tree.map(lambda a: a[0], csc_sh)
         g = _norm_chain_t(norm, apply_t(csc, d1), jnp.sum(d1))
         return lax.psum(g, axis)
 
@@ -352,7 +391,7 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
             return lambda m: s_grad_scatter(
                 m, batch.features, batch.labels, batch.weights)
         return lambda m: s_grad_csc(
-            m, batch.labels, batch.weights, *csc)
+            m, batch.labels, batch.weights, csc)
 
     return init_margin, dir_margin, loss_and_dir, make_data_grad
 
@@ -363,40 +402,49 @@ def _fit_distributed_margin(
 ) -> OptimizationResult:
     """L-BFGS fit with the margin-space line search: 2 data passes per
     iteration (one gather, one transpose) regardless of line-search effort.
-    ``transpose`` in {"scatter", "csc", "csc_pallas", "csc_precise"}; the
+    ``transpose`` in {"scatter", "csc", "csc_pallas", "csc_precise",
+    "csc_segment"}; the
     csc variants sort the nonzeros once (inside the jit but OUTSIDE the
     optimizer loop), or reuse ``precomputed_csc`` across fits."""
     from photon_ml_tpu.optimize.lbfgs_margin import lbfgs_margin
 
     batch = shard_batch(batch, mesh, axis)
-    init_margin, dir_margin, loss_and_dir, make_data_grad = make_margin_path(
-        objective, mesh, axis, transpose=transpose,
-        precise=(transpose == "csc_precise"),
-    )
-    reg_mask = objective._reg_mask
-    use_csc = transpose in ("csc", "csc_pallas", "csc_precise")
+    use_csc = transpose in ("csc", "csc_pallas", "csc_precise",
+                            "csc_segment")
     if precomputed_csc is not None and not use_csc:
         raise ValueError(
             f"precomputed_csc given but sparse_grad={transpose!r} does not "
             "use it; pass sparse_grad='csc' (or a csc variant)")
-    build = None
-    if use_csc and precomputed_csc is None:
-        build = make_csc_path(
-            objective, mesh, axis,
-            use_pallas=(transpose == "csc_pallas"),
-            precise=(transpose == "csc_precise"),
-        )[0]
 
-    @jax.jit
-    def run(w0, b, l2v, csc):
-        if use_csc and csc is None:
-            csc = build(b)
-        m0 = init_margin(w0, b)
-        return lbfgs_margin(
-            dir_margin(b), loss_and_dir(b), make_data_grad(b, csc),
-            reg_mask, w0, m0, l2v, config,
-        )
+    cache = _runner_cache_for(objective)
+    key = ("margin", mesh, axis, transpose, config,
+           precomputed_csc is not None)
+    run = cache.get(key)
+    if run is None:
+        init_margin, dir_margin, loss_and_dir, make_data_grad = \
+            make_margin_path(objective, mesh, axis, transpose=transpose,
+                             precise=(transpose == "csc_precise"))
+        reg_mask = objective._reg_mask
+        build = None
+        if use_csc and precomputed_csc is None:
+            build = make_csc_path(
+                objective, mesh, axis,
+                use_pallas=(transpose == "csc_pallas"),
+                precise=(transpose == "csc_precise"),
+                segment=(transpose == "csc_segment"),
+            )[0]
 
+        @jax.jit
+        def run(w0, b, l2v, csc):
+            if use_csc and csc is None:
+                csc = build(b)
+            m0 = init_margin(w0, b)
+            return lbfgs_margin(
+                dir_margin(b), loss_and_dir(b), make_data_grad(b, csc),
+                reg_mask, w0, m0, l2v, config,
+            )
+
+        cache[key] = run
     return run(w0, batch, l2, precomputed_csc)
 
 
@@ -420,8 +468,10 @@ def fit_distributed(
     ``sparse_grad``: "scatter" (XLA scatter-add via autodiff transpose),
     "csc" (scatter-free column-sorted gradients — see ``make_csc_path``;
     sorts once per fit on device, best for many-iteration sparse fits on
-    TPU), "csc_pallas" (fused Pallas kernel), or "csc_precise" (CSC with
-    f64 prefix accumulation for very large nnz).
+    TPU), "csc_pallas" (fused Pallas kernel), "csc_precise" (CSC with
+    f64 prefix accumulation for very large nnz), or "csc_segment" (sorted
+    segment-sum: a scatter with indices_are_sorted=True, which XLA can
+    lower without collision ordering).
 
     ``line_search``: "margin" (default, L-BFGS only) runs the strong-Wolfe
     search on cached margin vectors — O(n) per trial, two O(nnz) passes per
@@ -437,11 +487,12 @@ def fit_distributed(
             objective, batch, mesh, w0, l2, config, axis,
             transpose=sparse_grad, precomputed_csc=precomputed_csc,
         )
-    if sparse_grad in ("csc", "csc_pallas", "csc_precise"):
+    if sparse_grad in ("csc", "csc_pallas", "csc_precise", "csc_segment"):
         return _fit_distributed_csc(
             objective, batch, mesh, w0, l2, l1, optimizer, config, axis,
             use_pallas=(sparse_grad == "csc_pallas"),
             precise=(sparse_grad == "csc_precise"),
+            segment=(sparse_grad == "csc_segment"),
             precomputed_csc=precomputed_csc,
         )
     if precomputed_csc is not None:
@@ -449,74 +500,97 @@ def fit_distributed(
             f"precomputed_csc given but sparse_grad={sparse_grad!r} does "
             "not use it; pass sparse_grad='csc' (or a csc variant)")
     batch = shard_batch(batch, mesh, axis)
-    fg = distributed_value_and_grad(objective, mesh, axis)
-    opt = get_optimizer(optimizer)
+    cache = _runner_cache_for(objective)
+    key = ("full", mesh, axis, optimizer, config)
+    run = cache.get(key)
+    if run is None:
+        fg = distributed_value_and_grad(objective, mesh, axis)
+        opt = get_optimizer(optimizer)
+        if optimizer == "owlqn":
+            # L1 intercept mask (consistent with the L2 mask) is
+            # shape-dependent: derive from the traced w0 so the cached
+            # runner serves any dimension
+            mask_int = (objective.intercept_index
+                        if (objective.intercept_index >= 0
+                            and not objective.regularize_intercept) else -1)
 
+            def _owlqn_run(w0, b, l2v, l1v):
+                l1_mask = (None if mask_int < 0
+                           else jnp.ones_like(w0).at[mask_int].set(0.0))
+                return opt(lambda w: fg(w, b, l2v), w0, l1v, config,
+                           l1_mask=l1_mask)
+
+            run = jax.jit(_owlqn_run)
+        elif optimizer == "tron":
+            hvp = distributed_hvp(objective, mesh, axis)
+            run = jax.jit(
+                lambda w0, b, l2v: opt(
+                    lambda w: fg(w, b, l2v), w0, config,
+                    hvp=lambda w, v: hvp(w, v, b, l2v),
+                )
+            )
+        else:
+            run = jax.jit(
+                lambda w0, b, l2v: opt(lambda w: fg(w, b, l2v), w0, config))
+        cache[key] = run
     if optimizer == "owlqn":
-        # keep L1 intercept handling consistent with the objective's L2 mask
-        l1_mask = None
-        if objective.intercept_index >= 0 and not objective.regularize_intercept:
-            l1_mask = jnp.ones_like(w0).at[objective.intercept_index].set(0.0)
-        run = jax.jit(
-            lambda w0, b, l2v, l1v: opt(
-                lambda w: fg(w, b, l2v), w0, l1v, config, l1_mask=l1_mask
-            )
-        )
         return run(w0, batch, l2, l1)
-    if optimizer == "tron":
-        hvp = distributed_hvp(objective, mesh, axis)
-        run = jax.jit(
-            lambda w0, b, l2v: opt(
-                lambda w: fg(w, b, l2v), w0, config,
-                hvp=lambda w, v: hvp(w, v, b, l2v),
-            )
-        )
-        return run(w0, batch, l2)
-    run = jax.jit(lambda w0, b, l2v: opt(lambda w: fg(w, b, l2v), w0, config))
     return run(w0, batch, l2)
 
 
 def _fit_distributed_csc(
     objective, batch, mesh, w0, l2, l1, optimizer, config, axis,
-    use_pallas: bool = False, precise: bool = False, precomputed_csc=None,
+    use_pallas: bool = False, precise: bool = False, segment: bool = False,
+    precomputed_csc=None,
 ) -> OptimizationResult:
     """CSC-path fit: ONE jitted program that sorts the shard nonzeros by
     column (or reuses ``precomputed_csc`` from :func:`build_csc`), then runs
     the whole optimizer loop against the sorted view — sort cost amortizes
     over every iteration (and over every fit when precomputed)."""
     batch = shard_batch(batch, mesh, axis)
-    build, fg, hvp = make_csc_path(objective, mesh, axis,
-                                   use_pallas=use_pallas, precise=precise)
-    opt = get_optimizer(optimizer)
+    cache = _runner_cache_for(objective)
+    key = ("csc", mesh, axis, optimizer, config, use_pallas, precise,
+           segment, precomputed_csc is not None)
+    run = cache.get(key)
+    if run is None:
+        build, fg, hvp = make_csc_path(objective, mesh, axis,
+                                       use_pallas=use_pallas,
+                                       precise=precise, segment=segment)
+        opt = get_optimizer(optimizer)
+        if optimizer == "owlqn":
+            # the mask is shape-dependent: derive it from the traced w0 so
+            # the cached runner serves any dimension
+            mask_int = (objective.intercept_index
+                        if (objective.intercept_index >= 0
+                            and not objective.regularize_intercept) else -1)
 
+            @jax.jit
+            def run(w0, b, l2v, l1v, csc):
+                if csc is None:
+                    csc = build(b)
+                l1_mask = (None if mask_int < 0
+                           else jnp.ones_like(w0).at[mask_int].set(0.0))
+                return opt(lambda w: fg(w, b, csc, l2v), w0, l1v, config,
+                           l1_mask=l1_mask)
+
+        elif optimizer == "tron":
+
+            @jax.jit
+            def run(w0, b, l2v, csc):
+                if csc is None:
+                    csc = build(b)
+                return opt(lambda w: fg(w, b, csc, l2v), w0, config,
+                           hvp=lambda w, v: hvp(w, v, b, csc, l2v))
+
+        else:
+
+            @jax.jit
+            def run(w0, b, l2v, csc):
+                if csc is None:
+                    csc = build(b)
+                return opt(lambda w: fg(w, b, csc, l2v), w0, config)
+
+        cache[key] = run
     if optimizer == "owlqn":
-        l1_mask = None
-        if objective.intercept_index >= 0 and not objective.regularize_intercept:
-            l1_mask = jnp.ones_like(w0).at[objective.intercept_index].set(0.0)
-
-        @jax.jit
-        def run(w0, b, l2v, l1v, csc):
-            if csc is None:
-                csc = build(b)
-            return opt(lambda w: fg(w, b, csc, l2v), w0, l1v, config,
-                       l1_mask=l1_mask)
-
         return run(w0, batch, l2, l1, precomputed_csc)
-    if optimizer == "tron":
-
-        @jax.jit
-        def run(w0, b, l2v, csc):
-            if csc is None:
-                csc = build(b)
-            return opt(lambda w: fg(w, b, csc, l2v), w0, config,
-                       hvp=lambda w, v: hvp(w, v, b, csc, l2v))
-
-        return run(w0, batch, l2, precomputed_csc)
-
-    @jax.jit
-    def run(w0, b, l2v, csc):
-        if csc is None:
-            csc = build(b)
-        return opt(lambda w: fg(w, b, csc, l2v), w0, config)
-
     return run(w0, batch, l2, precomputed_csc)

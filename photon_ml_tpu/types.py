@@ -92,23 +92,31 @@ class CSCTranspose:
     values: Optional[jax.Array]  # None under the implicit-ones layout
     rows: jax.Array
     col_starts: jax.Array
+    # sorted column id per nonzero (== the sort key). Optional: only the
+    # segment-sum apply needs it; cumsum-difference works from col_starts.
+    cols: Optional[jax.Array] = None
 
 
 def build_csc_transpose(indices: jax.Array, values: Optional[jax.Array],
-                        dim: int) -> CSCTranspose:
+                        dim: int, with_cols: bool = True) -> CSCTranspose:
     """Sort the padded ELL nonzeros by column (pure jax; jit/shard_map safe).
     Padding slots (value 0) are kept — they land in their index's run and
     contribute 0 to every product. ``values=None`` (implicit ones) keeps
-    the sorted view value-free too."""
+    the sorted view value-free too. ``with_cols=False`` drops the sorted
+    column-id array (+4 B/nnz) when the segment-sum apply won't be used —
+    in-fit builds are dead-code-eliminated by XLA either way, but a
+    precomputed view materializes every stored leaf."""
     n, k = indices.shape
     flat_idx = indices.reshape(-1)
     order = jnp.argsort(flat_idx)
+    sorted_cols = flat_idx[order]
     return CSCTranspose(
         values=None if values is None else values.reshape(-1)[order],
         rows=(order // k).astype(jnp.int32),
         col_starts=jnp.searchsorted(
-            flat_idx[order], jnp.arange(dim + 1, dtype=jnp.int32), side="left"
+            sorted_cols, jnp.arange(dim + 1, dtype=jnp.int32), side="left"
         ).astype(jnp.int32),
+        cols=sorted_cols.astype(jnp.int32) if with_cols else None,
     )
 
 
@@ -127,6 +135,22 @@ def csc_transpose_apply(csc: CSCTranspose, d: jax.Array, precise: bool = False) 
     ])
     out = prefix[csc.col_starts[1:]] - prefix[csc.col_starts[:-1]]
     return out.astype(d.dtype)
+
+
+def csc_segment_apply(csc: CSCTranspose, d: jax.Array) -> jax.Array:
+    """``X^T d`` from the column-sorted view as a SORTED segment sum: the
+    scatter carries ``indices_are_sorted=True``, which XLA can lower far
+    better than the unordered ELL scatter (no collision ordering to
+    respect). A third strategy for the per-hardware calibration next to
+    the unordered scatter and the cumsum-difference."""
+    if csc.cols is None:
+        raise ValueError("csc.cols missing: rebuild the CSC view "
+                         "(build_csc_transpose now stores sorted cols)")
+    contrib = (d[csc.rows] if csc.values is None
+               else csc.values * d[csc.rows])
+    dim = csc.col_starts.shape[0] - 1
+    return jax.ops.segment_sum(contrib, csc.cols, num_segments=dim,
+                               indices_are_sorted=True)
 
 
 def margins(features: Features, w: jax.Array) -> jax.Array:
