@@ -59,13 +59,26 @@ def host_sparse_from_dense(X: np.ndarray) -> HostSparse:
     return HostSparse(indices, values, d)
 
 
+def materialize_ones(sp: HostSparse) -> HostSparse:
+    """Give an implicit-ones HostSparse explicit 1.0 values. Per-entity
+    subspace remapping carries explicit values through the local views, so
+    the random-effect data layer materializes here (same footprint the
+    caller would have paid with an explicit-values layout); fixed-effect
+    paths stay value-free end to end."""
+    if sp.values is None:
+        return HostSparse(sp.indices, np.ones(sp.indices.shape), sp.dim)
+    return sp
+
+
 def host_sparse_from_features(features) -> HostSparse:
     """Accept SparseFeatures / HostSparse / dense numpy or jax array."""
     if isinstance(features, HostSparse):
         return features
     if isinstance(features, SparseFeatures):
         return HostSparse(
-            np.asarray(features.indices), np.asarray(features.values), features.dim
+            np.asarray(features.indices),
+            None if features.values is None else np.asarray(features.values),
+            features.dim,
         )
     return host_sparse_from_dense(np.asarray(features))
 
@@ -206,7 +219,7 @@ def build_random_effect_data(
     LinearSubspaceProjector role); "random" uses a shared count-sketch of
     width ``projection_dim`` (the RandomProjection role — constant-shape
     entity problems, non-invertible)."""
-    sp = host_sparse_from_features(features)
+    sp = materialize_ones(host_sparse_from_features(features))
     labels = np.asarray(labels, np.float64)
     weights = np.asarray(weights, np.float64)
     n = sp.num_rows
@@ -315,6 +328,7 @@ def build_score_buckets(
 ) -> List[REScoreBucket]:
     """Shared score-view construction: project rows onto each entity's local
     subspace (single code path for train-data views and model-based views)."""
+    sp = materialize_ones(sp)
     out: List[REScoreBucket] = []
     for rows_per_entity, local_maps in zip(per_bucket_rows, local_maps_per_bucket):
         E = len(rows_per_entity)

@@ -179,7 +179,9 @@ def make_game_dataset(features, labels, weights=None, offsets=None,
 
 def _device_features(sp: HostSparse, dtype) -> SparseFeatures:
     return SparseFeatures(
-        jnp.asarray(sp.indices), jnp.asarray(sp.values, dtype), dim=sp.dim
+        jnp.asarray(sp.indices),
+        None if sp.values is None else jnp.asarray(sp.values, dtype),
+        dim=sp.dim
     )
 
 
@@ -260,8 +262,10 @@ class _FixedState:
             t0, t1 = process_span(len(rows)) if pc > 1 else (0, len(rows))
             self._train_span = (t0, t1)
             rows_local = rows[t0:t1]
-            train_sp = HostSparse(np.asarray(sp.indices)[rows_local],
-                                  np.asarray(sp.values)[rows_local], sp.dim)
+            train_sp = HostSparse(
+                np.asarray(sp.indices)[rows_local],
+                (None if sp.values is None
+                 else np.asarray(sp.values)[rows_local]), sp.dim)
             self._chunks, _ = make_host_chunks(
                 train_sp, data.labels[rows_local], None, w[t0:t1],
                 chunk_rows=chunk_rows)
@@ -270,8 +274,10 @@ class _FixedState:
             if cfg.down_sampling_rate >= 1.0 and (t0, t1) == (s0, s1):
                 self._score_chunks = self._chunks  # same rows, same order
             else:
-                score_sp = HostSparse(np.asarray(sp.indices)[s0:s1],
-                                      np.asarray(sp.values)[s0:s1], sp.dim)
+                score_sp = HostSparse(
+                    np.asarray(sp.indices)[s0:s1],
+                    (None if sp.values is None
+                     else np.asarray(sp.values)[s0:s1]), sp.dim)
                 self._score_chunks, _ = make_host_chunks(
                     score_sp, data.labels[s0:s1], chunk_rows=chunk_rows)
             self._last_chunks = self._chunks
@@ -302,8 +308,11 @@ class _FixedState:
         feats = SparseFeatures(
             jnp.asarray(np.concatenate([sp.indices[rows],
                                         np.zeros((pad,) + sp.indices.shape[1:], np.int32)])),
-            jnp.asarray(np.concatenate([sp.values[rows],
-                                        np.zeros((pad,) + sp.values.shape[1:])]), dtype),
+            # implicit-ones HostSparse stays value-free; padding rows are
+            # weight-0 so their implicit 1.0 slots contribute nothing
+            (None if sp.values is None else
+             jnp.asarray(np.concatenate([sp.values[rows],
+                                         np.zeros((pad,) + sp.values.shape[1:])]), dtype)),
             dim=sp.dim,
         )
         labels = jnp.asarray(np.concatenate([data.labels[rows], np.ones(pad)]), dtype)
@@ -433,9 +442,11 @@ class _FixedState:
         w_model = jnp.asarray(w_model, self.dtype)
         outs = []
         for c in self._score_chunks:
-            feats = SparseFeatures(jnp.asarray(c.indices),
-                                   jnp.asarray(c.values, self.dtype),
-                                   dim=self.dim)
+            feats = SparseFeatures(
+                jnp.asarray(c.indices),
+                (None if c.values is None
+                 else jnp.asarray(c.values, self.dtype)),
+                dim=self.dim)
             outs.append(np.asarray(_margins_jit(feats, w_model)))
         s0, s1 = self._score_span
         local = np.concatenate(outs)[: s1 - s0]

@@ -75,7 +75,9 @@ def score_game_model(
         sp = host[coord.feature_shard]
         if isinstance(coord, FixedEffectModel):
             feats = SparseFeatures(
-                jnp.asarray(sp.indices), jnp.asarray(sp.values, dtype), dim=sp.dim
+                jnp.asarray(sp.indices),
+                None if sp.values is None else jnp.asarray(sp.values, dtype),
+                dim=sp.dim,
             )
             s = _margins(feats, jnp.asarray(coord.model.coefficients.means, dtype))
         else:

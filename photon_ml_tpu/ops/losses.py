@@ -22,6 +22,17 @@ import jax
 import jax.numpy as jnp
 
 
+def apply_weights(weights, per_example):
+    """``w * l`` per example with exact-zero weights annihilating
+    non-finite losses. Zero-weight rows are the framework's padding
+    mechanism (mesh.pad_batch, streaming chunks, CD fixed states); under
+    the implicit-ones layout padding rows carry arbitrary margins (k
+    copies of feature 0), so e.g. a Poisson ``exp(margin)`` overflow would
+    turn ``0 * inf`` into NaN and poison the whole sum. The ``where`` also
+    masks the reverse-mode derivative, so gradients stay finite."""
+    return jnp.where(weights != 0, weights * per_example, 0.0)
+
+
 @dataclasses.dataclass(frozen=True)
 class PointwiseLoss:
     """A pointwise loss: per-example ``loss(margin, label)`` plus the inverse

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from flax import struct
 from jax import lax
 
-from photon_ml_tpu.ops.losses import PointwiseLoss, get_loss
+from photon_ml_tpu.ops.losses import PointwiseLoss, apply_weights, get_loss
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.types import (
     LabeledBatch,
@@ -78,7 +78,8 @@ class GLMObjective:
 
     def value(self, w: jax.Array, batch: LabeledBatch, l2=0.0) -> jax.Array:
         m = self.margins(w, batch)
-        data_term = jnp.sum(batch.weights * self.loss.loss(m, batch.labels))
+        data_term = jnp.sum(apply_weights(batch.weights,
+                                          self.loss.loss(m, batch.labels)))
         wr = self._reg_mask(w)
         return data_term + 0.5 * l2 * jnp.sum(wr * wr)
 
@@ -102,7 +103,7 @@ class GLMObjective:
         SURVEY.md §3.2). Expanded so the shifted square never materializes:
         sum d2 (x - s)^2 f^2 = f^2 (sum d2 x^2 - 2 s sum d2 x + s^2 sum d2)."""
         m = self.margins(w, batch)
-        d2 = batch.weights * self.loss.d2(m, batch.labels)
+        d2 = apply_weights(batch.weights, self.loss.d2(m, batch.labels))
         diag = row_squares_apply(batch.features, d2)
         if self.normalization is not None:
             norm = self.normalization
@@ -129,7 +130,7 @@ class GLMObjective:
         chunks ride the MXU). Rows stream in fixed-size chunks so the dense
         [n, d] view never materializes."""
         m = self.margins(w, batch)
-        d2 = batch.weights * self.loss.d2(m, batch.labels)
+        d2 = apply_weights(batch.weights, self.loss.d2(m, batch.labels))
         dim = batch.dim
         n = batch.num_examples
         c = min(chunk_rows, n)

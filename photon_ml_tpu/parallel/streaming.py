@@ -153,10 +153,19 @@ def streaming_value_and_grad(
     if mesh is not None:
         sharding = NamedSharding(mesh, P(axis))
 
-    @jax.jit
-    def chunk_fg(w, batch, f_acc, g_acc):
-        f, g = objective.value_and_grad(w, batch, 0.0)
-        return f_acc + f, g_acc + g
+    # cached per objective: a GAME CD loop re-enters fit_streaming every
+    # iteration — a fresh jit here would recompile the chunk kernel each
+    # time (same failure mode the fit_distributed runner cache fixes)
+    from photon_ml_tpu.parallel.data_parallel import cached_jit
+
+    def _make_chunk_fg():
+        def chunk_fg(w, batch, f_acc, g_acc):
+            f, g = objective.value_and_grad(w, batch, 0.0)
+            return f_acc + f, g_acc + g
+        return chunk_fg
+
+    chunk_fg = cached_jit(objective, ("stream_fg", mesh, axis),
+                          _make_chunk_fg)
 
     def fg(w, l2=0.0):
         w = jnp.asarray(w, dtype)
@@ -191,10 +200,11 @@ def streaming_hvp(
     of the reference's HessianVectorAggregator treeAggregate per CG step
     (SURVEY.md §4.2), with chunks instead of cluster partitions."""
     sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
+    from photon_ml_tpu.parallel.data_parallel import cached_jit
 
-    @jax.jit
-    def chunk_hvp(w, v, batch, acc):
-        return acc + objective.hvp(w, v, batch, 0.0)
+    chunk_hvp = cached_jit(
+        objective, ("stream_hvp", mesh, axis),
+        lambda: lambda w, v, batch, acc: acc + objective.hvp(w, v, batch, 0.0))
 
     def hvp(w, v, l2=0.0):
         w = jnp.asarray(w, dtype)
@@ -224,10 +234,12 @@ def streaming_coefficient_variances(
     data term accumulates per chunk (l2=0 adds nothing); the regularization
     diagonal is added once at the end."""
     sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
+    from photon_ml_tpu.parallel.data_parallel import cached_jit
 
-    @jax.jit
-    def chunk_diag(w, batch, acc):
-        return acc + objective.diagonal_hessian(w, batch, 0.0)
+    chunk_diag = cached_jit(
+        objective, ("stream_diag", mesh, axis),
+        lambda: lambda w, batch, acc: acc + objective.diagonal_hessian(
+            w, batch, 0.0))
 
     w = jnp.asarray(w, dtype)
     acc = jnp.zeros((dim,), dtype)

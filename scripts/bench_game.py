@@ -105,21 +105,24 @@ def main():
                              entity_column="item", max_iters=5,
                              num_buckets=2, reg_type="l2", reg_weight=1.0),
         ],
-        task="logistic", n_iterations=1,
+        task="logistic", n_iterations=3,
     )
-    t0 = time.perf_counter()
-    cd.run(train)  # includes data prep + compile
-    warm = time.perf_counter() - t0
+    # ONE run of 3 CD iterations: iteration 0 pays data prep + compiles
+    # (states/jits are per-run), the LAST iteration is the warm number
     t0 = time.perf_counter()
     _, hist = cd.run(train)
-    dt = time.perf_counter() - t0
-    per_coord = str([round(r["seconds"], 2) for r in hist])
+    total = time.perf_counter() - t0
+    n_coords = 3
+    last = hist[-n_coords:]
+    warm_iter = sum(r["seconds"] for r in last)
+    per_coord = str([round(r["seconds"], 2) for r in last])
     print(json.dumps({
         "metric": "game_cd_iteration_seconds",
-        "value": round(dt, 3),
-        "unit": (f"s/CD-iteration ({platform}, n={n_fixed}, d={fixed_d}, "
-                 f"2 RE coords E~{n_entities}; first(+compile)={warm:.1f}s; "
-                 f"per-coord s: {per_coord}"),
+        "value": round(warm_iter, 3),
+        "unit": (f"s/warm-CD-iteration ({platform}, n={n_fixed}, "
+                 f"d={fixed_d}, 2 RE coords E~{n_entities}; full 3-iter run "
+                 f"incl prep+compile={total:.1f}s; warm per-coord s: "
+                 f"{per_coord}"),
     }), flush=True)
 
 

@@ -344,3 +344,31 @@ def test_coordinate_config_validates_variance():
     with _pytest.raises(ValueError, match="streaming"):
         CoordinateConfig(name="x", compute_variance="full", streaming=True)
     CoordinateConfig(name="x", compute_variance="full")  # ok
+
+
+def test_game_with_implicit_ones_features(rng):
+    """A full GAME run (fixed + random effect + transformer scoring) over
+    the implicit-ones layout == the same run with explicit 1.0 values."""
+    from photon_ml_tpu.estimators import GameTransformer
+    from photon_ml_tpu.game.data import HostSparse
+
+    n, d, k = 600, 50, 5
+    idx = rng.integers(0, d, (n, k)).astype(np.int32)
+    y = (rng.random(n) < 0.5).astype(float)
+    users = rng.integers(0, 12, n)
+    configs = [
+        CoordinateConfig(name="fe", feature_shard="global", reg_type="l2",
+                         reg_weight=1.0, max_iters=20),
+        CoordinateConfig(name="per_user", coordinate_type="random",
+                         entity_column="user", reg_type="l2",
+                         reg_weight=1.0, max_iters=8, num_buckets=2),
+    ]
+    preds = {}
+    for name, vals in (("binary", None), ("explicit", np.ones((n, k)))):
+        train = make_game_dataset({"global": HostSparse(idx, vals, d)}, y,
+                                  entity_ids={"user": users})
+        cd = CoordinateDescent(configs, task="logistic", n_iterations=2)
+        model, _ = cd.run(train)
+        preds[name] = GameTransformer(model).predict_mean(train)
+    np.testing.assert_allclose(preds["binary"], preds["explicit"],
+                               rtol=1e-6, atol=1e-7)

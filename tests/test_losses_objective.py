@@ -232,3 +232,33 @@ def test_implicit_ones_layout_matches_explicit(rng):
                                obj.diagonal_hessian(w, be, 0.5))
     np.testing.assert_allclose(obj.full_hessian(w, bb, 0.5, chunk_rows=16),
                                obj.full_hessian(w, be, 0.5, chunk_rows=16))
+
+
+def test_zero_weight_rows_annihilate_nonfinite_losses(rng):
+    """Padding rows (weight 0) must contribute exactly 0 even when their
+    margin overflows the loss — under the implicit-ones layout a padding
+    row is k copies of feature 0, so a Poisson fit with large w[0] would
+    otherwise compute 0 * exp(overflow) = NaN (losses.apply_weights)."""
+    from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+    n, d, k = 8, 4, 50
+    indices = jnp.zeros((n, k), jnp.int32)  # every slot hits feature 0
+    weights = jnp.asarray([1.0] * 4 + [0.0] * 4)  # rows 4..7 are padding
+    labels = jnp.ones((n,))
+    batch = LabeledBatch(SparseFeatures(indices, None, dim=d), labels,
+                         jnp.zeros((n,)), weights)
+    obj = make_objective("poisson")
+    w = jnp.zeros((d,)).at[0].set(100.0)  # margin = 5000 -> exp overflows
+    f, g = obj.value_and_grad(w, batch, 0.0)
+    # the 4 real rows genuinely overflow (margin 5000), so f is inf — but
+    # NOT NaN: the padding rows contributed nothing
+    assert not jnp.isnan(f)
+    w_ok = jnp.zeros((d,)).at[0].set(0.01)  # real rows finite
+    f2, g2 = obj.value_and_grad(w_ok, batch, 0.0)
+    assert jnp.isfinite(f2) and jnp.isfinite(g2).all()
+    # exact equality with the same batch truncated to the real rows
+    real = LabeledBatch(SparseFeatures(indices[:4], None, dim=d),
+                        labels[:4], jnp.zeros((4,)), weights[:4])
+    f3, g3 = obj.value_and_grad(w_ok, real, 0.0)
+    np.testing.assert_allclose(f2, f3, rtol=1e-12)
+    np.testing.assert_allclose(g2, g3, rtol=1e-12)
