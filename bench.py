@@ -125,16 +125,27 @@ def main() -> None:
         print(f"csc precompute failed ({e}); csc modes will sort in-fit",
               file=sys.stderr)
 
-    def run(sparse_grad, n_iters):
+    def run(sparse_grad, n_iters, salt=0):
         # tolerance=0 disables convergence tests -> the iteration count is
-        # exact (optimize/common.py honors an explicit 0 since round 3)
+        # exact (optimize/common.py honors an explicit 0 since round 3).
+        # ``salt`` perturbs w0 so a timed run is a genuinely different
+        # computation from its warm-up: the r03 hardware session produced
+        # 0.7ms "fits" over 82M nnz when warm-up and timed calls were
+        # bit-identical — the axon remote backend appears to satisfy
+        # repeated identical executions without re-running them, and
+        # block_until_ready alone does not expose that.
         res = fit_distributed(
-            obj, batch, mesh, w0, l2=1.0, optimizer="lbfgs",
+            obj, batch, mesh, w0 + jnp.float32(salt) * 1e-8, l2=1.0,
+            optimizer="lbfgs",
             config=OptimizerConfig(max_iters=n_iters, tolerance=0.0),
             sparse_grad=sparse_grad,
             precomputed_csc=(csc if sparse_grad.startswith("csc") else None),
         )
-        jax.block_until_ready(res.w)
+        # sync by SCALAR FETCH, not block_until_ready: a device->host read
+        # of the result cannot complete before the computation has actually
+        # run, whatever the transfer/queue semantics of the backend.
+        res = res._replace(iterations=int(res.iterations),
+                           value=float(res.value))
         return res
 
     # Sparse-gradient strategy space (scatter-add vs scatter-free CSC prefix
@@ -144,11 +155,11 @@ def main() -> None:
     mode = os.environ.get("BENCH_SPARSE_GRAD", "auto")
     if mode == "auto":
         times = {}
-        for m in ("scatter", "csc", "csc_segment", "csc_pallas"):
+        for i, m in enumerate(("scatter", "csc", "csc_segment", "csc_pallas")):
             try:
-                run(m, 3)  # compile + warm-up
+                run(m, 3, salt=1)  # compile + warm-up
                 t0 = time.perf_counter()
-                run(m, 3)
+                run(m, 3, salt=2 + i)
                 times[m] = time.perf_counter() - t0
             except Exception as e:  # a mode that fails to lower is skipped
                 print(f"calibration: {m} failed: {e}", file=sys.stderr)
@@ -159,8 +170,9 @@ def main() -> None:
         if mode != "scatter" and "scatter" in times:
             w_ref = run("scatter", 3).w
             w_got = run(mode, 3).w
-            dev_rel = float(jnp.linalg.norm(w_got - w_ref)
-                            / jnp.maximum(jnp.linalg.norm(w_ref), 1e-30))
+            w_ref, w_got = map(np.asarray, (w_ref, w_got))
+            dev_rel = float(np.linalg.norm(w_got - w_ref)
+                            / max(np.linalg.norm(w_ref), 1e-30))
             print(f"calibration accuracy: |w_{mode} - w_scatter| rel = "
                   f"{dev_rel:.2e}", file=sys.stderr)
             if dev_rel > 1e-3:
@@ -169,9 +181,9 @@ def main() -> None:
                       file=sys.stderr)
                 mode = "scatter"
 
-    run(mode, iters)  # compile + warm-up
+    run(mode, iters, salt=101)  # compile + warm-up
     t0 = time.perf_counter()
-    res = run(mode, iters)
+    res = run(mode, iters, salt=102)  # scalar-fetch-synced inside run()
     elapsed = time.perf_counter() - t0
 
     done = int(res.iterations)

@@ -28,9 +28,25 @@ def apply_weights(weights, per_example):
     mechanism (mesh.pad_batch, streaming chunks, CD fixed states); under
     the implicit-ones layout padding rows carry arbitrary margins (k
     copies of feature 0), so e.g. a Poisson ``exp(margin)`` overflow would
-    turn ``0 * inf`` into NaN and poison the whole sum. The ``where`` also
-    masks the reverse-mode derivative, so gradients stay finite."""
+    turn ``0 * inf`` into NaN and poison the whole sum.
+
+    VALUE protection only: reverse-mode AD through this ``where`` still
+    multiplies the pad-branch cotangent (0) by the upstream loss
+    derivative, and ``0 * inf = NaN`` (the classic double-where pitfall).
+    Every differentiated path must therefore ALSO run its margins through
+    :func:`mask_margins` before the loss touches them."""
     return jnp.where(weights != 0, weights * per_example, 0.0)
+
+
+def mask_margins(weights, margins):
+    """Zero the margin on exactly-zero-weight (padding) rows BEFORE the
+    loss is evaluated. ``loss(0, label)`` is finite for every loss family,
+    so with masked margins no pad-row intermediate is ever non-finite and
+    gradients/HVPs through :func:`apply_weights` stay finite (masking only
+    the loss value is not enough — see the double-where note there).
+    Differentiating through this ``where`` hard-zeroes pad-row cotangents,
+    which is exactly the weight-0 semantics."""
+    return jnp.where(weights != 0, margins, 0.0)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -30,7 +30,9 @@ import jax.numpy as jnp
 from flax import struct
 from jax import lax
 
-from photon_ml_tpu.ops.losses import PointwiseLoss, apply_weights, get_loss
+from photon_ml_tpu.ops.losses import (
+    PointwiseLoss, apply_weights, get_loss, mask_margins,
+)
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.types import (
     LabeledBatch,
@@ -77,7 +79,7 @@ class GLMObjective:
         return w.at[self.intercept_index].set(0.0)
 
     def value(self, w: jax.Array, batch: LabeledBatch, l2=0.0) -> jax.Array:
-        m = self.margins(w, batch)
+        m = mask_margins(batch.weights, self.margins(w, batch))
         data_term = jnp.sum(apply_weights(batch.weights,
                                           self.loss.loss(m, batch.labels)))
         wr = self._reg_mask(w)
@@ -102,7 +104,7 @@ class GLMObjective:
         diagonal-Hessian aggregator, VarianceComputationType.SIMPLE —
         SURVEY.md §3.2). Expanded so the shifted square never materializes:
         sum d2 (x - s)^2 f^2 = f^2 (sum d2 x^2 - 2 s sum d2 x + s^2 sum d2)."""
-        m = self.margins(w, batch)
+        m = mask_margins(batch.weights, self.margins(w, batch))
         d2 = apply_weights(batch.weights, self.loss.d2(m, batch.labels))
         diag = row_squares_apply(batch.features, d2)
         if self.normalization is not None:
@@ -129,7 +131,7 @@ class GLMObjective:
         dims (d up to a few thousand: O(d^2) memory, O(n d^2) FLOPs — dense
         chunks ride the MXU). Rows stream in fixed-size chunks so the dense
         [n, d] view never materializes."""
-        m = self.margins(w, batch)
+        m = mask_margins(batch.weights, self.margins(w, batch))
         d2 = apply_weights(batch.weights, self.loss.d2(m, batch.labels))
         dim = batch.dim
         n = batch.num_examples

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from photon_ml_tpu.ops.losses import apply_weights
+from photon_ml_tpu.ops.losses import apply_weights, mask_margins
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
 from photon_ml_tpu.optimize.common import OptimizationResult
@@ -243,7 +243,9 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         w_eff, adjust = _eff(w)
         m = ell_margins(batch.features, w_eff) + batch.offsets + adjust
         per_ex = lambda m: jnp.sum(apply_weights(
-            batch.weights, objective.loss.loss(m, batch.labels)))
+            batch.weights,
+            objective.loss.loss(mask_margins(batch.weights, m),
+                                batch.labels)))
         f, d = jax.value_and_grad(per_ex)(m)
         return f, d
 
@@ -276,7 +278,9 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         v_eff, v_adjust = _eff(v)
         mv = ell_margins(batch.features, v_eff) + v_adjust
         d2 = apply_weights(batch.weights,
-                           objective.loss.d2(m, batch.labels))
+                           objective.loss.d2(
+                               mask_margins(batch.weights, m),
+                               batch.labels))
         csc = jax.tree.map(lambda a: a[0], csc_sh)
         dv = d2 * mv
         return lax.psum(_chain_t(apply_t(csc, dv), jnp.sum(dv)), axis)
@@ -369,8 +373,8 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         out_specs=(P(), P()),
     )
     def s_loss_and_dir(m, mp, labels, weights):
-        per_ex = lambda mm: jnp.sum(apply_weights(weights,
-                                                  loss.loss(mm, labels)))
+        per_ex = lambda mm: jnp.sum(apply_weights(
+            weights, loss.loss(mask_margins(weights, mm), labels)))
         f, d1 = jax.value_and_grad(per_ex)(m)
         return lax.psum(f, axis), lax.psum(jnp.sum(d1 * mp), axis)
 
@@ -383,8 +387,8 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         out_specs=P(),
     )
     def s_grad_scatter(m, feats, labels, weights):
-        per_ex = lambda mm: jnp.sum(apply_weights(weights,
-                                                  loss.loss(mm, labels)))
+        per_ex = lambda mm: jnp.sum(apply_weights(
+            weights, loss.loss(mask_margins(weights, mm), labels)))
         d1 = jax.grad(per_ex)(m)
         g = _norm_chain_t(norm, transpose_apply(feats, d1), jnp.sum(d1))
         return lax.psum(g, axis)
@@ -396,8 +400,8 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         check_vma=check_vma,
     )
     def s_grad_csc(m, labels, weights, csc_sh):
-        per_ex = lambda mm: jnp.sum(apply_weights(weights,
-                                                  loss.loss(mm, labels)))
+        per_ex = lambda mm: jnp.sum(apply_weights(
+            weights, loss.loss(mask_margins(weights, mm), labels)))
         d1 = jax.grad(per_ex)(m)
         csc = jax.tree.map(lambda a: a[0], csc_sh)
         g = _norm_chain_t(norm, apply_t(csc, d1), jnp.sum(d1))

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.parallel.data_parallel import cached_jit
 from photon_ml_tpu.optimize.common import OptimizationResult, OptimizerConfig
 from photon_ml_tpu.optimize.lbfgs import two_loop_direction
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures
@@ -156,7 +157,6 @@ def streaming_value_and_grad(
     # cached per objective: a GAME CD loop re-enters fit_streaming every
     # iteration — a fresh jit here would recompile the chunk kernel each
     # time (same failure mode the fit_distributed runner cache fixes)
-    from photon_ml_tpu.parallel.data_parallel import cached_jit
 
     def _make_chunk_fg():
         def chunk_fg(w, batch, f_acc, g_acc):
@@ -200,7 +200,6 @@ def streaming_hvp(
     of the reference's HessianVectorAggregator treeAggregate per CG step
     (SURVEY.md §4.2), with chunks instead of cluster partitions."""
     sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
-    from photon_ml_tpu.parallel.data_parallel import cached_jit
 
     chunk_hvp = cached_jit(
         objective, ("stream_hvp", mesh, axis),
@@ -234,7 +233,6 @@ def streaming_coefficient_variances(
     data term accumulates per chunk (l2=0 adds nothing); the regularization
     diagonal is added once at the end."""
     sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
-    from photon_ml_tpu.parallel.data_parallel import cached_jit
 
     chunk_diag = cached_jit(
         objective, ("stream_diag", mesh, axis),

@@ -24,7 +24,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def _arm_watchdog(timeout_s: float = 1500.0) -> None:
+    """The axon tunnel can wedge indefinitely; die loudly instead."""
+    import threading
+
+    def fire():
+        print(json.dumps({"metric": "game_bench", "value": 0.0,
+                          "unit": f"TIMEOUT after {timeout_s:.0f}s"}),
+              flush=True)
+        os._exit(2)
+
+    t = threading.Timer(timeout_s, fire)
+    t.daemon = True
+    t.start()
+
+
 def main():
+    _arm_watchdog(float(os.environ.get("BENCH_TIMEOUT_S", 1500)))
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -34,7 +50,7 @@ def main():
             pass
     import jax.numpy as jnp
 
-    from photon_ml_tpu.game.data import build_random_effect_data
+    from photon_ml_tpu.game.data import REBucket, RandomEffectTrainData
     from photon_ml_tpu.game.descent import (
         CoordinateConfig, CoordinateDescent, make_game_dataset,
     )
@@ -46,35 +62,61 @@ def main():
         n_entities, rows_per, local_d = 2000, 32, 16
         n_fixed, fixed_d, k = 1 << 14, 1 << 12, 24
     else:
-        # per-member scale: 100k entities x 64 rows x 32 local features
+        # per-member scale: 100k entities x 64 rows x 32 local features.
+        # The fixed-effect block stays modest: the r03 session showed the
+        # tunnel wedges (and once crashed the worker) on bulk host->device
+        # transfers, so everything large is synthesized ON DEVICE and the
+        # host-built CD dataset is kept to tens of MB.
         n_entities, rows_per, local_d = 100_000, 64, 32
-        n_fixed, fixed_d, k = 1 << 19, 1 << 16, 39
+        n_fixed, fixed_d, k = 1 << 17, 1 << 16, 39
 
     rng = np.random.default_rng(0)
 
     # -- 1. raw vmap-of-solvers throughput --------------------------------
+    # One size bucket of E entities, padded layout [E, N, kk] — built
+    # directly on device (the host path build_random_effect_data is
+    # ingestion code; its output layout is what matters to the solver).
     n_re = n_entities * rows_per
-    ids = np.repeat(np.arange(n_entities), rows_per)
-    # each entity sees a random local_d-subset of a wider space; the
-    # subspace projector makes per-entity dims == local_d exactly
-    Xr_idx = rng.integers(0, local_d, size=(n_re, 8)).astype(np.int32)
-    Xr = np.zeros((n_re, local_d), np.float32)
-    Xr[np.arange(n_re)[:, None], Xr_idx] = rng.normal(
-        size=(n_re, 8)).astype(np.float32)
-    yr = (rng.random(n_re) < 0.5).astype(np.float64)
-    data = build_random_effect_data(Xr, yr, np.ones(n_re), ids,
-                                    num_buckets=1)
+    kk = 8  # nonzeros per row within the local_d-dim subspace
+
+    @jax.jit
+    def make_re(key):
+        k_idx, k_val, k_lab = jax.random.split(key, 3)
+        idx = jax.random.randint(
+            k_idx, (n_entities, rows_per, kk), 0, local_d, jnp.int32)
+        val = jax.random.normal(k_val, (n_entities, rows_per, kk),
+                                jnp.float32)
+        lab = (jax.random.uniform(k_lab, (n_entities, rows_per))
+               < 0.5).astype(jnp.float32)
+        wts = jnp.ones((n_entities, rows_per), jnp.float32)
+        sidx = jnp.arange(n_re, dtype=jnp.int32).reshape(
+            n_entities, rows_per)
+        proj = jnp.broadcast_to(jnp.arange(local_d, dtype=jnp.int32),
+                                (n_entities, local_d))
+        return idx, val, lab, wts, sidx, proj
+
+    idx, val, lab, wts, sidx, proj = jax.block_until_ready(
+        make_re(jax.random.key(0)))
+    bucket = REBucket(entity_ids=np.arange(n_entities), indices=idx,
+                      values=val, labels=lab, weights=wts, sample_idx=sidx,
+                      projection=proj, local_maps=[])
+    data = RandomEffectTrainData("random", [bucket], n_re, {})
+    offsets = jnp.zeros((n_re,), jnp.float32)
     cfg = OptimizerConfig(max_iters=10, tolerance=0.0)
 
-    def re_solve():
-        fit = train_random_effect(data, np.zeros(n_re), l2=0.5, config=cfg)
-        jax.block_until_ready(fit.coefficients)
-        return fit
+    def re_solve(l2):
+        # l2 is a traced scalar: varying it between warm-up and timed run
+        # makes the timed call a distinct computation (the axon remote
+        # backend appears to memoize bit-identical executions) without
+        # recompiling. train_random_effect np.asarray()s the coefficients,
+        # which host-syncs the result.
+        return train_random_effect(data, offsets, l2=l2, config=cfg)
 
-    re_solve()  # compile
+    re_solve(0.5)  # compile + warm-up
     t0 = time.perf_counter()
-    re_solve()
+    fit = re_solve(0.5000001)
     dt = time.perf_counter() - t0
+    assert float(np.abs(fit.coefficients[0]).sum()) > 0
     print(json.dumps({
         "metric": "game_re_vmap_entities_per_sec",
         "value": round(n_entities / dt, 1),
@@ -86,15 +128,14 @@ def main():
     users = rng.integers(0, n_entities, size=n_fixed)
     items = rng.integers(0, max(n_entities // 10, 10), size=n_fixed)
     Xf_idx = rng.integers(0, fixed_d, size=(n_fixed, k)).astype(np.int32)
-    Xf_val = np.ones((n_fixed, k), np.float32)
     from photon_ml_tpu.game.data import HostSparse
 
-    feats = HostSparse(Xf_idx, Xf_val, fixed_d)
+    # implicit-ones layout: no values array -> half the host->device bytes
+    feats = HostSparse(Xf_idx, None, fixed_d)
     y = (rng.random(n_fixed) < 0.5).astype(np.float64)
     train = make_game_dataset({"global": feats}, y,
                               entity_ids={"user": users, "item": items})
-    cd = CoordinateDescent(
-        [
+    coord_configs = [
             CoordinateConfig("fixed", coordinate_type="fixed",
                              reg_type="l2", reg_weight=1.0, max_iters=10,
                              tolerance=0.0),
@@ -104,15 +145,14 @@ def main():
             CoordinateConfig("per_item", coordinate_type="random",
                              entity_column="item", max_iters=5,
                              num_buckets=2, reg_type="l2", reg_weight=1.0),
-        ],
-        task="logistic", n_iterations=3,
-    )
+    ]
+    cd = CoordinateDescent(coord_configs, task="logistic", n_iterations=3)
     # ONE run of 3 CD iterations: iteration 0 pays data prep + compiles
     # (states/jits are per-run), the LAST iteration is the warm number
     t0 = time.perf_counter()
     _, hist = cd.run(train)
     total = time.perf_counter() - t0
-    n_coords = 3
+    n_coords = len(coord_configs)
     last = hist[-n_coords:]
     warm_iter = sum(r["seconds"] for r in last)
     per_coord = str([round(r["seconds"], 2) for r in last])

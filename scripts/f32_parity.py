@@ -85,8 +85,12 @@ def _spawn(dtype: str, platform: str | None, x64: bool) -> dict:
     if platform:
         env["JAX_PLATFORMS"] = platform
     env["JAX_ENABLE_X64"] = "1" if x64 else "0"
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
+    # APPEND the repo root: replacing PYTHONPATH would drop the axon
+    # sitecustomize dir (/root/.axon_site) that registers the TPU-tunnel
+    # backend, making --platform axon fail with "unknown backend"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"), repo) if p])
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "run", "--dtype", dtype],
         env=env, capture_output=True, text=True, timeout=1200,

@@ -24,15 +24,26 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def bench(fn, *args, warmup=2, reps=5):
-    """Median wall-clock of fn(*args) with block_until_ready."""
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    ts = []
-    for _ in range(reps):
+def bench(fn, warmup=2, reps=5):
+    """Median wall-clock of fn(salt).
+
+    ``fn`` must build a call whose inputs DEPEND on the float ``salt`` (e.g.
+    perturb a float operand by it): the axon remote backend appears to
+    memoize bit-identical executions, so repeating the same call times
+    nothing.  Sync is a scalar device->host fetch of the result, which
+    cannot complete before the computation has actually run (r03 session:
+    block_until_ready-timed repeats reported 0.7ms for 82M-nnz fits).
+    """
+    def once(salt):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        ts.append(time.perf_counter() - t0)
+        out = fn(jnp.float32(salt))
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        float(jnp.sum(leaf))
+        return time.perf_counter() - t0
+
+    for i in range(warmup):
+        once(1e-8 * (i + 1))
+    ts = [once(1e-8 * (i + 17)) for i in range(reps)]
     return float(np.median(ts))
 
 
@@ -66,13 +77,29 @@ def main():
     indices, values, w, dvec, labels = jax.block_until_ready(make(key))
 
     results = {}
+    bw_peak = 8.19e11
+
+    def record(name, fn, traffic_bytes=None, **kw):
+        """Bench fn(salt), store + print the line IMMEDIATELY (a later
+        tunnel wedge must not lose earlier measurements)."""
+        t = bench(fn, **kw)
+        results[name] = t
+        line = f"{name:32s} {t*1e3:10.2f} ms"
+        if traffic_bytes:
+            bw = traffic_bytes / t
+            line += f"   ~{bw/1e9:7.1f} GB/s ({bw/bw_peak:.1%} of peak)"
+        print(line, flush=True)
+        return t
+
+    tb = 16.0 * nnz  # 2x(idx+val) int32/f32 traffic model
 
     # ---- forward: margin gather --------------------------------------------
     @jax.jit
     def margin(w, indices, values):
         return jnp.sum(values * w[indices], axis=1)
 
-    results["margin gather  (fwd pass)"] = bench(margin, w, indices, values)
+    record("margin gather  (fwd pass)",
+           lambda s: margin(w + s, indices, values), tb)
 
     # ---- pointwise loss on margins (line-search trial cost in margin space)
     @jax.jit
@@ -80,7 +107,8 @@ def main():
         return jnp.sum(jax.nn.softplus(jnp.where(labels > 0, -m, m)))
 
     m0 = margin(w, indices, values)
-    results["pointwise loss (O(n) only)"] = bench(pointwise, m0, labels)
+    record("pointwise loss (O(n) only)",
+           lambda s: pointwise(m0 + s, labels))
 
     # ---- backward: scatter-add transpose -----------------------------------
     @jax.jit
@@ -89,7 +117,8 @@ def main():
         return jnp.zeros((d,), jnp.float32).at[indices.reshape(-1)].add(
             contrib.reshape(-1))
 
-    results["scatter X^T d  (bwd pass)"] = bench(scatter_t, indices, values, dvec)
+    record("scatter X^T d  (bwd pass)",
+           lambda s: scatter_t(indices, values, dvec + s), tb)
 
     # ---- full value_and_grad (what one line-search eval costs today) -------
     from photon_ml_tpu.ops.objective import make_objective
@@ -99,8 +128,11 @@ def main():
     batch = LabeledBatch(
         SparseFeatures(indices, values, dim=d), labels,
         jnp.zeros((n,), jnp.float32), jnp.ones((n,), jnp.float32))
-    fg = jax.jit(lambda w: obj.value_and_grad(w, batch, 1.0))
-    results["value_and_grad (one fg eval)"] = bench(fg, w)
+    # pass the batch as an ARGUMENT: a closure would embed the 82M-element
+    # arrays as HLO constants, and the axon remote_compile endpoint rejects
+    # oversized request bodies (HTTP 413, observed on hardware)
+    fg = jax.jit(lambda w, b: obj.value_and_grad(w, b, 1.0))
+    record("value_and_grad (one fg eval)", lambda s: fg(w + s, batch))
 
     # ---- CSC build (the cost round 2 paid inside every fit) ----------------
     @jax.jit
@@ -111,7 +143,15 @@ def main():
                 jnp.searchsorted(flat[order],
                                  jnp.arange(d + 1, dtype=jnp.int32)))
 
-    results["csc build (argsort 82M)"] = bench(csc_build, indices, values)
+    @jax.jit
+    def csc_build_s(idx, v, s):
+        # salt one output inside the jit (an eager 82M `v + s` add would
+        # inflate the timed traffic); all three outputs stay live
+        sv, rows, cs = csc_build(idx, v)
+        return sv + s, rows, cs
+
+    record("csc build (argsort 82M)",
+           lambda s: csc_build_s(indices, values, s))
     s_vals, s_rows, col_starts = jax.block_until_ready(csc_build(indices, values))
 
     # ---- hoisted CSC apply: gather + cumsum + boundary diff ----------------
@@ -122,8 +162,8 @@ def main():
                                   jnp.cumsum(contrib)])
         return prefix[col_starts[1:]] - prefix[col_starts[:-1]]
 
-    results["csc apply (cumsum, hoisted)"] = bench(
-        csc_apply, s_vals, s_rows, col_starts, dvec)
+    record("csc apply (cumsum, hoisted)",
+           lambda s: csc_apply(s_vals, s_rows, col_starts, dvec + s), tb)
 
     # ---- segment-sum variant on the sorted view ----------------------------
     sorted_ids = jax.block_until_ready(
@@ -135,15 +175,16 @@ def main():
         return jax.ops.segment_sum(contrib, sorted_ids, num_segments=d,
                                    indices_are_sorted=True)
 
-    results["segment_sum (sorted ids)"] = bench(
-        seg_apply, s_vals, s_rows, sorted_ids, dvec)
+    record("segment_sum (sorted ids)",
+           lambda s: seg_apply(s_vals, s_rows, sorted_ids, dvec + s), tb)
 
     # ---- implicit-ones variants (bench layout: no values array) ------------
     @jax.jit
     def margin_binary(w, indices):
         return jnp.sum(w[indices], axis=1)
 
-    results["margin gather (implicit 1s)"] = bench(margin_binary, w, indices)
+    record("margin gather (implicit 1s)",
+           lambda s: margin_binary(w + s, indices), tb / 2)
 
     @jax.jit
     def scatter_binary(indices, dvec):
@@ -151,22 +192,26 @@ def main():
         return jnp.zeros((d,), jnp.float32).at[indices.reshape(-1)].add(
             contrib.reshape(-1))
 
-    results["scatter X^T d (implicit 1s)"] = bench(scatter_binary, indices, dvec)
+    record("scatter X^T d (implicit 1s)",
+           lambda s: scatter_binary(indices, dvec + s), tb / 2)
 
     @jax.jit
     def seg_binary(s_rows, sorted_ids, dvec):
         return jax.ops.segment_sum(dvec[s_rows], sorted_ids, num_segments=d,
                                    indices_are_sorted=True)
 
-    results["segment_sum (implicit 1s)"] = bench(
-        seg_binary, s_rows, sorted_ids, dvec)
+    record("segment_sum (implicit 1s)",
+           lambda s: seg_binary(s_rows, sorted_ids, dvec + s), tb / 2)
 
     # ---- cumsum alone (is XLA's cumsum multi-pass?) ------------------------
     flat_contrib = jax.block_until_ready(
         jax.jit(lambda v, r, dv: v * dv[r])(s_vals, s_rows, dvec))
-    results["cumsum 82M alone"] = bench(jax.jit(jnp.cumsum), flat_contrib)
-    results["gather d[rows] alone"] = bench(
-        jax.jit(lambda dv, r: dv[r]), dvec, s_rows)
+    # salt the OUTPUT inside the jitted kernel: an eager `big + s` add
+    # would double the timed region's memory traffic
+    cumsum_j = jax.jit(lambda x, s: jnp.cumsum(x) + s)
+    record("cumsum 82M alone", lambda s: cumsum_j(flat_contrib, s))
+    gather_j = jax.jit(lambda dv, r: dv[r])
+    record("gather d[rows] alone", lambda s: gather_j(dvec + s, s_rows))
 
     # ---- the full bench fit, for eval accounting ---------------------------
     from photon_ml_tpu.optimize import OptimizerConfig
@@ -182,29 +227,21 @@ def main():
         SparseFeatures(indices, None, dim=d), labels,
         jnp.zeros((n,), jnp.float32), jnp.ones((n,), jnp.float32))
 
-    def fit():
+    def fit(salt):
         res = fit_distributed(
-            obj, bin_batch, mesh, w0, l2=1.0, optimizer="lbfgs",
+            obj, bin_batch, mesh, w0 + salt, l2=1.0, optimizer="lbfgs",
             config=OptimizerConfig(max_iters=iters, tolerance=0.0),
             sparse_grad="scatter")
-        jax.block_until_ready(res.w)
         return res
 
-    res = fit()  # compile
-    t_fit = bench(lambda: fit(), warmup=0, reps=3)
-    results[f"full lbfgs fit ({int(res.iterations)} iters)"] = t_fit
+    res = fit(jnp.float32(0.0))  # compile
+    n_done = int(res.iterations)
+    t_fit = record(f"full lbfgs fit ({n_done} iters)", fit,
+                   warmup=1, reps=3)
 
     # ------------------------------------------------------------------------
-    print()
-    bw_peak = 8.19e11
-    for name, t in results.items():
-        line = f"{name:32s} {t*1e3:10.2f} ms"
-        if "pass" in name or "apply" in name or "segment" in name:
-            bw = 16.0 * nnz / t  # 2x(idx+val) int32/f32 traffic model
-            line += f"   ~{bw/1e9:7.1f} GB/s ({bw/bw_peak:.1%} of peak)"
-        print(line, flush=True)
     t_fg = results["value_and_grad (one fg eval)"]
-    n_it = int(res.iterations)
+    n_it = n_done
     print(f"\nfit/iter = {t_fit/max(n_it,1)*1e3:.2f} ms; fg eval = "
           f"{t_fg*1e3:.2f} ms -> fg-equivalents/iter = "
           f"{t_fit/max(n_it,1)/t_fg:.2f} (margin line search: ~1 gather + "

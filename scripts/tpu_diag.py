@@ -1,0 +1,143 @@
+"""On-chip timing diagnostics for the axon tunnel (round 3).
+
+The r03 session produced physically impossible numbers (a 3-iteration
+L-BFGS fit over 82M nnz "completing" in 0.7ms), which implies
+``jax.block_until_ready`` may not actually synchronize with remote axon
+buffers.  This script measures, in order:
+
+1. sync semantics: a large matmul timed via block_until_ready vs via a
+   scalar device->host fetch (a fetch cannot lie);
+2. the true cost of one sparse forward pass / one scatter transpose at
+   the bench shape, fetch-synced;
+3. the true cost of 3- and 20-iteration L-BFGS fits (scatter mode),
+   fetch-synced, to re-derive an honest example-passes/sec.
+
+Shapes shrink on CPU so the script doubles as a smoke test.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t_block(fn, *args, reps=3):
+    """Median time of fn(*args) synced by block_until_ready."""
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def t_fetch(fn, *args, reps=3):
+    """Median time of fn(*args) synced by fetching a scalar to host."""
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        float(jnp.sum(leaf))  # device->host: cannot complete early
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        n, d, k, mm = 1 << 14, 1 << 13, 39, 1024
+    else:
+        n, d, k, mm = 1 << 21, 1 << 18, 39, 8192
+    print(f"platform={platform} n={n} d={d} k={k}", flush=True)
+
+    key = jax.random.key(0)
+
+    # ---- 1. sync semantics --------------------------------------------------
+    A = jax.block_until_ready(jax.random.normal(key, (mm, mm), jnp.float32))
+    mat = jax.jit(lambda a: a @ a)
+    jax.block_until_ready(mat(A))  # compile
+    tb = t_block(mat, A)
+    tf = t_fetch(mat, A)
+    flops = 2.0 * mm ** 3
+    print(f"matmul {mm}x{mm}: block={tb*1e3:.2f} ms ({flops/tb/1e12:.1f} "
+          f"TFLOP/s)  fetch={tf*1e3:.2f} ms ({flops/tf/1e12:.1f} TFLOP/s)",
+          flush=True)
+    if tb < 0.5 * tf:
+        print("!! block_until_ready under-reports vs fetch -> block-based "
+              "timings on this platform are NOT trustworthy", flush=True)
+
+    # ---- 2. one sparse pass -------------------------------------------------
+    @jax.jit
+    def make(key):
+        k_idx, k_d = jax.random.split(key)
+        indices = jax.random.randint(k_idx, (n, k), 0, d, jnp.int32)
+        dvec = jax.random.normal(k_d, (n,), jnp.float32)
+        return indices, dvec
+
+    indices, dvec = jax.block_until_ready(make(key))
+    w = jnp.zeros((d,), jnp.float32)
+
+    fwd = jax.jit(lambda w, idx: jnp.sum(w[idx], axis=1))
+    bwd = jax.jit(lambda idx, dv: jnp.zeros((d,), jnp.float32)
+                  .at[idx.reshape(-1)].add(
+                      jnp.broadcast_to(dv[:, None], idx.shape).reshape(-1)))
+    jax.block_until_ready(fwd(w, indices))
+    jax.block_until_ready(bwd(indices, dvec))
+    nnz = n * k
+    for name, fn, args in (("fwd gather", fwd, (w, indices)),
+                           ("bwd scatter", bwd, (indices, dvec))):
+        tbo = t_block(fn, *args)
+        tfo = t_fetch(fn, *args)
+        bw = 8.0 * nnz / tfo
+        print(f"{name}: block={tbo*1e3:.2f} ms fetch={tfo*1e3:.2f} ms "
+              f"-> ~{bw/1e9:.0f} GB/s ({bw/8.19e11:.1%} of peak)", flush=True)
+
+    # ---- 3. honest fit timings ---------------------------------------------
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.data_parallel import fit_distributed
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+    labels = jax.block_until_ready(
+        jax.jit(lambda dv: (dv > 0).astype(jnp.float32))(dvec))
+    batch = LabeledBatch(SparseFeatures(indices, None, dim=d), labels,
+                         jnp.zeros((n,), jnp.float32),
+                         jnp.ones((n,), jnp.float32))
+    obj = make_objective("logistic")
+    mesh = make_mesh()
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    for iters in (3, 20):
+        def fit():
+            return fit_distributed(
+                obj, batch, mesh, w0, l2=1.0, optimizer="lbfgs",
+                config=OptimizerConfig(max_iters=iters, tolerance=0.0),
+                sparse_grad="scatter")
+
+        r = fit()
+        done = int(r.iterations)  # forces full sync (scalar fetch)
+        t0 = time.perf_counter()
+        r = fit()
+        done = int(r.iterations)
+        el = time.perf_counter() - t0
+        print(f"fit {iters} iters: {el*1e3:.1f} ms wall (ran {done} iters) "
+              f"-> {n*max(done,1)/el/1e6:.2f}M example-passes/s; "
+              f"loss={float(r.value):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

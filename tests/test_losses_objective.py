@@ -262,3 +262,37 @@ def test_zero_weight_rows_annihilate_nonfinite_losses(rng):
     f3, g3 = obj.value_and_grad(w_ok, real, 0.0)
     np.testing.assert_allclose(f2, f3, rtol=1e-12)
     np.testing.assert_allclose(g2, g3, rtol=1e-12)
+
+
+def test_overflowing_pad_rows_keep_gradients_finite(rng):
+    """The sharper double-where case: REAL rows finite, only the PAD rows
+    overflow. Masking the loss value alone is not enough — reverse-mode AD
+    through the value-`where` computes 0 * inf = NaN unless the margins
+    themselves are masked first (losses.mask_margins)."""
+    from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+    n, d, k = 8, 4, 50
+    # real rows (0..3) use feature 1; pad rows are k copies of feature 0
+    indices = jnp.concatenate([jnp.ones((4, k), jnp.int32),
+                               jnp.zeros((4, k), jnp.int32)])
+    weights = jnp.asarray([1.0] * 4 + [0.0] * 4)
+    labels = jnp.ones((n,))
+    batch = LabeledBatch(SparseFeatures(indices, None, dim=d), labels,
+                         jnp.zeros((n,)), weights)
+    obj = make_objective("poisson")
+    # w[0]=100: pad margins = 5000 (exp overflows); w[1]=0.01: real rows ok
+    w = jnp.zeros((d,)).at[0].set(100.0).at[1].set(0.01)
+    f, g = obj.value_and_grad(w, batch, 0.0)
+    assert jnp.isfinite(f)
+    assert jnp.isfinite(g).all(), g
+    # HVP and diagonal Hessian flow through d2 the same way
+    hv = obj.hvp(w, jnp.ones((d,)), batch, 0.0)
+    assert jnp.isfinite(hv).all(), hv
+    dh = obj.diagonal_hessian(w, batch, 0.0)
+    assert jnp.isfinite(dh).all(), dh
+    # parity with the same problem with pad rows removed
+    real = LabeledBatch(SparseFeatures(indices[:4], None, dim=d),
+                        labels[:4], jnp.zeros((4,)), weights[:4])
+    f3, g3 = obj.value_and_grad(w, real, 0.0)
+    np.testing.assert_allclose(f, f3, rtol=1e-12)
+    np.testing.assert_allclose(g, g3, rtol=1e-12)
