@@ -155,7 +155,8 @@ def main() -> None:
     mode = os.environ.get("BENCH_SPARSE_GRAD", "auto")
     if mode == "auto":
         times = {}
-        for i, m in enumerate(("scatter", "csc", "csc_segment", "csc_pallas")):
+        for i, m in enumerate(("scatter", "csc", "csc_segment", "csc_pallas",
+                               "csc_precise")):
             try:
                 run(m, 3, salt=1)  # compile + warm-up
                 t0 = time.perf_counter()
@@ -163,23 +164,29 @@ def main() -> None:
                 times[m] = time.perf_counter() - t0
             except Exception as e:  # a mode that fails to lower is skipped
                 print(f"calibration: {m} failed: {e}", file=sys.stderr)
-        mode = min(times, key=times.get)
-        print(f"calibration: {times} -> {mode}", file=sys.stderr)
-        # speed is not enough: cross-check the winner's solution against the
-        # scatter reference once (an inaccurate fast mode must be visible)
-        if mode != "scatter" and "scatter" in times:
-            w_ref = run("scatter", 3).w
-            w_got = run(mode, 3).w
-            w_ref, w_got = map(np.asarray, (w_ref, w_got))
+        print(f"calibration: {times}", file=sys.stderr)
+        # speed is not enough: cross-check each candidate's solution against
+        # the scatter reference (an inaccurate fast mode must be visible).
+        # The f32 cumsum-difference transpose loses ~sqrt(nnz)*eps ≈ 1e-3
+        # relative at 82M nnz, so the fastest mode can legitimately fail the
+        # gate — walk the modes fastest-first and take the first accurate
+        # one instead of falling straight back to scatter.
+        w_ref = np.asarray(run("scatter", 3).w) if "scatter" in times else None
+        mode = "scatter"
+        for m in sorted(times, key=times.get):
+            if m == "scatter" or w_ref is None:
+                mode = m
+                break
+            w_got = np.asarray(run(m, 3).w)
             dev_rel = float(np.linalg.norm(w_got - w_ref)
                             / max(np.linalg.norm(w_ref), 1e-30))
-            print(f"calibration accuracy: |w_{mode} - w_scatter| rel = "
+            print(f"calibration accuracy: |w_{m} - w_scatter| rel = "
                   f"{dev_rel:.2e}", file=sys.stderr)
-            if dev_rel > 1e-3:
-                print(f"WARNING: {mode} diverges from scatter by {dev_rel:.2e}"
-                      " — falling back to scatter (accuracy over speed)",
-                      file=sys.stderr)
-                mode = "scatter"
+            if dev_rel <= 1e-3:
+                mode = m
+                break
+            print(f"calibration: {m} rejected (> 1e-3)", file=sys.stderr)
+        print(f"calibration -> {mode}", file=sys.stderr)
 
     run(mode, iters, salt=101)  # compile + warm-up
     t0 = time.perf_counter()

@@ -1,7 +1,8 @@
 #!/bin/bash
-# Round-3 hardware measurement session: run every prepared TPU experiment
-# in cost order, each with its own timeout so a tunnel wedge loses one
-# experiment, not the session. Logs under docs/tpu_r03_logs/.
+# Round-3 hardware measurement session, v2 (post measurement-artifact fixes):
+# run every prepared TPU experiment in priority order, each with its own
+# timeout so a tunnel wedge loses one experiment, not the session.
+# Logs under docs/tpu_r03_logs/ (v2 files suffixed _v2).
 set -u
 cd "$(dirname "$0")/.."
 LOGDIR=docs/tpu_r03_logs
@@ -16,15 +17,20 @@ run() {
   echo "--- $name rc=$rc"
 }
 
-# 1. Attribute the r02 utilization gap per op
-run profile_hot_loop 1800 python scripts/profile_hot_loop.py
-# 2. The headline bench (margin path + precomputed CSC; vs r02 17.77M)
-run bench 1800 python bench.py
-# 3. GAME / random-effect path
-run bench_game 1800 python scripts/bench_game.py
-# 4. Streamed (larger-than-HBM) fit, small chunks first
-run bench_streaming 1200 python scripts/bench_streaming.py --rows-log2 18 --chunk-rows 8192
-run bench_streaming_big 1800 python scripts/bench_streaming.py --rows-log2 21 --chunk-rows 65536
-# 5. f32-vs-f64 parity on hardware
-run f32_parity 1200 python scripts/f32_parity.py compare --platform axon
+# 0. Sync semantics + honest per-op / per-fit timings (the r03 v1 session
+#    produced physically impossible numbers; this must run first)
+run tpu_diag_v2 2400 python scripts/tpu_diag.py
+# 1. The headline bench (salted + scalar-fetch-synced)
+run bench_v2 1800 python bench.py
+# 2. Attribute the utilization gap per op
+run profile_v2 2400 python scripts/profile_hot_loop.py
+# 3. GAME / random-effect path (now device-synthesized, watchdogged)
+run bench_game_v2 1800 python scripts/bench_game.py
+# 4. Streamed fit, small then the r02 bench shape (chunked in-HBM upload)
+run bench_streaming_v2 1200 python scripts/bench_streaming.py --rows-log2 18 --chunk-rows 8192
+run bench_streaming_big_v2 1800 python scripts/bench_streaming.py --rows-log2 21 --chunk-rows 65536
+# 5. f32-vs-f64 parity on hardware (PYTHONPATH append fix)
+run f32_parity_v2 1500 python scripts/f32_parity.py compare --platform axon
+# 6. End-to-end training+scoring drivers on the chip (small Avro dataset)
+run driver_e2e_v2 1800 python scripts/tpu_driver_e2e.py --rows 20000 --users 300
 echo "session done; logs in $LOGDIR"
