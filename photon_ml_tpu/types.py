@@ -120,20 +120,68 @@ def build_csc_transpose(indices: jax.Array, values: Optional[jax.Array],
     )
 
 
-def csc_transpose_apply(csc: CSCTranspose, d: jax.Array, precise: bool = False) -> jax.Array:
-    """``X^T d`` from the column-sorted view, with no scatter:
-    prefix-sum the per-nonzero contributions, then difference the prefix at
-    column boundaries. ``precise=True`` runs the prefix sum in f64 (the
-    boundary difference of a long f32 prefix loses ~sqrt(nnz)*eps relative
-    accuracy; f64 restores it at ~2x cumsum cost)."""
+def csc_transpose_apply(csc: CSCTranspose, d: jax.Array,
+                        precise: bool = False,
+                        block: int = 1 << 16) -> jax.Array:
+    """``X^T d`` from the column-sorted view, with no scatter.
+
+    A single global prefix sum followed by boundary differencing is
+    numerically unsound in f32: the difference ``prefix[b] - prefix[a]``
+    cancels catastrophically once the running prefix dwarfs a column's own
+    sum — ~sqrt(nnz)*eps relative error for sign-mixed gradients (~1e-3 at
+    82M nnz, measured on hardware), and *unbounded* relative error for the
+    all-positive ``d2`` contributions of the HVP path, where the prefix
+    grows linearly.
+
+    The default is therefore a BLOCKED two-level scheme whose error does
+    not grow with nnz: contributions reshape to [B, block]; each block
+    gets a local f32 cumsum (magnitudes bounded by one block); a column
+    contained in one block differences only local prefixes; a column
+    spanning blocks takes (suffix of its first block) + (sum of interior
+    block totals) + (head of its last block). Interior sums fall back to
+    a block-total prefix difference, but only columns wider than a whole
+    block (>= ``block`` nonzeros) ever take it — and for those the
+    interior sum *is* the dominant term, so no cancellation. Cost: the
+    same one pass of cumsum traffic, plus O(dim) boundary gathers.
+
+    ``precise=True`` keeps the old full-f64 global prefix (meaningful
+    only under jax_enable_x64; without it, f64 silently degrades to f32,
+    which is exactly what the blocked default repairs)."""
     contrib = (d[csc.rows] if csc.values is None
                else csc.values * d[csc.rows])
-    acc_dtype = jnp.float64 if precise else contrib.dtype
-    prefix = jnp.concatenate([
-        jnp.zeros((1,), acc_dtype),
-        jnp.cumsum(contrib.astype(acc_dtype)),
-    ])
-    out = prefix[csc.col_starts[1:]] - prefix[csc.col_starts[:-1]]
+    if precise:
+        prefix = jnp.concatenate([
+            jnp.zeros((1,), jnp.float64),
+            jnp.cumsum(contrib.astype(jnp.float64)),
+        ])
+        out = prefix[csc.col_starts[1:]] - prefix[csc.col_starts[:-1]]
+        return out.astype(d.dtype)
+
+    nnz = contrib.shape[0]
+    if nnz == 0:
+        return jnp.zeros((csc.col_starts.shape[0] - 1,), d.dtype)
+    T = min(block, nnz)
+    B = -(-nnz // T)
+    padded = jnp.pad(contrib, (0, B * T - nnz)).reshape(B, T)
+    local = jnp.cumsum(padded, axis=1)  # [B, T] inclusive, block-local
+    bt = local[:, -1]  # [B] block totals
+    # exclusive prefix of block totals; only consulted for columns spanning
+    # >= 1 full interior block (see docstring)
+    BP = jnp.concatenate([jnp.zeros((1,), bt.dtype), jnp.cumsum(bt)])
+
+    cs = csc.col_starts.astype(jnp.int32)
+    b, r = cs // T, cs % T
+    local_flat = local.reshape(-1)
+    # local exclusive prefix at each boundary: local[b, r-1], 0 at r == 0
+    lp = jnp.where(r > 0, local_flat[jnp.maximum(cs - 1, 0)],
+                   jnp.zeros((), contrib.dtype))
+    b0, b1 = b[:-1], b[1:]
+    lp0, lp1 = lp[:-1], lp[1:]
+    same = b0 == b1
+    # bt[b0] is only used on the spanning branch, where b0 < B always
+    suffix0 = bt[jnp.minimum(b0, B - 1)] - lp0
+    mid = BP[b1] - BP[jnp.minimum(b0 + 1, B)]  # exact 0 when b1 == b0 + 1
+    out = jnp.where(same, lp1 - lp0, suffix0 + mid + lp1)
     return out.astype(d.dtype)
 
 
