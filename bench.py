@@ -81,9 +81,10 @@ def _tpu_reachable(probe_timeout_s: float = 90.0) -> bool:
 def main() -> None:
     _arm_watchdog()
     fallback = ""
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        pass  # CPU explicitly requested (CI/driver smoke): no probe, no label
-    elif not _tpu_reachable():
+    # Only probe-and-fall-back when the platform is UNPINNED: an explicit
+    # JAX_PLATFORMS (cpu for CI smoke, tpu/axon for fail-fast hardware
+    # runs) is honored as given.
+    if not os.environ.get("JAX_PLATFORMS") and not _tpu_reachable():
         os.environ["JAX_PLATFORMS"] = "cpu"
         fallback = "; TPU-unreachable CPU FALLBACK, not comparable to TPU rounds"
         print("TPU tunnel unreachable -> CPU fallback measurement",

@@ -30,14 +30,12 @@ from jax.experimental.pallas import tpu as pltpu
 _LANES = 128
 
 
-def _mps_kernel(v_ref, d_ref, out_ref, carry_ref):
-    """One [T, 128] tile of the fused multiply + inclusive prefix sum."""
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _():
-        carry_ref[0, 0] = jnp.zeros((), v_ref.dtype)
-
+def _mps_kernel(v_ref, d_ref, out_ref, tot_ref):
+    """One [T, 128] tile: fused multiply + TILE-LOCAL inclusive prefix sum,
+    plus the tile's total. No cross-tile carry: a global running prefix
+    would reintroduce the f32 boundary-difference cancellation the blocked
+    scheme exists to avoid (types.blocked_boundary_combine), and dropping
+    the sequential carry removes the only cross-tile dependency."""
     x = v_ref[:] * d_ref[:]  # fused contribution product
     rows = x.shape[0]
     dtype = x.dtype
@@ -61,9 +59,8 @@ def _mps_kernel(v_ref, d_ref, out_ref, carry_ref):
     row_excl = jnp.dot(match_vma((rb < ra).astype(dtype), x), row_tot,
                        preferred_element_type=dtype)  # [rows, 1]
 
-    carry = carry_ref[0, 0]
-    out_ref[:] = lane_cum + row_excl + carry
-    carry_ref[0, 0] = carry + row_excl[rows - 1, 0] + row_tot[rows - 1, 0]
+    out_ref[:] = lane_cum + row_excl
+    tot_ref[0, 0] = row_excl[rows - 1, 0] + row_tot[rows - 1, 0]
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
@@ -72,14 +69,20 @@ def multiply_prefix_sum(
     d_sorted: jax.Array,
     block_rows: int = 256,
     interpret: bool | None = None,
-) -> jax.Array:
-    """Inclusive prefix sum of ``values * d_sorted`` (both [nnz]) in one
-    streamed pass. ``interpret=None`` auto-selects interpret mode off-TPU."""
+) -> tuple[jax.Array, jax.Array, int]:
+    """TILE-LOCAL inclusive prefix sums of ``values * d_sorted`` (both
+    [nnz]) in one streamed pass, plus per-tile totals.
+
+    Returns ``(local, totals, tile)``: ``local`` is [padded] with the
+    prefix restarting every ``tile = block_rows * 128`` elements, exactly
+    the pair ``types.blocked_boundary_combine`` consumes. ``interpret=None``
+    auto-selects interpret mode off-TPU."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     nnz = values.shape[0]
     tile = block_rows * _LANES
-    padded = max(pl.cdiv(nnz, tile), 1) * tile
+    n_tiles = max(pl.cdiv(nnz, tile), 1)
+    padded = n_tiles * tile
     pad = padded - nnz
     v = jnp.pad(values, (0, pad)).reshape(-1, _LANES)
     d = jnp.pad(d_sorted, (0, pad)).reshape(-1, _LANES)
@@ -87,31 +90,38 @@ def multiply_prefix_sum(
     # under shard_map (manual mode) the output varies over the same mesh
     # axes as the inputs; plumb the vma through or check_vma rejects the call
     vma = frozenset(getattr(jax.typeof(v), "vma", frozenset()))
-    out_shape = (jax.ShapeDtypeStruct(v.shape, v.dtype, vma=vma) if vma
-                 else jax.ShapeDtypeStruct(v.shape, v.dtype))
-    out = pl.pallas_call(
+    def _shape(sh):
+        return (jax.ShapeDtypeStruct(sh, v.dtype, vma=vma) if vma
+                else jax.ShapeDtypeStruct(sh, v.dtype))
+    local, totals = pl.pallas_call(
         _mps_kernel,
-        grid=(padded // tile,),
+        grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
             pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
-        out_shape=out_shape,
-        scratch_shapes=[pltpu.SMEM((1, 1), v.dtype)],
+        out_specs=[
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[_shape(v.shape), _shape((n_tiles, 1))],
         interpret=interpret,
     )(v, d)
-    return out.reshape(-1)[:nnz]
+    return local.reshape(-1), totals.reshape(-1), tile
 
 
 def csc_transpose_apply_pallas(csc, d: jax.Array) -> jax.Array:
-    """``X^T d`` from the column-sorted view with the fused Pallas scan
-    (drop-in for ``types.csc_transpose_apply``). The implicit-ones layout
-    materializes a ones vector here (the kernel is a two-operand scan);
-    prefer sparse_grad='csc' for binary data."""
+    """``X^T d`` from the column-sorted view with the fused Pallas per-tile
+    scan + the shared blocked boundary combine (drop-in for
+    ``types.csc_transpose_apply``, same accuracy guarantee: error does not
+    grow with nnz). The implicit-ones layout materializes a ones vector
+    here (the kernel is a two-operand scan); prefer sparse_grad='csc' for
+    binary data."""
+    from photon_ml_tpu.types import blocked_boundary_combine
+
     values = (jnp.ones_like(d[csc.rows]) if csc.values is None
               else csc.values)
-    prefix_incl = multiply_prefix_sum(values, d[csc.rows])
-    prefix = jnp.concatenate([jnp.zeros((1,), prefix_incl.dtype), prefix_incl])
-    out = prefix[csc.col_starts[1:]] - prefix[csc.col_starts[:-1]]
+    local, totals, tile = multiply_prefix_sum(values, d[csc.rows])
+    out = blocked_boundary_combine(local, totals, csc.col_starts, tile)
     return out.astype(d.dtype)

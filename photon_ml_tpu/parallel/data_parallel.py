@@ -208,9 +208,10 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
 
         apply_t = csc_segment_apply
     elif precise:
-        # f64 prefix accumulation: at TB-scale nnz an f32 prefix loses
-        # ~sqrt(nnz)*eps relative accuracy through boundary-difference
-        # cancellation, which can stall tight-tolerance convergence
+        # full-f64 global prefix: meaningful only under jax_enable_x64
+        # (x64-off runs, i.e. all TPU runs, silently degrade it to the
+        # global-f32 scheme that cancels at scale) — the blocked default
+        # is the accurate choice there (types.csc_transpose_apply)
         apply_t = functools.partial(csc_transpose_apply, precise=True)
     else:
         apply_t = csc_transpose_apply
@@ -490,7 +491,7 @@ def fit_distributed(
     "csc" (scatter-free column-sorted gradients — see ``make_csc_path``;
     sorts once per fit on device, best for many-iteration sparse fits on
     TPU), "csc_pallas" (fused Pallas kernel), "csc_precise" (CSC with
-    f64 prefix accumulation for very large nnz), or "csc_segment" (sorted
+    f64 global prefix — only meaningful under jax_enable_x64), or "csc_segment" (sorted
     segment-sum: a scatter with indices_are_sorted=True, which XLA can
     lower without collision ordering).
 

@@ -165,24 +165,38 @@ def csc_transpose_apply(csc: CSCTranspose, d: jax.Array,
     padded = jnp.pad(contrib, (0, B * T - nnz)).reshape(B, T)
     local = jnp.cumsum(padded, axis=1)  # [B, T] inclusive, block-local
     bt = local[:, -1]  # [B] block totals
+    return blocked_boundary_combine(local.reshape(-1), bt, csc.col_starts,
+                                    T).astype(d.dtype)
+
+
+def blocked_boundary_combine(local_flat: jax.Array, bt: jax.Array,
+                             col_starts: jax.Array, T: int) -> jax.Array:
+    """Column sums from BLOCK-LOCAL inclusive prefixes.
+
+    ``local_flat``: [B*T] inclusive prefix sums that restart at every block
+    boundary; ``bt``: [B] block totals. Shared by the XLA cumsum path and
+    the Pallas per-tile scan kernel (both produce exactly this pair).
+    A column inside one block differences local prefixes only; a spanning
+    column takes first-block suffix + interior block totals + last-block
+    head, so no difference ever cancels against a prefix that outgrew the
+    column's own sum (see ``csc_transpose_apply``)."""
+    B = bt.shape[0]
     # exclusive prefix of block totals; only consulted for columns spanning
-    # >= 1 full interior block (see docstring)
+    # >= 1 full interior block
     BP = jnp.concatenate([jnp.zeros((1,), bt.dtype), jnp.cumsum(bt)])
 
-    cs = csc.col_starts.astype(jnp.int32)
+    cs = col_starts.astype(jnp.int32)
     b, r = cs // T, cs % T
-    local_flat = local.reshape(-1)
     # local exclusive prefix at each boundary: local[b, r-1], 0 at r == 0
     lp = jnp.where(r > 0, local_flat[jnp.maximum(cs - 1, 0)],
-                   jnp.zeros((), contrib.dtype))
+                   jnp.zeros((), local_flat.dtype))
     b0, b1 = b[:-1], b[1:]
     lp0, lp1 = lp[:-1], lp[1:]
     same = b0 == b1
     # bt[b0] is only used on the spanning branch, where b0 < B always
     suffix0 = bt[jnp.minimum(b0, B - 1)] - lp0
     mid = BP[b1] - BP[jnp.minimum(b0 + 1, B)]  # exact 0 when b1 == b0 + 1
-    out = jnp.where(same, lp1 - lp0, suffix0 + mid + lp1)
-    return out.astype(d.dtype)
+    return jnp.where(same, lp1 - lp0, suffix0 + mid + lp1)
 
 
 def csc_segment_apply(csc: CSCTranspose, d: jax.Array) -> jax.Array:

@@ -12,23 +12,36 @@ from photon_ml_tpu.ops.pallas_kernels import (
 
 
 @pytest.mark.parametrize("nnz", [1, 100, 128 * 256, 128 * 256 * 3 + 17])
-def test_multiply_prefix_sum_matches_cumsum(nnz, rng):
+def test_multiply_prefix_sum_tile_local(nnz, rng):
+    """The kernel returns TILE-LOCAL inclusive prefixes + tile totals
+    (the blocked-combine contract): within each tile the scan matches
+    cumsum of that tile's slice; totals are the tile sums."""
     v = jnp.asarray(rng.normal(size=nnz))
     d = jnp.asarray(rng.normal(size=nnz))
-    got = multiply_prefix_sum(v, d, block_rows=256)
-    want = jnp.cumsum(v * d)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=1e-10, atol=1e-10)
+    local, totals, tile = multiply_prefix_sum(v, d, block_rows=256)
+    x = np.zeros(len(local))
+    x[:nnz] = np.asarray(v * d)
+    assert tile == 128 * 256
+    for t in range(len(totals)):
+        sl = x[t * tile:(t + 1) * tile]
+        np.testing.assert_allclose(np.asarray(local[t * tile:(t + 1) * tile]),
+                                   np.cumsum(sl), rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(float(totals[t]), sl.sum(),
+                                   rtol=1e-10, atol=1e-10)
 
 
-def test_multiple_tiles_carry(rng):
-    # small block size forces many grid steps; carry must chain exactly
+def test_multiple_tiles_no_carry(rng):
+    # small block size forces many grid steps; every tile restarts at zero
     nnz = 128 * 8 * 5 + 3
     v = jnp.asarray(rng.normal(size=nnz))
     d = jnp.ones((nnz,))
-    got = multiply_prefix_sum(v, d, block_rows=8)
-    want = jnp.cumsum(v)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+    local, totals, tile = multiply_prefix_sum(v, d, block_rows=8)
+    assert tile == 128 * 8 and len(totals) == 6
+    x = np.zeros(len(local))
+    x[:nnz] = np.asarray(v)
+    want = np.concatenate([np.cumsum(x[t * tile:(t + 1) * tile])
+                           for t in range(6)])
+    np.testing.assert_allclose(np.asarray(local), want,
                                rtol=1e-10, atol=1e-10)
 
 
