@@ -108,7 +108,7 @@ def main():
     # ---- 3. honest fit timings ---------------------------------------------
     from photon_ml_tpu.ops.objective import make_objective
     from photon_ml_tpu.optimize import OptimizerConfig
-    from photon_ml_tpu.parallel.data_parallel import fit_distributed
+    from photon_ml_tpu.parallel.data_parallel import build_csc, fit_distributed
     from photon_ml_tpu.parallel.mesh import make_mesh
     from photon_ml_tpu.types import LabeledBatch, SparseFeatures
 
@@ -121,22 +121,35 @@ def main():
     mesh = make_mesh()
     w0 = jnp.zeros((d,), jnp.float32)
 
-    for iters in (3, 20):
-        def fit():
-            return fit_distributed(
-                obj, batch, mesh, w0, l2=1.0, optimizer="lbfgs",
-                config=OptimizerConfig(max_iters=iters, tolerance=0.0),
-                sparse_grad="scatter")
+    t0 = time.perf_counter()
+    csc = build_csc(obj, batch, mesh)
+    leaf = jax.tree_util.tree_leaves(csc)[0]
+    float(jnp.sum(leaf.reshape(-1)[:1]))  # fetch-sync
+    print(f"csc build (hoisted, once/dataset): "
+          f"{(time.perf_counter()-t0)*1e3:.1f} ms", flush=True)
 
-        r = fit()
-        done = int(r.iterations)  # forces full sync (scalar fetch)
-        t0 = time.perf_counter()
-        r = fit()
-        done = int(r.iterations)
-        el = time.perf_counter() - t0
-        print(f"fit {iters} iters: {el*1e3:.1f} ms wall (ran {done} iters) "
-              f"-> {n*max(done,1)/el/1e6:.2f}M example-passes/s; "
-              f"loss={float(r.value):.6f}", flush=True)
+    # scatter vs hoisted-CSC fits: the decisive single-chip comparison.
+    # salt w0 per run (the axon backend memoizes identical executions);
+    # sync by scalar fetch of the result.
+    for mode in ("scatter", "csc"):
+        for iters in (3, 20):
+            def fit(salt):
+                return fit_distributed(
+                    obj, batch, mesh, w0 + jnp.float32(salt) * 1e-8,
+                    l2=1.0, optimizer="lbfgs",
+                    config=OptimizerConfig(max_iters=iters, tolerance=0.0),
+                    sparse_grad=mode,
+                    precomputed_csc=csc if mode == "csc" else None)
+
+            r = fit(1)
+            int(r.iterations)  # compile+warm, fetch-synced
+            t0 = time.perf_counter()
+            r = fit(2)
+            done = int(r.iterations)
+            el = time.perf_counter() - t0
+            print(f"fit[{mode}] {iters} iters: {el*1e3:.1f} ms wall "
+                  f"(ran {done}) -> {n*max(done,1)/el/1e6:.2f}M "
+                  f"example-passes/s; loss={float(r.value):.6f}", flush=True)
 
 
 if __name__ == "__main__":
