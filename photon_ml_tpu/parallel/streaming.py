@@ -138,6 +138,23 @@ def _chunk_to_device(chunk: HostChunk, dim: int, dtype, sharding) -> LabeledBatc
     )
 
 
+
+def _kahan_add(acc, comp, x):
+    """One compensated (Kahan) accumulation step: returns (acc', comp')
+    with acc' - comp' == (acc - comp) + x to ~f32-exact (``comp`` holds
+    the running EXCESS of ``acc`` over the true sum, so fold with
+    ``acc - comp``). Streamed fits sum
+    thousands of per-chunk partials — at the 1TB north star (~15k chunks)
+    naive f32 accumulation drifts by ~n_chunks * eps (~2e-3 relative on
+    biased sums), which this removes without f64 (unavailable on TPU
+    without x64). XLA is IEEE-strict by default, so the cancellation
+    sequence below is not reassociated away."""
+    y = x - comp
+    t = acc + y
+    comp = (t - acc) - y
+    return t, comp
+
+
 def streaming_value_and_grad(
     objective: GLMObjective,
     chunks: Sequence[HostChunk],
@@ -159,9 +176,11 @@ def streaming_value_and_grad(
     # time (same failure mode the fit_distributed runner cache fixes)
 
     def _make_chunk_fg():
-        def chunk_fg(w, batch, f_acc, g_acc):
+        def chunk_fg(w, batch, f_acc, f_comp, g_acc, g_comp):
             f, g = objective.value_and_grad(w, batch, 0.0)
-            return f_acc + f, g_acc + g
+            f_acc, f_comp = _kahan_add(f_acc, f_comp, f)
+            g_acc, g_comp = _kahan_add(g_acc, g_comp, g)
+            return f_acc, f_comp, g_acc, g_comp
         return chunk_fg
 
     chunk_fg = cached_jit(objective, ("stream_fg", mesh, axis),
@@ -169,17 +188,20 @@ def streaming_value_and_grad(
 
     def fg(w, l2=0.0):
         w = jnp.asarray(w, dtype)
-        f_acc = jnp.zeros((), dtype)
-        g_acc = jnp.zeros((dim,), dtype)
+        acc = (jnp.zeros((), dtype), jnp.zeros((), dtype),
+               jnp.zeros((dim,), dtype), jnp.zeros((dim,), dtype))
         # one-chunk lookahead: transfer chunk i+1 while chunk i computes
         pending = None
         for chunk in chunks:
             dev = _chunk_to_device(chunk, dim, dtype, sharding)
             if pending is not None:
-                f_acc, g_acc = chunk_fg(w, pending, f_acc, g_acc)
+                acc = chunk_fg(w, pending, *acc)
             pending = dev
         if pending is not None:
-            f_acc, g_acc = chunk_fg(w, pending, f_acc, g_acc)
+            acc = chunk_fg(w, pending, *acc)
+        # fold the compensations in before the cross-process reduction
+        # (comp is the accumulated EXCESS: subtract it)
+        f_acc, g_acc = acc[0] - acc[1], acc[2] - acc[3]
         f_acc, g_acc = _cross_process_sum((f_acc, g_acc))
         wr = objective._reg_mask(w)
         l2 = jnp.asarray(l2, dtype)
@@ -203,16 +225,17 @@ def streaming_hvp(
 
     chunk_hvp = cached_jit(
         objective, ("stream_hvp", mesh, axis),
-        lambda: lambda w, v, batch, acc: acc + objective.hvp(w, v, batch, 0.0))
+        lambda: lambda w, v, batch, acc, comp: _kahan_add(
+            acc, comp, objective.hvp(w, v, batch, 0.0)))
 
     def hvp(w, v, l2=0.0):
         w = jnp.asarray(w, dtype)
         v = jnp.asarray(v, dtype)
-        acc = jnp.zeros((dim,), dtype)
+        acc = comp = jnp.zeros((dim,), dtype)
         for chunk in chunks:
-            acc = chunk_hvp(w, v, _chunk_to_device(chunk, dim, dtype, sharding),
-                            acc)
-        acc = _cross_process_sum(acc)
+            acc, comp = chunk_hvp(
+                w, v, _chunk_to_device(chunk, dim, dtype, sharding), acc, comp)
+        acc = _cross_process_sum(acc - comp)
         return acc + jnp.asarray(l2, dtype) * objective._reg_mask(v)
 
     return hvp
@@ -236,14 +259,15 @@ def streaming_coefficient_variances(
 
     chunk_diag = cached_jit(
         objective, ("stream_diag", mesh, axis),
-        lambda: lambda w, batch, acc: acc + objective.diagonal_hessian(
-            w, batch, 0.0))
+        lambda: lambda w, batch, acc, comp: _kahan_add(
+            acc, comp, objective.diagonal_hessian(w, batch, 0.0)))
 
     w = jnp.asarray(w, dtype)
-    acc = jnp.zeros((dim,), dtype)
+    acc = comp = jnp.zeros((dim,), dtype)
     for chunk in chunks:
-        acc = chunk_diag(w, _chunk_to_device(chunk, dim, dtype, sharding), acc)
-    acc = _cross_process_sum(acc)
+        acc, comp = chunk_diag(
+            w, _chunk_to_device(chunk, dim, dtype, sharding), acc, comp)
+    acc = _cross_process_sum(acc - comp)
     reg = jnp.full((dim,), jnp.asarray(l2, dtype))
     if not objective.regularize_intercept and objective.intercept_index >= 0:
         reg = reg.at[objective.intercept_index].set(0.0)

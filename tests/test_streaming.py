@@ -316,3 +316,56 @@ def test_summarize_features_implicit_ones(rng):
     for f in ("mean", "variance", "std", "min", "max", "num_nonzeros"):
         np.testing.assert_allclose(getattr(sb, f), getattr(se, f),
                                    err_msg=f)
+
+
+def test_kahan_add_survives_magnitude_gap():
+    """The compensated accumulator must absorb additions far below the
+    accumulator's ulp — the regime a 1TB stream reaches once the running
+    sum dwarfs one chunk's partial. Naive f32 drops them entirely."""
+    from photon_ml_tpu.parallel.streaming import _kahan_add
+
+    acc = jnp.float32(1e8)   # ulp(1e8) = 8 in f32
+    comp = jnp.float32(0.0)
+    naive = jnp.float32(1e8)
+    # 1003 NOT divisible by 8 = ulp(1e8): comp is nonzero at the end, so
+    # this asserts the fold SIGN too (acc + comp would give 1e8 + 997)
+    for _ in range(1003):
+        acc, comp = _kahan_add(acc, comp, jnp.float32(1.0))
+        naive = naive + jnp.float32(1.0)
+    assert float(naive) == 1e8  # every add was lost
+    assert float(comp) != 0.0
+    # comp holds the excess of acc over the true sum: fold by subtracting
+    assert float(acc) - float(comp) == 1e8 + 1003  # none were lost
+
+
+def test_streamed_accumulation_is_compensated(rng):
+    """512-chunk streamed f32 fg stays within a few f32 ulps of the f64
+    reference (the compensated accumulators keep the drift flat in the
+    number of chunks; the magnitude-gap unit test above is the
+    discriminating case)."""
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.parallel.streaming import (
+        make_host_chunks, streaming_value_and_grad,
+    )
+    from photon_ml_tpu.game.data import HostSparse
+
+    n, k, dim, chunk_rows = 1 << 15, 8, 64, 64  # 512 chunks
+    indices = rng.integers(0, dim, (n, k)).astype(np.int32)
+    labels = np.ones(n)  # biased: every chunk's f/g partial has one sign
+    feats = HostSparse(indices, None, dim)
+    chunks, _ = make_host_chunks(feats, labels, chunk_rows=chunk_rows)
+    assert len(chunks) == 512
+
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=dim) * 0.1, jnp.float32)
+
+    fg32 = streaming_value_and_grad(obj, chunks, dim, dtype=jnp.float32)
+    f32_, g32 = fg32(w, 0.0)
+    fg64 = streaming_value_and_grad(obj, chunks, dim, dtype=jnp.float64)
+    f64_, g64 = fg64(jnp.asarray(w, jnp.float64), 0.0)
+
+    rel_f = abs(float(f32_) - float(f64_)) / abs(float(f64_))
+    rel_g = float(np.max(np.abs(np.asarray(g32, np.float64) - np.asarray(g64))
+                         / np.maximum(np.abs(np.asarray(g64)), 1e-6)))
+    assert rel_f < 2e-6, rel_f
+    assert rel_g < 2e-5, rel_g
