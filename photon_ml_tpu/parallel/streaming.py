@@ -12,8 +12,11 @@ per-chunk kernel (one compilation, static shapes), and the L-BFGS direction
 in-memory optimizer uses. Transfers overlap compute via one-chunk lookahead
 (JAX async dispatch).
 
-Cost model matches the reference: each L-BFGS iteration (plus each extra
-line-search evaluation) is one full pass over the data.
+Cost model: the default margin-space L-BFGS pays exactly two sparse
+passes per iteration (direction margins + accepted-point gradient) with
+line-search trials streaming only cached margin vectors; the black-box
+loops (``lbfgs_blackbox``, TRON, OWL-QN) match the reference's model of
+one full pass per evaluation.
 """
 
 from __future__ import annotations
@@ -290,15 +293,18 @@ def fit_streaming(
 ) -> OptimizationResult:
     """Streamed (larger-than-HBM) full-batch fit.
 
-    ``optimizer``: "lbfgs" (default), "tron" (trust-region Newton — each CG
-    step is one streamed HVP pass, exactly the reference's cost model), or
-    "owlqn" (L1; requires ``l1`` > 0 makes sense). Only the outer control
-    flow runs on host; direction/update vector math stays on device.
-    Line search is backtracking Armijo; pairs are stored only under a
-    curvature guard, which keeps the inverse-Hessian metric positive
-    definite without paying extra full passes for the Wolfe curvature
-    condition (a weaker (s,y) filter than the in-memory strong-Wolfe
-    optimizer — convergence contract documented in docs/PERF.md)."""
+    ``optimizer``: "lbfgs" (default — margin-space line search: trials
+    stream cached margin vectors instead of paying a sparse pass each,
+    see ``_fit_streaming_lbfgs_margin``), "lbfgs_blackbox" (one full
+    streamed fg pass per Armijo trial — the reference's cost model),
+    "tron" (trust-region Newton — each CG step is one streamed HVP
+    pass), or "owlqn" (L1; auto-selected when ``l1`` > 0). Only the
+    outer control flow runs on host; direction/update vector math stays
+    on device. Line search is backtracking Armijo; pairs are stored only
+    under a curvature guard, which keeps the inverse-Hessian metric
+    positive definite without paying extra full passes for the Wolfe
+    curvature condition (a weaker (s,y) filter than the in-memory
+    strong-Wolfe optimizer — convergence contract in docs/PERF.md)."""
     if np.asarray(l1).item() > 0 and optimizer != "owlqn":
         optimizer = "owlqn"
     if optimizer == "tron":
@@ -307,7 +313,10 @@ def fit_streaming(
     if optimizer == "owlqn":
         return _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1,
                                     config, dtype, mesh, axis)
-    if optimizer != "lbfgs":
+    if optimizer == "lbfgs":
+        return _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2,
+                                           config, dtype, mesh, axis)
+    if optimizer != "lbfgs_blackbox":
         raise ValueError(f"unknown streaming optimizer '{optimizer}'")
     m = config.history
     if w0 is None:
@@ -315,17 +324,9 @@ def fit_streaming(
     w = jnp.asarray(w0, dtype)
     fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis)
 
-    direction = jax.jit(functools.partial(two_loop_direction, m=m))
-
-    @jax.jit
-    def store_pair(s_hist, y_hist, rho, k, step, y):
-        sy = jnp.sum(step * y)
-        slot = jnp.mod(k, m)
-        return (s_hist.at[slot].set(step), y_hist.at[slot].set(y),
-                rho.at[slot].set(1.0 / sy))
+    direction, store_pair = _lbfgs_stream_kernels(objective, mesh, axis, m)
 
     f, g = fg(w, l2)
-    f0 = float(f)
     g0_norm = float(jnp.linalg.norm(g))
     s_hist = jnp.zeros((m, dim), dtype)
     y_hist = jnp.zeros((m, dim), dtype)
@@ -368,6 +369,194 @@ def fit_streaming(
                                              jnp.asarray(k), step, yv)
             k += 1
         w, f, g = w_try, f_try, g_try
+        gnorm = float(jnp.linalg.norm(g))
+        loss_hist[it] = float(f)
+        gnorm_hist[it] = gnorm
+        rel = abs(f_cur - float(f)) / max(abs(f_cur), eps)
+        if rel < tol or gnorm < tol * max(g0_norm, eps):
+            converged = True
+            it += 1
+            break
+    else:
+        it = config.max_iters
+
+    return OptimizationResult(
+        w=w, value=f, grad_norm=jnp.linalg.norm(g),
+        iterations=jnp.asarray(it), converged=jnp.asarray(converged),
+        loss_history=jnp.asarray(loss_hist),
+        grad_norm_history=jnp.asarray(gnorm_hist),
+    )
+
+
+def _lbfgs_stream_kernels(objective, mesh, axis, m):
+    """Jitted direction/store-pair kernels, cached per (objective, m) so a
+    GAME CD loop re-entering fit_streaming every iteration reuses the
+    compiled executables (the same failure mode the chunk-kernel cache
+    exists for)."""
+    direction = cached_jit(
+        objective, ("stream_dir", mesh, axis, m),
+        lambda: functools.partial(two_loop_direction, m=m))
+
+    def _make_store():
+        def store_pair(s_hist, y_hist, rho, k, step, y):
+            sy = jnp.sum(step * y)
+            slot = jnp.mod(k, m)
+            return (s_hist.at[slot].set(step), y_hist.at[slot].set(y),
+                    rho.at[slot].set(1.0 / sy))
+        return store_pair
+
+    store_pair = cached_jit(objective, ("stream_store", mesh, axis, m),
+                            _make_store)
+    return direction, store_pair
+
+
+def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
+                                dtype, mesh, axis) -> OptimizationResult:
+    """Streamed L-BFGS with margin-space line search (the default).
+
+    The black-box streamed loop pays one FULL sparse pass (index gather +
+    transpose) per Armijo trial. GLM margins are affine in w (offsets and
+    the normalization adjust are the constant/linear parts —
+    ``ops/objective.margins``), so this loop instead caches the per-chunk
+    margin vectors ``mw`` in HOST RAM and evaluates every trial by
+    streaming only (mw, mp, labels, weights) — 16 bytes/row against the
+    hundreds of bytes/row of a sparse pass. Per iteration: one gather pass
+    (the direction's margins), pointwise-only trials, and one
+    gather+transpose pass for the accepted point's gradient — the same
+    2-sparse-pass cost as the in-memory margin optimizer
+    (``optimize/lbfgs_margin.py``), where the black-box loop paid
+    ``1 + n_trials`` full passes. The L2 term is closed-form along the ray
+    (three O(d) scalars). Accumulations are Kahan-compensated; Armijo
+    semantics and the (s, y) curvature guard match the black-box loop.
+
+    Drift consistency: ``mw`` is updated incrementally and in f32 slowly
+    drifts from the exact margins of ``w``, so the Armijo test compares
+    the trial against ``phi(0)`` — the margin-space value of the CURRENT
+    point under the same drift — never against the exact ``f`` from the
+    sparse pass (mixing the two reference frames would make the shrinking
+    Armijo allowance a coin flip near convergence). Exact (f, g) from the
+    accepted-point sparse pass still drive convergence tests and the
+    returned histories."""
+    m = config.history
+    if w0 is None:
+        w0 = jnp.zeros((dim,), dtype)
+    w = jnp.asarray(w0, dtype)
+    sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
+    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis)
+
+    margin_k = cached_jit(
+        objective, ("stream_margin", mesh, axis),
+        lambda: lambda w, batch: objective.margins(w, batch))
+    # per-chunk trial: masked margins -> weighted loss partial (Kahan)
+    from photon_ml_tpu.ops.losses import apply_weights, mask_margins
+
+    def _make_trial():
+        def trial(mw, mp, labels, weights, alpha, f_acc, f_comp):
+            mm = mask_margins(weights, mw + alpha * mp)
+            f = jnp.sum(apply_weights(weights,
+                                      objective.loss.loss(mm, labels)))
+            return _kahan_add(f_acc, f_comp, f)
+        return trial
+
+    trial_k = cached_jit(objective, ("stream_trial", mesh, axis), _make_trial)
+
+    def _put(a):
+        dev = jnp.asarray(a, dtype)
+        return jax.device_put(dev, sharding) if sharding else dev
+
+    def margins_of(vec, out):
+        """One streamed gather pass: per-chunk margins of ``vec`` (offsets
+        included), stored to host numpy in ``out``. One-chunk lookahead:
+        chunk i+1's transfer+compute dispatch before chunk i's
+        device->host fetch blocks, mirroring fg's overlap."""
+        pending = None
+        for i, chunk in enumerate(chunks):
+            dev = _chunk_to_device(chunk, dim, dtype, sharding)
+            res = margin_k(vec, dev)
+            if pending is not None:
+                out[pending[0]] = np.asarray(pending[1])
+            pending = (i, res)
+        if pending is not None:
+            out[pending[0]] = np.asarray(pending[1])
+        return out
+
+    def phi(mw_h, mp_h, alpha):
+        """f(w + alpha p) data term via margin-only streaming."""
+        f_acc = f_comp = jnp.zeros((), dtype)
+        a = jnp.asarray(alpha, dtype)
+        for i, chunk in enumerate(chunks):
+            f_acc, f_comp = trial_k(
+                _put(mw_h[i]), _put(mp_h[i]),
+                _put(chunk.labels), _put(chunk.weights),
+                a, f_acc, f_comp)
+        (f_acc,) = _cross_process_sum((f_acc - f_comp,))
+        return float(f_acc)
+
+    direction, store_pair = _lbfgs_stream_kernels(objective, mesh, axis, m)
+
+    f, g = fg(w, l2)
+    g0_norm = float(jnp.linalg.norm(g))
+    mw_h = margins_of(w, [None] * len(chunks))
+    mp_h = [None] * len(chunks)
+    s_hist = jnp.zeros((m, dim), dtype)
+    y_hist = jnp.zeros((m, dim), dtype)
+    rho = jnp.zeros((m,), dtype)
+    k = 0
+    eps = float(jnp.finfo(dtype).eps)
+    tol = max(config.tolerance, eps)
+    loss_hist = np.full((config.max_iters,), np.nan)
+    gnorm_hist = np.full((config.max_iters,), np.nan)
+
+    it = 0
+    converged = False
+    for it in range(config.max_iters):
+        p = direction(g, s_hist, y_hist, rho, jnp.asarray(k))
+        dg = float(jnp.sum(p * g))
+        if dg >= 0:  # degraded metric: steepest descent restart
+            p = -g
+            dg = -float(jnp.sum(g * g))
+        # ONE gather pass: the direction's margins (offsets subtracted:
+        # margins() adds them and they are the affine constant)
+        mp_h = margins_of(p, mp_h)
+        for i, chunk in enumerate(chunks):
+            mp_h[i] = mp_h[i] - np.asarray(chunk.offsets, mp_h[i].dtype)
+        # L2 along the ray: f(w+ap) = data(a) + l2/2 (c0 + 2 a c1 + a^2 c2)
+        wr = np.asarray(objective._reg_mask(w), np.float64)
+        pr = np.asarray(objective._reg_mask(p), np.float64)
+        l2f = float(np.asarray(l2))
+        c0, c1, c2 = wr @ wr, wr @ pr, pr @ pr
+
+        alpha = 1.0 if k > 0 else 1.0 / max(g0_norm, 1.0)
+        f_cur = float(f)  # exact value (fg pass) — drives convergence only
+        # margin-space value of the current point: same drift frame as the
+        # trials (one extra cheap margin-only stream per iteration)
+        f_cur_m = phi(mw_h, mp_h, 0.0) + 0.5 * l2f * c0
+        accepted = False
+        for _ in range(config.max_line_search_steps):
+            f_try = (phi(mw_h, mp_h, alpha)
+                     + 0.5 * l2f * (c0 + 2.0 * alpha * c1
+                                    + alpha * alpha * c2))
+            if f_try <= f_cur_m + 1e-4 * alpha * dg and np.isfinite(f_try):
+                accepted = True
+                break
+            alpha *= 0.5
+        if not accepted:
+            break
+        w_try = w + jnp.asarray(alpha, dtype) * p
+        # accepted point: ONE gather+transpose pass for the exact (f, g)
+        f_try_x, g_try = fg(w_try, l2)
+        for i in range(len(chunks)):
+            mw_h[i] = mw_h[i] + mw_h[i].dtype.type(alpha) * mp_h[i]
+        step = w_try - w
+        yv = g_try - g
+        sy = float(jnp.sum(step * yv))
+        if sy > 1e-10 * max(
+            float(jnp.linalg.norm(step)) * float(jnp.linalg.norm(yv)), eps
+        ):
+            s_hist, y_hist, rho = store_pair(s_hist, y_hist, rho,
+                                             jnp.asarray(k), step, yv)
+            k += 1
+        w, f, g = w_try, f_try_x, g_try
         gnorm = float(jnp.linalg.norm(g))
         loss_hist[it] = float(f)
         gnorm_hist[it] = gnorm

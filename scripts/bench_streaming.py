@@ -35,6 +35,9 @@ def main():
     ap.add_argument("--rows-log2", type=int, default=None)
     ap.add_argument("--chunk-rows", type=int, default=None)
     ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--optimizer", default="lbfgs",
+                    help="lbfgs (margin-space trials, default) or "
+                         "lbfgs_blackbox (full pass per trial)")
     ap.add_argument("--timeout", type=float, default=900.0)
     args = ap.parse_args()
 
@@ -100,7 +103,7 @@ def main():
         # salted w0: warm-up and timed run must be distinct computations
         # (the axon backend appears to memoize bit-identical executions)
         res = fit_streaming(obj, chunks, dim, w0 + jnp.float32(salt) * 1e-8,
-                            l2=1.0, config=cfg)
+                            l2=1.0, config=cfg, optimizer=args.optimizer)
         int(res.iterations)  # scalar fetch: true end-to-end sync
         return res
 
@@ -115,7 +118,7 @@ def main():
         "value": round(v_stream, 1),
         "unit": (f"example-passes/sec end-to-end incl transfer ({platform},"
                  f" n={n}, d={dim}, k={k}, chunk_rows={chunk_rows},"
-                 f" iters={done})"),
+                 f" iters={done}, optimizer={args.optimizer})"),
     }), flush=True)
 
     # in-HBM comparison on the same data (may OOM at big shapes; guarded).

@@ -369,3 +369,33 @@ def test_streamed_accumulation_is_compensated(rng):
                          / np.maximum(np.abs(np.asarray(g64)), 1e-6)))
     assert rel_f < 2e-6, rel_f
     assert rel_g < 2e-5, rel_g
+
+
+def test_streamed_margin_vs_blackbox_lbfgs(rng):
+    """The margin-space streamed L-BFGS (default) and the black-box loop
+    share Armijo semantics: same fits to tight tolerance in f64."""
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.parallel.streaming import (
+        fit_streaming, make_host_chunks,
+    )
+    from photon_ml_tpu.game.data import HostSparse
+    from photon_ml_tpu.optimize import OptimizerConfig
+
+    n, k, dim = 2000, 6, 40
+    indices = rng.integers(0, dim, (n, k)).astype(np.int32)
+    values = rng.normal(size=(n, k))
+    w_true = rng.normal(size=dim)
+    margins = (values * w_true[indices]).sum(axis=1)
+    labels = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(float)
+    chunks, _ = make_host_chunks(HostSparse(indices, values, dim), labels,
+                                 chunk_rows=256)
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-12)
+    r_m = fit_streaming(obj, chunks, dim, l2=0.5, config=cfg,
+                        dtype=jnp.float64)
+    r_b = fit_streaming(obj, chunks, dim, l2=0.5, config=cfg,
+                        dtype=jnp.float64, optimizer="lbfgs_blackbox")
+    np.testing.assert_allclose(np.asarray(r_m.w), np.asarray(r_b.w),
+                               rtol=1e-6, atol=1e-9)
+    assert abs(float(r_m.value) - float(r_b.value)) < 1e-8 * abs(
+        float(r_b.value))
