@@ -123,6 +123,11 @@ class CoordinateConfig:
             raise ValueError(
                 f"coordinate '{self.name}': streaming applies to fixed "
                 "effects (random-effect data is per-entity bucketed)")
+        if self.optimizer == "newton" and self.coordinate_type != "random":
+            raise ValueError(
+                f"coordinate '{self.name}': optimizer='newton' is the "
+                "batched dense per-entity solver — random coordinates "
+                "only (fixed effects use lbfgs/owlqn/tron)")
         if (self.coordinate_type == "random" and self.normalization is not None
                 and self.projection == "random"):
             raise ValueError(

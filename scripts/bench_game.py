@@ -104,25 +104,31 @@ def main():
     offsets = jnp.zeros((n_re,), jnp.float32)
     cfg = OptimizerConfig(max_iters=10, tolerance=0.0)
 
-    def re_solve(l2):
+    def re_solve(l2, optimizer):
         # l2 is a traced scalar: varying it between warm-up and timed run
         # makes the timed call a distinct computation (the axon remote
         # backend appears to memoize bit-identical executions) without
         # recompiling. train_random_effect np.asarray()s the coefficients,
         # which host-syncs the result.
-        return train_random_effect(data, offsets, l2=l2, config=cfg)
+        return train_random_effect(data, offsets, l2=l2, config=cfg,
+                                   optimizer=optimizer)
 
-    re_solve(0.5)  # compile + warm-up
-    t0 = time.perf_counter()
-    fit = re_solve(0.5000001)
-    dt = time.perf_counter() - t0
-    assert float(np.abs(fit.coefficients[0]).sum()) > 0
-    print(json.dumps({
-        "metric": "game_re_vmap_entities_per_sec",
-        "value": round(n_entities / dt, 1),
-        "unit": f"entities/sec ({platform}, E={n_entities}, "
-                f"rows/entity={rows_per}, d_local={local_d}, 10 iters)",
-    }), flush=True)
+    # both RE solvers: the vmapped sparse L-BFGS and the batched dense
+    # Newton (einsum/MXU) — which wins is the hardware question
+    for opt_name in ("lbfgs", "newton"):
+        re_solve(0.5, opt_name)  # compile + warm-up
+        t0 = time.perf_counter()
+        fit = re_solve(0.5000001, opt_name)
+        dt = time.perf_counter() - t0
+        assert float(np.abs(fit.coefficients[0]).sum()) > 0
+        print(json.dumps({
+            "metric": f"game_re_{opt_name}_entities_per_sec",
+            "value": round(n_entities / dt, 1),
+            "unit": (f"entities/sec ({platform}, E={n_entities}, "
+                     f"rows/entity={rows_per}, d_local={local_d}, "
+                     f"optimizer={opt_name}, mean_iters="
+                     f"{fit.mean_iterations:.1f})"),
+        }), flush=True)
 
     # -- 2. one full CD iteration (fixed + 2 random effects) --------------
     users = rng.integers(0, n_entities, size=n_fixed)

@@ -372,3 +372,69 @@ def test_game_with_implicit_ones_features(rng):
         preds[name] = GameTransformer(model).predict_mean(train)
     np.testing.assert_allclose(preds["binary"], preds["explicit"],
                                rtol=1e-6, atol=1e-7)
+
+
+def test_newton_dense_re_solver_matches_lbfgs(rng):
+    """The batched dense-Newton RE solver (optimizer='newton') matches the
+    vmapped L-BFGS path: coefficients, variances (diagonal + full),
+    offsets, weights, and per-entity normalization all agree."""
+    from photon_ml_tpu.game.data import build_random_effect_data
+    from photon_ml_tpu.game.random_effect import train_random_effect
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+
+    n, d, E = 360, 5, 12
+    X = rng.normal(size=(n, d)) * np.array([10.0, 0.2, 1.0, 3.0, 1.0])
+    X = X * (rng.random((n, d)) < 0.8)
+    ids = rng.integers(0, E, n)
+    u = rng.normal(size=(E, d))
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.sum(X * u[ids], axis=1)))
+         ).astype(float)
+    weights = rng.uniform(0.5, 2.0, n)
+    offs = rng.normal(size=n) * 0.3
+
+    from photon_ml_tpu.optimize import OptimizerConfig
+
+    data = build_random_effect_data(X, y, weights, ids, num_buckets=2)
+    cfg_kw = dict(task="logistic", l2=0.7, dtype=jnp.float64,
+                  config=OptimizerConfig(max_iters=100, tolerance=1e-10))
+    f_lb = train_random_effect(data, offs, optimizer="lbfgs",
+                               compute_variance="full", **cfg_kw)
+    f_nt = train_random_effect(data, offs, optimizer="newton",
+                               compute_variance="full", **cfg_kw)
+    assert f_nt.converged_fraction == 1.0
+    assert f_nt.mean_iterations <= f_lb.mean_iterations  # Newton is quadratic
+    for b in range(len(f_lb.coefficients)):
+        np.testing.assert_allclose(f_nt.coefficients[b], f_lb.coefficients[b],
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(f_nt.variances[b], f_lb.variances[b],
+                                   rtol=1e-4, atol=1e-8)
+    d_lb = train_random_effect(data, offs, optimizer="lbfgs",
+                               compute_variance="diagonal", **cfg_kw)
+    d_nt = train_random_effect(data, offs, optimizer="newton",
+                               compute_variance="diagonal", **cfg_kw)
+    for b in range(len(d_lb.variances)):
+        np.testing.assert_allclose(d_nt.variances[b], d_lb.variances[b],
+                                   rtol=1e-4, atol=1e-8)
+
+    # normalization (factors + shifts through the intercept) parity
+    Xi = np.concatenate([X, np.ones((n, 1))], axis=1)
+    mean = Xi.mean(axis=0)
+    std = np.where(Xi.std(axis=0) > 0, Xi.std(axis=0), 1.0)
+    ctx = NormalizationContext(jnp.asarray(1.0 / std), jnp.asarray(mean),
+                               intercept_index=d)
+    data_i = build_random_effect_data(Xi, y, weights, ids, num_buckets=2)
+    g_lb = train_random_effect(data_i, offs, optimizer="lbfgs",
+                               normalization=ctx, **cfg_kw)
+    g_nt = train_random_effect(data_i, offs, optimizer="newton",
+                               normalization=ctx, **cfg_kw)
+    for b in range(len(g_lb.coefficients)):
+        np.testing.assert_allclose(g_nt.coefficients[b], g_lb.coefficients[b],
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_newton_rejected_for_fixed_coordinates():
+    from photon_ml_tpu.game.descent import CoordinateConfig
+
+    with pytest.raises(ValueError, match="newton"):
+        CoordinateConfig("fixed", coordinate_type="fixed",
+                         optimizer="newton")
