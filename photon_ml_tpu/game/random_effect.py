@@ -78,7 +78,14 @@ def _newton_dense_solver(local_dim: int, task: str,
             val_j = jnp.take(values, j, axis=2)[..., None]
             return X + jnp.where(idx_j == iota, val_j, 0.0), None
 
-        X, _ = jax.lax.scan(add_slot, jnp.zeros((E, N, D), dt),
+        # match_vma: under the entity-axis shard_map the data varies over
+        # the mesh axis but fresh zeros/True carries do not; align every
+        # loop carry or scan/while_loop reject the carry types (no-op
+        # outside shard_map)
+        from photon_ml_tpu.optimize.common import match_vma, match_vma_tree
+
+        X, _ = jax.lax.scan(add_slot,
+                            match_vma(jnp.zeros((E, N, D), dt), values),
                             jnp.arange(kk))
         # normalization in data space: x' = (x - s) * f per local slot
         # (exactly the sparse path's effective-coefficient fold)
@@ -157,8 +164,9 @@ def _newton_dense_solver(local_dim: int, task: str,
             f_out = jnp.where(active, f_new, f)
             return (W_new, f_out, active_new, conv_seen | conv, iters_new)
 
-        state = (jnp.asarray(w0, dt), f0, jnp.ones((E,), bool),
-                 jnp.zeros((E,), bool), jnp.zeros((E,), jnp.int32))
+        state = match_vma_tree(
+            (jnp.asarray(w0, dt), f0, jnp.ones((E,), bool),
+             jnp.zeros((E,), bool), jnp.zeros((E,), jnp.int32)), values)
         W, f, active, conv_seen, iters = jax.lax.while_loop(cond, body,
                                                             state)
         converged = conv_seen

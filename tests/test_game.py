@@ -210,7 +210,8 @@ def test_duplicate_coordinate_names_rejected():
         CoordinateDescent([CoordinateConfig("a"), CoordinateConfig("a")])
 
 
-def test_train_random_effect_entity_sharded_matches(rng):
+@pytest.mark.parametrize("optimizer", ["lbfgs", "newton"])
+def test_train_random_effect_entity_sharded_matches(rng, optimizer):
     # entity-axis shard_map path == unsharded path (review/verify regression)
     from photon_ml_tpu.parallel import make_mesh
 
@@ -222,9 +223,9 @@ def test_train_random_effect_entity_sharded_matches(rng):
     mesh = make_mesh({"entity": 4})
     cfg = OptimizerConfig(max_iters=60, tolerance=1e-10)
     fit_plain = train_random_effect(data, np.zeros(n), l2=0.4, dtype=jnp.float64,
-                                    config=cfg)
+                                    config=cfg, optimizer=optimizer)
     fit_mesh = train_random_effect(data, np.zeros(n), l2=0.4, dtype=jnp.float64,
-                                   config=cfg, mesh=mesh)
+                                   config=cfg, mesh=mesh, optimizer=optimizer)
     for a, b in zip(fit_plain.coefficients, fit_mesh.coefficients):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
     assert fit_mesh.converged_fraction == 1.0
