@@ -43,7 +43,7 @@ class OptimizationResult(NamedTuple):
     grad_norm_history: jax.Array  # [max_iters] same padding
 
 
-def converged_check(f_prev, f, g_norm, g0_norm, tol):
+def converged_check(f_prev, f, g_norm, g0_norm, tol, f_scale=None):
     """Reference-style stopping rule: relative loss change below tol OR
     gradient norm below tol * max(1, ||g0||). A positive tolerance is
     clamped to a few ulps of the working dtype so a tol tuned for f64
@@ -51,14 +51,33 @@ def converged_check(f_prev, f, g_norm, g0_norm, tol):
     max_iters. An explicit tol <= 0 is honored exactly — it disables both
     tests, pinning the iteration count at max_iters (bench determinism:
     round 2's f32 run silently stopped at 15/20 "pinned" iterations
-    because the clamp re-enabled the relative-loss test)."""
+    because the clamp re-enabled the relative-loss test).
+
+    ``f_scale``: override for the relative-test scale. Delta-space
+    callers pass the accurately-summed improvement as ``f_prev=0,
+    f=-delta`` (so the difference is exact, not a rounding artifact of
+    two large totals) with ``f_scale`` = the current loss value."""
     dtype = jnp.asarray(f).dtype
     eps = jnp.finfo(dtype).eps
     tol = jnp.asarray(tol, dtype)
     tol = jnp.where(tol > 0, jnp.maximum(tol, 4 * eps), tol)
-    rel_loss = jnp.abs(f_prev - f) <= tol * jnp.maximum(jnp.abs(f_prev), 1.0)
+    scale = jnp.abs(f_prev if f_scale is None else f_scale)
+    rel_loss = jnp.abs(f_prev - f) <= tol * jnp.maximum(scale, 1.0)
     grad_small = g_norm <= tol * jnp.maximum(g0_norm, 1.0)
     return (tol > 0) & (rel_loss | grad_small)
+
+
+def grad_converged(g_norm, g0_norm, tol):
+    """The gradient-norm half of :func:`converged_check` alone (same tol
+    clamping). Used when a failed line search invalidates the relative-
+    loss test (f unchanged -> zero delta would pass spuriously) but the
+    gradient test remains meaningful — a search that fails AT the optimum
+    must still report convergence."""
+    dtype = jnp.asarray(g_norm).dtype
+    eps = jnp.finfo(dtype).eps
+    tol = jnp.asarray(tol, dtype)
+    tol = jnp.where(tol > 0, jnp.maximum(tol, 4 * eps), tol)
+    return (tol > 0) & (g_norm <= tol * jnp.maximum(g0_norm, 1.0))
 
 
 def init_history(max_iters: int, dtype) -> tuple[jax.Array, jax.Array]:

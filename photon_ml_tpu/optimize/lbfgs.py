@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from photon_ml_tpu.optimize.common import (
+    grad_converged,
     OptimizationResult,
     OptimizerConfig,
     converged_check,
@@ -113,13 +114,25 @@ def lbfgs(
         s_hist = jnp.where(store, s.s_hist.at[slot].set(step), s.s_hist)
         y_hist = jnp.where(store, s.y_hist.at[slot].set(y), s.y_hist)
         rho = jnp.where(store, s.rho.at[slot].set(1.0 / jnp.where(sy == 0, 1.0, sy)), s.rho)
-        k_new = jnp.where(store, s.k + 1, s.k)
+        # on line-search failure: reset the history and retry from
+        # steepest descent; stall only if -g itself failed (k == 0).
+        # conv is gated on ls.ok — a failed search leaves f unchanged and
+        # the zero delta would spuriously pass the relative test
+        # (same policy as optimize/lbfgs_margin.py)
+        k_new = jnp.where(store, s.k + 1, jnp.where(ls.ok, s.k, 0))
+        stalled = (~ls.ok) & (s.k == 0)
         gnorm = l2_norm(ls.g)
-        conv = converged_check(s.f, ls.f, gnorm, g0_norm, config.tolerance)
+        # failed search: only the rel-loss half is invalid (zero delta
+        # passes spuriously); the gradient test must still fire — a
+        # search failing AT the optimum is convergence, not a stall
+        conv = jnp.where(
+            ls.ok,
+            converged_check(s.f, ls.f, gnorm, g0_norm, config.tolerance),
+            grad_converged(gnorm, g0_norm, config.tolerance))
         return _State(
             s.it + 1, k_new, w_new, ls.f, ls.g,
             s_hist, y_hist, rho,
-            conv, ~ls.ok,
+            conv, stalled,
             s.loss_hist.at[s.it].set(ls.f),
             s.gnorm_hist.at[s.it].set(gnorm),
         )

@@ -37,6 +37,7 @@ from jax import lax
 
 from photon_ml_tpu.optimize.common import (
     OptimizationResult,
+    grad_converged,
     OptimizerConfig,
     converged_check,
     init_history,
@@ -73,10 +74,21 @@ def lbfgs_margin(
     m0: jax.Array,
     l2,
     config: OptimizerConfig = OptimizerConfig(),
+    loss_delta_and_dir: Callable | None = None,
+    # (m, m_p, alpha) -> (sum_i w_i (l(m_i + a m_p_i) - l(m_i)),
+    #                     sum_i w_i l'(m_i + a m_p_i) m_p_i)
 ) -> OptimizationResult:
     """Minimize  sum_i w_i l(m_i(w)) + 0.5*l2*||reg_mask(w)||^2  where the
     margin map is affine in w. All data reductions must already be global
-    (psummed) inside the supplied callables."""
+    (psummed) inside the supplied callables.
+
+    When ``loss_delta_and_dir`` is given, the line search and the
+    relative-loss convergence test run in DELTA space: per-row loss
+    differences are summed instead of differencing two rounded totals.
+    In f32 a total's resolution is eps*|f|, far coarser than late-stage
+    per-iteration improvements, so total-space Wolfe tests stall the fit
+    (observed on TPU: hard stop at 16/20 iterations); delta sums keep
+    relative accuracy in the improvement itself."""
     m = config.history
     d = w0.shape[0]
     dtype = w0.dtype
@@ -106,24 +118,43 @@ def lbfgs_margin(
         c1 = jnp.sum(wr * pr)
         c2 = jnp.sum(pr * pr)
 
-        def phi(alpha):
-            """(f(w + a p), f'(a)) as an O(n) pointwise computation; the
-            scalar derivative doubles as the 1-d 'gradient' for
-            strong_wolfe (with direction 1.0, sum(g*p) == the derivative)."""
-            f_data, df_data = loss_and_dir(s.mw + alpha * mp, mp)
-            f = f_data + 0.5 * l2 * (jnp.sum(wr * wr) + 2.0 * alpha * c1
-                                     + alpha * alpha * c2)
-            df = df_data + l2 * (c1 + alpha * c2)
-            return f, df
+        if loss_delta_and_dir is not None:
+            # DELTA space: phi returns f(w + a p) - f(w) via summed
+            # per-row differences (accurate at any |f|); strong_wolfe's
+            # tests are all translation-invariant, so feeding f0 = 0
+            # keeps its semantics exactly
+            def phi(alpha):
+                delta_data, df_data = loss_delta_and_dir(s.mw, mp, alpha)
+                delta = delta_data + l2 * (alpha * c1
+                                           + 0.5 * alpha * alpha * c2)
+                df = df_data + l2 * (c1 + alpha * c2)
+                return delta, df
+
+            ls_f0 = jnp.zeros((), dtype)
+        else:
+            def phi(alpha):
+                """(f(w + a p), f'(a)) as an O(n) pointwise computation;
+                the scalar derivative doubles as the 1-d 'gradient' for
+                strong_wolfe (direction 1.0: sum(g*p) == the derivative)."""
+                f_data, df_data = loss_and_dir(s.mw + alpha * mp, mp)
+                f = f_data + 0.5 * l2 * (jnp.sum(wr * wr)
+                                         + 2.0 * alpha * c1
+                                         + alpha * alpha * c2)
+                df = df_data + l2 * (c1 + alpha * c2)
+                return f, df
+
+            ls_f0 = s.f
 
         # phi'(0) == p . g exactly (g is the full gradient incl. the L2
         # term): an O(d) local dot, not another distributed evaluation
         df0 = jnp.sum(p * s.g)
         alpha0 = jnp.where(s.k > 0, 1.0, 1.0 / jnp.maximum(l2_norm(s.g), 1.0))
         ls = strong_wolfe(
-            phi, jnp.zeros((), dtype), jnp.ones((), dtype), s.f, df0,
+            phi, jnp.zeros((), dtype), jnp.ones((), dtype), ls_f0, df0,
             alpha0=alpha0, max_evals=config.max_line_search_steps,
         )
+        # in delta space ls.f is the accepted IMPROVEMENT (0 on failure)
+        f_new = (s.f + ls.f) if loss_delta_and_dir is not None else ls.f
         w_new = s.w + ls.alpha * p
         mw_new = s.mw + ls.alpha * mp
         g_new = full_g(mw_new, w_new)  # the iteration's ONE transpose pass
@@ -141,14 +172,36 @@ def lbfgs_margin(
         rho = jnp.where(store,
                         s.rho.at[slot].set(1.0 / jnp.where(sy == 0, 1.0, sy)),
                         s.rho)
-        k_new = jnp.where(store, s.k + 1, s.k)
+        # line-search failure (alpha=0, no step): RESET the history and
+        # retry from steepest descent before giving up — in f32 the
+        # L-BFGS metric goes stale near convergence and a restart often
+        # buys several more productive iterations (observed on TPU:
+        # hard stop at iteration 16/20). Stall only if the search failed
+        # with an already-empty history (p was -g).
+        k_new = jnp.where(store, s.k + 1, jnp.where(ls.ok, s.k, 0))
+        stalled = (~ls.ok) & (s.k == 0)
         gnorm = l2_norm(g_new)
-        conv = converged_check(s.f, ls.f, gnorm, g0_norm, config.tolerance)
+        # gate on ls.ok: a failed search leaves f unchanged, and a zero
+        # loss-delta would spuriously pass the relative convergence test
+        if loss_delta_and_dir is not None:
+            # accurate delta: test |improvement| directly against
+            # tol * max(|f|, 1) (converged_check would re-difference the
+            # rounded totals and lose exactly what delta space preserves)
+            full = converged_check(jnp.zeros((), dtype), -ls.f, gnorm,
+                                   g0_norm, config.tolerance, f_scale=s.f)
+        else:
+            full = converged_check(s.f, f_new, gnorm, g0_norm,
+                                   config.tolerance)
+        # failed search: rel-loss half is invalid (zero delta) but the
+        # gradient test must still fire — failing AT the optimum is
+        # convergence, not a stall
+        conv = jnp.where(ls.ok, full,
+                         grad_converged(gnorm, g0_norm, config.tolerance))
         return _State(
-            s.it + 1, k_new, w_new, mw_new, ls.f, g_new,
+            s.it + 1, k_new, w_new, mw_new, f_new, g_new,
             s_hist, y_hist, rho,
-            conv, ~ls.ok,
-            s.loss_hist.at[s.it].set(ls.f),
+            conv, stalled,
+            s.loss_hist.at[s.it].set(f_new),
             s.gnorm_hist.at[s.it].set(gnorm),
         )
 

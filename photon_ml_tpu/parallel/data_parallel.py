@@ -384,6 +384,35 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(axis), P(axis)),
+        out_specs=(P(), P()),
+    )
+    def s_delta_and_dir(m, mp, alpha, labels, weights):
+        """Line-search evaluation in DELTA space: sums the per-row loss
+        DIFFERENCES l(m + a*mp) - l(m), which keeps relative accuracy in
+        the delta itself. In f32 the total loss's resolution is eps*|f|
+        (~5e-3 at the bench scale) — far coarser than the per-iteration
+        improvements near convergence, so Wolfe tests on totals become
+        coin flips and the fit stalls (observed: hard stop at 16/20 on
+        TPU). The derivative is evaluated at the trial point as usual."""
+        mm0 = mask_margins(weights, m)
+        per_ex = lambda mm: jnp.sum(apply_weights(
+            weights, loss.loss(mask_margins(weights, mm), labels)))
+        m1 = m + alpha * mp
+        d1 = jax.grad(per_ex)(m1)
+        diffs = apply_weights(
+            weights,
+            loss.loss(mask_margins(weights, m1), labels)
+            - loss.loss(mm0, labels))
+        return (lax.psum(jnp.sum(diffs), axis),
+                lax.psum(jnp.sum(d1 * mp), axis))
+
+    def delta_and_dir(batch):
+        return lambda m, mp, alpha: s_delta_and_dir(
+            m, mp, alpha, batch.labels, batch.weights)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(),
     )
@@ -415,7 +444,8 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         return lambda m: s_grad_csc(
             m, batch.labels, batch.weights, csc)
 
-    return init_margin, dir_margin, loss_and_dir, make_data_grad
+    return (init_margin, dir_margin, loss_and_dir, make_data_grad,
+            delta_and_dir)
 
 
 def _fit_distributed_margin(
@@ -443,7 +473,8 @@ def _fit_distributed_margin(
            precomputed_csc is not None)
     run = cache.get(key)
     if run is None:
-        init_margin, dir_margin, loss_and_dir, make_data_grad = \
+        (init_margin, dir_margin, loss_and_dir, make_data_grad,
+         delta_and_dir) = \
             make_margin_path(objective, mesh, axis, transpose=transpose,
                              precise=(transpose == "csc_precise"))
         reg_mask = objective._reg_mask
@@ -464,6 +495,7 @@ def _fit_distributed_margin(
             return lbfgs_margin(
                 dir_margin(b), loss_and_dir(b), make_data_grad(b, csc),
                 reg_mask, w0, m0, l2v, config,
+                loss_delta_and_dir=delta_and_dir(b),
             )
 
         cache[key] = run
