@@ -452,13 +452,23 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
 
     def _make_trial():
         def trial(mw, mp, labels, weights, alpha, f_acc, f_comp):
-            mm = mask_margins(weights, mw + alpha * mp)
-            f = jnp.sum(apply_weights(weights,
-                                      objective.loss.loss(mm, labels)))
-            return _kahan_add(f_acc, f_comp, f)
+            # DELTA space: sum per-row loss DIFFERENCES l(mw + a*mp) -
+            # l(mw). In f32 a loss total's resolution is eps*|f|, far
+            # coarser than late-stage improvements, so Armijo on totals
+            # stalls; the difference keeps relative accuracy in the
+            # improvement itself (same scheme as the in-memory
+            # lbfgs_margin delta path). Also removes the need for a
+            # separate phi(0) stream: the trial compares against 0.
+            mm0 = mask_margins(weights, mw)
+            mm1 = mask_margins(weights, mw + alpha * mp)
+            d = jnp.sum(apply_weights(
+                weights, objective.loss.loss(mm1, labels)
+                - objective.loss.loss(mm0, labels)))
+            return _kahan_add(f_acc, f_comp, d)
         return trial
 
-    trial_k = cached_jit(objective, ("stream_trial", mesh, axis), _make_trial)
+    trial_k = cached_jit(objective, ("stream_trial_delta", mesh, axis),
+                         _make_trial)
 
     def _put(a):
         dev = jnp.asarray(a, dtype)
@@ -480,8 +490,8 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
             out[pending[0]] = np.asarray(pending[1])
         return out
 
-    def phi(mw_h, mp_h, alpha):
-        """f(w + alpha p) data term via margin-only streaming."""
+    def phi_delta(mw_h, mp_h, alpha):
+        """f(w + alpha p) - f(w), data term, via margin-only streaming."""
         f_acc = f_comp = jnp.zeros((), dtype)
         a = jnp.asarray(alpha, dtype)
         for i, chunk in enumerate(chunks):
@@ -520,23 +530,21 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         mp_h = margins_of(p, mp_h)
         for i, chunk in enumerate(chunks):
             mp_h[i] = mp_h[i] - np.asarray(chunk.offsets, mp_h[i].dtype)
-        # L2 along the ray: f(w+ap) = data(a) + l2/2 (c0 + 2 a c1 + a^2 c2)
+        # L2 delta along the ray: l2 * (a c1 + a^2/2 c2)
         wr = np.asarray(objective._reg_mask(w), np.float64)
         pr = np.asarray(objective._reg_mask(p), np.float64)
         l2f = float(np.asarray(l2))
-        c0, c1, c2 = wr @ wr, wr @ pr, pr @ pr
+        c1, c2 = wr @ pr, pr @ pr
 
         alpha = 1.0 if k > 0 else 1.0 / max(g0_norm, 1.0)
         f_cur = float(f)  # exact value (fg pass) — drives convergence only
-        # margin-space value of the current point: same drift frame as the
-        # trials (one extra cheap margin-only stream per iteration)
-        f_cur_m = phi(mw_h, mp_h, 0.0) + 0.5 * l2f * c0
         accepted = False
         for _ in range(config.max_line_search_steps):
-            f_try = (phi(mw_h, mp_h, alpha)
-                     + 0.5 * l2f * (c0 + 2.0 * alpha * c1
-                                    + alpha * alpha * c2))
-            if f_try <= f_cur_m + 1e-4 * alpha * dg and np.isfinite(f_try):
+            # delta-space Armijo: improvement vs 0, accurate at any |f|
+            # (and drift-consistent — both sides live on the cached mw)
+            delta = (phi_delta(mw_h, mp_h, alpha)
+                     + l2f * (alpha * c1 + 0.5 * alpha * alpha * c2))
+            if delta <= 1e-4 * alpha * dg and np.isfinite(delta):
                 accepted = True
                 break
             alpha *= 0.5
