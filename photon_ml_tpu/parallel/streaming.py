@@ -258,8 +258,24 @@ def streaming_coefficient_variances(
     (the in-memory ``GLMObjective.coefficient_variances``, chunked). The
     data term accumulates per chunk (l2=0 adds nothing); the regularization
     diagonal is added once at the end."""
-    sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
+    diag = streaming_hessian_diagonal(objective, chunks, dim, w, l2,
+                                      dtype, mesh, axis)
+    return 1.0 / jnp.maximum(diag, jnp.finfo(dtype).tiny)
 
+
+def streaming_hessian_diagonal(
+    objective: GLMObjective,
+    chunks: Sequence[HostChunk],
+    dim: int,
+    w: jax.Array,
+    l2=0.0,
+    dtype=jnp.float32,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+) -> jax.Array:
+    """Exact Hessian diagonal over one streamed (Kahan-compensated) pass —
+    shared by coefficient variances and TRON's Jacobi preconditioner."""
+    sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
     chunk_diag = cached_jit(
         objective, ("stream_diag", mesh, axis),
         lambda: lambda w, batch, acc, comp: _kahan_add(
@@ -274,8 +290,7 @@ def streaming_coefficient_variances(
     reg = jnp.full((dim,), jnp.asarray(l2, dtype))
     if not objective.regularize_intercept and objective.intercept_index >= 0:
         reg = reg.at[objective.intercept_index].set(0.0)
-    diag = acc + reg
-    return 1.0 / jnp.maximum(diag, jnp.finfo(dtype).tiny)
+    return acc + reg
 
 
 def fit_streaming(
@@ -603,22 +618,28 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
     max_cg = max(dim, 20)
     eps = float(jnp.finfo(dtype).eps)
 
-    def cg(wc, g, delta, cg_tol):
-        """Steihaug CG; each hvp call is a full streamed pass."""
+    def cg(wc, g, delta, cg_tol, m_diag):
+        """Jacobi-preconditioned Steihaug CG; each hvp call is a full
+        streamed pass, so the preconditioner (one extra streamed diag
+        pass per OUTER iteration) buys the expensive thing: fewer inner
+        passes. Trust region measured in the M-norm (mirrors
+        optimize.tron)."""
+        minv = 1.0 / m_diag
+        mdot = lambda a, b: float(jnp.sum(a * m_diag * b))
         s = jnp.zeros_like(g)
         r = -g
-        d = r
-        rr = float(jnp.sum(r * r))
+        d = minv * r
+        rz = float(jnp.sum(r * d))
         for _ in range(max_cg):
             Hd = hvp(wc, d, l2)
             dHd = float(jnp.sum(d * Hd))
             neg_curv = dHd <= 0
-            alpha = rr / (1.0 if neg_curv else dHd)
-            outside = float(jnp.linalg.norm(s + alpha * d)) >= delta
+            alpha = rz / (1.0 if neg_curv else dHd)
+            outside = np.sqrt(mdot(s + alpha * d, s + alpha * d)) >= delta
             if neg_curv or outside:
-                sd = float(jnp.sum(s * d))
-                dd = float(jnp.sum(d * d))
-                ss = float(jnp.sum(s * s))
+                sd = mdot(s, d)
+                dd = mdot(d, d)
+                ss = mdot(s, s)
                 disc = np.sqrt(max(sd * sd + dd * (delta * delta - ss), 0.0))
                 tau = (-sd + disc) / max(dd, eps)
                 s = s + tau * d
@@ -626,11 +647,12 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
                 break
             s = s + alpha * d
             r = r - alpha * Hd
-            rr_new = float(jnp.sum(r * r))
-            if np.sqrt(rr_new) <= cg_tol:
+            if float(jnp.linalg.norm(r)) <= cg_tol:
                 break
-            d = r + (rr_new / max(rr, eps)) * d
-            rr = rr_new
+            z = minv * r
+            rz_new = float(jnp.sum(r * z))
+            d = z + (rz_new / max(rz, eps)) * d
+            rz = rz_new
         return s, r
 
     f, g = fg(w, l2)
@@ -642,16 +664,24 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
     gnorm_hist = np.full((config.max_iters,), np.nan)
     it = 0
     converged = False
+    m_diag = None
     for it in range(config.max_iters):
         gnorm = float(jnp.linalg.norm(g))
-        step, r = cg(w, g, delta, 0.1 * gnorm)
+        if m_diag is None:  # recomputed only after an ACCEPTED step
+            md = streaming_hessian_diagonal(objective, chunks, dim, w, l2,
+                                            dtype, mesh, axis)
+            # same relative positivity floor as optimize.tron
+            m_diag = jnp.maximum(md, eps * jnp.maximum(float(jnp.max(md)),
+                                                       1.0))
+        step, r = cg(w, g, delta, 0.1 * gnorm, m_diag)
         w_try = w + step
         f_try_j, g_try = fg(w_try, l2)
         f_try = float(f_try_j)
         gs = float(jnp.sum(g * step))
         prered = 0.5 * (float(jnp.sum(step * r)) - gs)
         actred = f - f_try
-        snorm = float(jnp.linalg.norm(step))
+        # radius lives in the CG's M-norm
+        snorm = float(jnp.sqrt(jnp.sum(step * m_diag * step)))
 
         denom = f_try - f - gs
         alpha = _SIGMA3 if denom <= 0 else max(_SIGMA1, -0.5 * (gs / denom))
@@ -666,6 +696,7 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
 
         accept = actred > _ETA0 * prered
         if accept:
+            m_diag = None  # w moved: the cached diagonal is stale
             prev_f = f
             w, f, g = w_try, f_try, g_try
             gnorm = float(jnp.linalg.norm(g))
