@@ -656,13 +656,15 @@ def _fit_distributed_csc(
                            l1_mask=l1_mask)
 
         elif optimizer == "tron":
+            diag = distributed_diagonal_hessian(objective, mesh, axis)
 
             @jax.jit
             def run(w0, b, l2v, csc):
                 if csc is None:
                     csc = build(b)
                 return opt(lambda w: fg(w, b, csc, l2v), w0, config,
-                           hvp=lambda w, v: hvp(w, v, b, csc, l2v))
+                           hvp=lambda w, v: hvp(w, v, b, csc, l2v),
+                           precond=lambda w: diag(w, b, l2v))
 
         else:
 
