@@ -9,11 +9,12 @@ on-device fused pass + psum).
 
 Metric: example-passes/second = rows x optimizer-iterations / wall-clock of
 the jitted fit (compile time excluded; one warm-up fit on identical shapes
-precedes the timed run). ``vs_baseline`` is the ratio against the newest
-prior-round recording with value > 0 (``BENCH_r*.json``); BASELINE.json has
-``"published": {}`` (no repo-published reference numbers — see BASELINE.md),
-so our own prior round is the baseline. With no prior recording the ratio
-is 1.0.
+precedes the timed run). ``vs_baseline`` is the ratio against the honest
+comparator in ``BENCH_BASELINE.json`` (the r03-v1 hardware lower bound;
+BENCH_r02.json's 17.77M is a documented measurement artifact — see
+docs/PERF.md and ``_baseline``); the comparator's label is embedded in the
+unit string. BASELINE.json has ``"published": {}`` (no repo-published
+reference numbers — see BASELINE.md). With no comparator the ratio is 1.0.
 
 Also reported (stderr + unit string): a model-FLOPs throughput and an
 effective-HBM-bandwidth estimate. The workload is memory-bound, so the
@@ -81,10 +82,16 @@ def _tpu_reachable(probe_timeout_s: float = 90.0) -> bool:
 def main() -> None:
     _arm_watchdog()
     fallback = ""
-    # Only probe-and-fall-back when the platform is UNPINNED: an explicit
-    # JAX_PLATFORMS (cpu for CI smoke, tpu/axon for fail-fast hardware
-    # runs) is honored as given.
-    if not os.environ.get("JAX_PLATFORMS") and not _tpu_reachable():
+    # Probe-and-fall-back unless the caller pinned CPU (CI smoke) or set
+    # BENCH_REQUIRE_TPU=1 (fail-fast hardware runs that must never emit a
+    # CPU number). Round 1 and round 3 both recorded value-0 TIMEOUTs
+    # because this environment sets JAX_PLATFORMS=axon ambiently and the
+    # old "honor an explicit JAX_PLATFORMS" rule skipped the probe — the
+    # main process then wedged inside the axon plugin's retry loop with no
+    # way to reach the CPU path.
+    pinned = os.environ.get("JAX_PLATFORMS", "")
+    require_tpu = os.environ.get("BENCH_REQUIRE_TPU") == "1"
+    if pinned != "cpu" and not require_tpu and not _tpu_reachable():
         os.environ["JAX_PLATFORMS"] = "cpu"
         fallback = "; TPU-unreachable CPU FALLBACK, not comparable to TPU rounds"
         print("TPU tunnel unreachable -> CPU fallback measurement",
@@ -108,6 +115,13 @@ def main() -> None:
     from photon_ml_tpu.types import LabeledBatch, SparseFeatures
 
     platform = jax.devices()[0].platform
+    if require_tpu and platform == "cpu":
+        # the axon backend can fast-fail and silently leave CPU as the
+        # first platform; a fail-fast hardware run must die loudly rather
+        # than publish a CPU number against the TPU baseline
+        print("BENCH_REQUIRE_TPU=1 but only CPU initialized — aborting",
+              file=sys.stderr)
+        sys.exit(3)
     # Criteo shape: 39 features/row. Sized to finish the timed fit in
     # seconds; CPU fallback keeps CI/driver runs fast.
     if platform == "cpu":
@@ -254,27 +268,55 @@ def main() -> None:
             f"~{bytes_touched/1e9:.3g} GB/s HBM ({bw_frac:.3g} of peak)")
     print(f"utilization: {util}", file=sys.stderr)
 
+    base = _baseline()
+    vs = round(value / base[0], 4) if base else 1.0
+    base_note = f"; vs_baseline vs {base[1]}" if base else ""
     print(json.dumps({
         "metric": "criteo_shaped_logreg_lbfgs_example_passes_per_sec",
         "value": round(value, 1),
         "unit": f"example-passes/sec ({platform}, {len(jax.devices())} dev, "
                 f"n={n_rows}, d={dim}, k={k}, iters={done}, "
-                f"sparse_grad={mode}; {util}{fallback})",
-        "vs_baseline": _vs_baseline(value),
+                f"sparse_grad={mode}; {util}{base_note}{fallback})",
+        "vs_baseline": vs,
     }))
 
 
-def _vs_baseline(value: float) -> float:
-    """Ratio against the newest prior recorded round with a real (> 0)
-    measurement; 1.0 when none exists (BASELINE.json published: {})."""
+def _baseline() -> "tuple[float, str] | None":
+    """The honest comparator for ``vs_baseline``.
+
+    Preferred: the explicit record in ``BENCH_BASELINE.json`` — written
+    because the mechanical "newest prior round > 0" rule resolves to
+    BENCH_r02.json's 17.77M passes/s, which docs/PERF.md documents as a
+    measurement artifact (per-call recompile + memoized warm-up==timed
+    execution on the axon backend); dividing an honest number by an
+    artifact would misbrand it a regression (VERDICT r3 weak #3).
+    Fallback: the newest prior BENCH_r*.json with value > 0 that is not
+    listed in the baseline file's ``artifact_rounds``."""
     import glob
     import re
 
-    best = None
     here = os.path.dirname(os.path.abspath(__file__))
+    artifact_rounds: set = set()
+    base_path = os.path.join(here, "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        try:
+            with open(base_path) as f:
+                base = json.load(f)
+            artifact_rounds = set(base.get("artifact_rounds", []))
+            if float(base.get("value", 0.0)) > 0:
+                return float(base["value"]), str(base.get("label", "pinned"))
+        except Exception as e:
+            # a malformed pin must NOT silently fall back to scanning with
+            # an empty artifact list — that would resurrect the r02
+            # artifact as comparator, the exact misbranding this file
+            # exists to prevent
+            print(f"BENCH_BASELINE.json unreadable ({e}); vs_baseline "
+                  "reported as 1.0 (no comparator)", file=sys.stderr)
+            return None
+    best = None
     for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
-        if not m:
+        if not m or int(m.group(1)) in artifact_rounds:
             continue
         try:
             with open(path) as f:
@@ -285,8 +327,8 @@ def _vs_baseline(value: float) -> float:
         if prior > 0:
             best = (int(m.group(1)), prior)
     if best is None:
-        return 1.0
-    return round(value / best[1], 4)
+        return None
+    return best[1], f"BENCH_r{best[0]:02d}"
 
 
 if __name__ == "__main__":

@@ -153,11 +153,15 @@ def _newton_dense_solver(local_dim: int, task: str,
                               W - a_sel[:, None] * step, W)
             gnorm = jnp.linalg.norm(g, axis=1)
             # converged_check semantics, batched: |f_prev - f| <= tol *
-            # max(|f_prev|, 1) OR gnorm <= tol * max(||g0||, 1); a stalled
-            # entity (no halving level decreases f) is NOT converged
+            # max(|f_prev|, 1) OR gnorm <= tol * max(||g0||, 1). The
+            # relative-loss half needs an accepted step (a rejected step's
+            # zero delta would pass spuriously), but the gradient half
+            # fires regardless: step-halving failing AT the optimum (fp
+            # noise, singular-H NaN step) is convergence, not a stall —
+            # same policy as the L-BFGS paths.
             delta = jnp.abs(f - f_new)
-            conv = active & any_ok & (eff_tol > 0) & (
-                (delta <= eff_tol * jnp.maximum(jnp.abs(f), 1.0))
+            conv = active & (eff_tol > 0) & (
+                (any_ok & (delta <= eff_tol * jnp.maximum(jnp.abs(f), 1.0)))
                 | (gnorm <= eff_tol * jnp.maximum(g0n, 1.0)))
             iters_new = iters + active.astype(iters.dtype)
             active_new = active & ~conv & any_ok & (iters_new < max_iters)

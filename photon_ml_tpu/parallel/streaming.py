@@ -35,6 +35,7 @@ from photon_ml_tpu.parallel.data_parallel import cached_jit
 from photon_ml_tpu.optimize.common import OptimizationResult, OptimizerConfig
 from photon_ml_tpu.optimize.lbfgs import two_loop_direction
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+from photon_ml_tpu.utils import transfer_budget
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,7 +131,12 @@ def _cross_process_sum(tree):
 
 
 def _chunk_to_device(chunk: HostChunk, dim: int, dtype, sharding) -> LabeledBatch:
-    put = (lambda a: jax.device_put(a, sharding)) if sharding else jax.device_put
+    # every streamed upload is budget-accounted (utils.transfer_budget):
+    # chunk-sized pieces are tunnel-safe, but a session budget catches a
+    # misconfigured chunk_rows before it can wedge the TPU worker. The
+    # .astype happens first so the charged bytes are the bytes moved.
+    def put(a):
+        return transfer_budget.device_put(a, sharding, what="stream chunk")
     return LabeledBatch(
         SparseFeatures(put(chunk.indices.astype(np.int32)),
                        (None if chunk.values is None
@@ -140,6 +146,20 @@ def _chunk_to_device(chunk: HostChunk, dim: int, dtype, sharding) -> LabeledBatc
         put(chunk.weights.astype(dtype)),
     )
 
+
+
+def _host_tol(tolerance, dtype) -> float:
+    """Mirror :func:`optimize.common.converged_check` tolerance semantics
+    for the streamed HOST loops: an explicit tol <= 0 disables the
+    convergence tests entirely (exact iteration counts — bench determinism),
+    while a positive tol is clamped to a few ulps of the working dtype so an
+    f64-tuned tolerance still terminates in f32. Round 3 clamped
+    ``max(tol, eps)`` unconditionally, silently re-enabling the tests that
+    ``tolerance=0`` callers (scripts/bench_streaming.py) rely on being off."""
+    t = float(np.asarray(tolerance))
+    if t <= 0:
+        return 0.0
+    return max(t, 4 * float(jnp.finfo(dtype).eps))
 
 
 def _kahan_add(acc, comp, x):
@@ -305,8 +325,17 @@ def fit_streaming(
     axis: str = "data",
     optimizer: str = "lbfgs",
     l1=0.0,
+    progress_callback: Optional[Callable] = None,
 ) -> OptimizationResult:
     """Streamed (larger-than-HBM) full-batch fit.
+
+    ``progress_callback(iteration, w)``, when given, fires after every
+    outer iteration that produced a new point, with the 0-based loop
+    index and the point — measurement harnesses use it for per-iteration
+    progress logging and host-side checkpoints so a tunnel stall loses
+    an iteration, not the run (VERDICT r3 #5). Iterations whose line
+    search fails (history-reset retries) are counted in ``iterations``
+    but fire no callback, so indices can skip.
 
     ``optimizer``: "lbfgs" (default — margin-space line search: trials
     stream cached margin vectors instead of paying a sparse pass each,
@@ -324,13 +353,15 @@ def fit_streaming(
         optimizer = "owlqn"
     if optimizer == "tron":
         return _fit_streaming_tron(objective, chunks, dim, w0, l2, config,
-                                   dtype, mesh, axis)
+                                   dtype, mesh, axis, progress_callback)
     if optimizer == "owlqn":
         return _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1,
-                                    config, dtype, mesh, axis)
+                                    config, dtype, mesh, axis,
+                                    progress_callback)
     if optimizer == "lbfgs":
         return _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2,
-                                           config, dtype, mesh, axis)
+                                           config, dtype, mesh, axis,
+                                           progress_callback)
     if optimizer != "lbfgs_blackbox":
         raise ValueError(f"unknown streaming optimizer '{optimizer}'")
     m = config.history
@@ -348,7 +379,7 @@ def fit_streaming(
     rho = jnp.zeros((m,), dtype)
     k = 0
     eps = float(jnp.finfo(dtype).eps)
-    tol = max(config.tolerance, eps)
+    tol = _host_tol(config.tolerance, dtype)
     loss_hist = np.full((config.max_iters,), np.nan)
     gnorm_hist = np.full((config.max_iters,), np.nan)
 
@@ -373,6 +404,25 @@ def fit_streaming(
                 break
             alpha *= 0.5
         if not accepted:
+            # mirror optimize/lbfgs.py: failing AT the optimum is
+            # convergence, not a stall — and with a stale f32 metric a
+            # history reset + steepest-descent retry often buys more
+            # productive iterations before giving up. The attempted
+            # iteration is counted and recorded (f unchanged), matching
+            # the in-memory loop's unconditional it+1.
+            gnorm = float(jnp.linalg.norm(g))
+            loss_hist[it] = float(f)
+            gnorm_hist[it] = gnorm
+            if tol > 0 and gnorm <= tol * max(g0_norm, 1.0):
+                converged = True
+                it += 1
+                break
+            if k > 0:
+                s_hist = jnp.zeros((m, dim), dtype)
+                y_hist = jnp.zeros((m, dim), dtype)
+                rho = jnp.zeros((m,), dtype)
+                k = 0
+                continue
             break
         step = w_try - w
         yv = g_try - g
@@ -387,8 +437,10 @@ def fit_streaming(
         gnorm = float(jnp.linalg.norm(g))
         loss_hist[it] = float(f)
         gnorm_hist[it] = gnorm
-        rel = abs(f_cur - float(f)) / max(abs(f_cur), eps)
-        if rel < tol or gnorm < tol * max(g0_norm, eps):
+        if progress_callback is not None:
+            progress_callback(it, w)
+        rel = abs(f_cur - float(f)) / max(abs(f_cur), 1.0)
+        if tol > 0 and (rel <= tol or gnorm <= tol * max(g0_norm, 1.0)):
             converged = True
             it += 1
             break
@@ -426,7 +478,8 @@ def _lbfgs_stream_kernels(objective, mesh, axis, m):
 
 
 def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
-                                dtype, mesh, axis) -> OptimizationResult:
+                                dtype, mesh, axis,
+                                progress_callback=None) -> OptimizationResult:
     """Streamed L-BFGS with margin-space line search (the default).
 
     The black-box streamed loop pays one FULL sparse pass (index gather +
@@ -486,6 +539,10 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
                          _make_trial)
 
     def _put(a):
+        if isinstance(a, np.ndarray):
+            # charge the bytes actually moved (post-cast width)
+            transfer_budget.charge(
+                a.size * jnp.dtype(dtype).itemsize, "margin trial chunk")
         dev = jnp.asarray(a, dtype)
         return jax.device_put(dev, sharding) if sharding else dev
 
@@ -528,7 +585,7 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
     rho = jnp.zeros((m,), dtype)
     k = 0
     eps = float(jnp.finfo(dtype).eps)
-    tol = max(config.tolerance, eps)
+    tol = _host_tol(config.tolerance, dtype)
     loss_hist = np.full((config.max_iters,), np.nan)
     gnorm_hist = np.full((config.max_iters,), np.nan)
 
@@ -564,6 +621,25 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
                 break
             alpha *= 0.5
         if not accepted:
+            # mirror optimize/lbfgs_margin.py: a search failing AT the
+            # optimum is convergence, not a stall; otherwise reset the
+            # (stale-in-f32) history and retry once from steepest descent
+            # before reporting not-converged. The attempted iteration is
+            # counted and recorded (f unchanged), matching the in-memory
+            # loop's unconditional it+1.
+            gnorm = float(jnp.linalg.norm(g))
+            loss_hist[it] = float(f)
+            gnorm_hist[it] = gnorm
+            if tol > 0 and gnorm <= tol * max(g0_norm, 1.0):
+                converged = True
+                it += 1
+                break
+            if k > 0:
+                s_hist = jnp.zeros((m, dim), dtype)
+                y_hist = jnp.zeros((m, dim), dtype)
+                rho = jnp.zeros((m,), dtype)
+                k = 0
+                continue
             break
         w_try = w + jnp.asarray(alpha, dtype) * p
         # accepted point: ONE gather+transpose pass for the exact (f, g)
@@ -583,8 +659,10 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         gnorm = float(jnp.linalg.norm(g))
         loss_hist[it] = float(f)
         gnorm_hist[it] = gnorm
-        rel = abs(f_cur - float(f)) / max(abs(f_cur), eps)
-        if rel < tol or gnorm < tol * max(g0_norm, eps):
+        if progress_callback is not None:
+            progress_callback(it, w)
+        rel = abs(f_cur - float(f)) / max(abs(f_cur), 1.0)
+        if tol > 0 and (rel <= tol or gnorm <= tol * max(g0_norm, 1.0)):
             converged = True
             it += 1
             break
@@ -605,7 +683,7 @@ _SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
 
 
 def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
-                        axis) -> OptimizationResult:
+                        axis, progress_callback=None) -> OptimizationResult:
     """Host-loop TRON mirroring ``optimize.tron``: Steihaug CG inner loop
     where every Hessian-vector product is one streamed pass over the data —
     the reference's one-treeAggregate-per-CG-step cost model (SURVEY.md
@@ -659,7 +737,7 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
     f = float(f)
     g0_norm = float(jnp.linalg.norm(g))
     delta = g0_norm
-    tol = max(config.tolerance, eps)
+    tol = _host_tol(config.tolerance, dtype)
     loss_hist = np.full((config.max_iters,), np.nan)
     gnorm_hist = np.full((config.max_iters,), np.nan)
     it = 0
@@ -700,11 +778,13 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
             prev_f = f
             w, f, g = w_try, f_try, g_try
             gnorm = float(jnp.linalg.norm(g))
-            rel = abs(prev_f - f) / max(abs(prev_f), eps)
-            if rel < tol or gnorm < tol * max(g0_norm, eps):
+            rel = abs(prev_f - f) / max(abs(prev_f), 1.0)
+            if tol > 0 and (rel <= tol or gnorm <= tol * max(g0_norm, 1.0)):
                 converged = True
         loss_hist[it] = f
         gnorm_hist[it] = gnorm
+        if progress_callback is not None:
+            progress_callback(it, w)
         if prered <= eps * max(abs(f), 1.0):  # model predicts no gain left
             converged = True
         if converged or delta < eps * max(float(jnp.linalg.norm(w)), 1.0):
@@ -722,7 +802,8 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
 
 
 def _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1, config, dtype,
-                         mesh, axis) -> OptimizationResult:
+                         mesh, axis, progress_callback=None
+                         ) -> OptimizationResult:
     """Host-loop OWL-QN mirroring ``optimize.owlqn`` (Andrew & Gao 2007):
     pseudo-gradient from the streamed smooth gradient, L-BFGS direction on
     device, orthant projection of direction and iterates; every line-search
@@ -759,7 +840,7 @@ def _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1, config, dtype,
     pg = pseudo_gradient(w, g, lam)
     pg0_norm = float(jnp.linalg.norm(pg))
     eps = float(jnp.finfo(dtype).eps)
-    tol = max(config.tolerance, eps)
+    tol = _host_tol(config.tolerance, dtype)
     s_hist = jnp.zeros((m, dim), dtype)
     y_hist = jnp.zeros((m, dim), dtype)
     rho = jnp.zeros((m,), dtype)
@@ -802,8 +883,10 @@ def _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1, config, dtype,
         pg_norm = float(jnp.linalg.norm(pseudo_gradient(w, g, lam)))
         loss_hist[it] = F
         gnorm_hist[it] = pg_norm
-        rel = abs(F_prev - F) / max(abs(F_prev), eps)
-        if rel < tol or pg_norm < tol * max(pg0_norm, eps):
+        if progress_callback is not None:
+            progress_callback(it, w)
+        rel = abs(F_prev - F) / max(abs(F_prev), 1.0)
+        if tol > 0 and (rel <= tol or pg_norm <= tol * max(pg0_norm, 1.0)):
             converged = True
             it += 1
             break

@@ -1,0 +1,137 @@
+"""Host->device transfer budget for hardware measurement sessions.
+
+The axon TPU tunnel in this environment wedges — and has twice crashed the
+TPU worker — on bulk host->device transfers (r03: one ~800MB upload at
+04:57 cost the round its chip; see docs/PERF.md "Measuring through the
+axon tunnel"). The protection is structural, not procedural: every
+sanctioned upload in the measurement harnesses is routed through
+:func:`charge` / :func:`device_put`, and a session-configured budget makes
+an oversized transfer raise *on the host, before any bytes move*, instead
+of killing the worker.
+
+Two limits, both in bytes:
+
+- ``single``: the per-transfer cap (default 64 MB). This is the actual
+  wedge vector — one huge contiguous upload. Chunked uploads of the same
+  total are fine (~10MB pieces demonstrably safe on the tunnel).
+- ``total``: the per-process cap (default 256 MB). Streaming benches that
+  legitimately move more declare it via :func:`waive` / a larger env
+  budget, so the waiver is visible in the harness source.
+
+Activation: explicitly via :func:`set_budget`, or ambiently via the
+``PHOTON_TRANSFER_BUDGET_MB`` / ``PHOTON_TRANSFER_SINGLE_MB`` env vars
+(read at first use — the session runner sets them per experiment). With
+no budget configured every charge is a no-op, so library users outside
+measurement sessions never see this module.
+
+Design note: JAX's own ``jax_transfer_guard`` is not used — on the CPU
+backend host->device "transfers" are zero-copy and never fire the guard,
+which would make the mandated CPU dry-run of the session vacuous, and on
+any backend it cannot distinguish a sanctioned chunked upload from the
+800MB mistake. Byte accounting at the call sites is deterministic and
+dry-testable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+__all__ = [
+    "TransferBudgetExceeded", "set_budget", "get_budget", "charge",
+    "device_put", "waive",
+]
+
+
+class TransferBudgetExceeded(RuntimeError):
+    """A sanctioned upload would exceed the session's transfer budget."""
+
+
+class _Budget:
+    def __init__(self, total: float, single: float, label: str = ""):
+        self.total = float(total)
+        self.single = float(single)
+        self.label = label
+        self.spent = 0.0
+        self._lock = threading.Lock()
+
+    def charge(self, nbytes: int, what: str = "") -> None:
+        nbytes = int(nbytes)
+        if nbytes > self.single:
+            raise TransferBudgetExceeded(
+                f"single host->device transfer of {nbytes/1e6:.1f} MB "
+                f"exceeds the per-transfer cap {self.single/1e6:.1f} MB"
+                f"{' [' + what + ']' if what else ''} — chunk it (~10MB "
+                "pieces are tunnel-safe); bulk uploads have crashed the "
+                "TPU worker (docs/PERF.md)")
+        with self._lock:
+            if self.spent + nbytes > self.total:
+                raise TransferBudgetExceeded(
+                    f"transfer of {nbytes/1e6:.1f} MB would take this "
+                    f"process to {(self.spent + nbytes)/1e6:.1f} MB, over "
+                    f"the {self.total/1e6:.1f} MB budget"
+                    f"{' [' + what + ']' if what else ''} — synthesize on "
+                    "device, or waive explicitly (transfer_budget.waive / "
+                    "PHOTON_TRANSFER_BUDGET_MB) if this experiment is "
+                    "meant to move bulk data")
+            self.spent += nbytes
+
+
+_budget: Optional[_Budget] = None
+_initialized = False
+
+
+def _ambient() -> Optional[_Budget]:
+    """Budget from the environment, if the session runner set one."""
+    mb = os.environ.get("PHOTON_TRANSFER_BUDGET_MB")
+    if not mb:
+        return None
+    single = float(os.environ.get("PHOTON_TRANSFER_SINGLE_MB", "64"))
+    return _Budget(float(mb) * 1e6, single * 1e6, label="env")
+
+
+def set_budget(total_mb: Optional[float], single_mb: float = 64.0,
+               label: str = "") -> None:
+    """Install (or with ``None`` clear) the process transfer budget."""
+    global _budget, _initialized
+    _initialized = True
+    _budget = (None if total_mb is None
+               else _Budget(total_mb * 1e6, single_mb * 1e6, label))
+
+
+def get_budget() -> Optional[_Budget]:
+    global _budget, _initialized
+    if not _initialized:
+        _initialized = True
+        _budget = _ambient()
+    return _budget
+
+
+def waive(extra_total_mb: float, reason: str) -> None:
+    """Raise the total cap for an experiment that legitimately moves bulk
+    data (e.g. a streaming bench). The reason is mandatory so the waiver
+    is auditable at the call site; the per-transfer cap stays."""
+    b = get_budget()
+    if b is not None:
+        assert reason, "a transfer-budget waiver needs a reason"
+        with b._lock:
+            b.total += extra_total_mb * 1e6
+
+
+def charge(nbytes: int, what: str = "") -> None:
+    """Account ``nbytes`` of imminent host->device transfer against the
+    budget (no-op when none is configured). Call BEFORE the upload."""
+    b = get_budget()
+    if b is not None and nbytes:
+        b.charge(nbytes, what)
+
+
+def device_put(x, sharding=None, what: str = ""):
+    """Budget-accounted ``jax.device_put`` for host (numpy) arrays."""
+    import jax
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        charge(x.nbytes, what or "device_put")
+    return jax.device_put(x, sharding)

@@ -399,3 +399,68 @@ def test_streamed_margin_vs_blackbox_lbfgs(rng):
                                rtol=1e-6, atol=1e-9)
     assert abs(float(r_m.value) - float(r_b.value)) < 1e-8 * abs(
         float(r_b.value))
+
+
+def test_streamed_tolerance_zero_disables_convergence_tests(sparse_problem):
+    """ADVICE r3: an explicit tolerance=0 must disable the convergence
+    tests in the streamed HOST loops (converged_check semantics) — the
+    loop runs past the point a positive tolerance stops at, ending only
+    on max_iters or genuine line-search exhaustion. Round 3 clamped tol
+    to eps unconditionally, silently re-enabling the relative-loss test."""
+    X, y, offsets, weights = sparse_problem
+    chunks, dim = make_host_chunks(
+        HostSparse(np.asarray(sparse_from_scipy(X).indices),
+                   np.asarray(sparse_from_scipy(X).values), X.shape[1]),
+        y, offsets, weights, chunk_rows=256,
+    )
+    obj = make_objective("logistic")
+    for optimizer in ("lbfgs", "lbfgs_blackbox"):
+        cfg_tol = OptimizerConfig(max_iters=40, tolerance=1e-6)
+        with_tol = fit_streaming(obj, chunks, dim, l2=1.0,
+                                 optimizer=optimizer, dtype=jnp.float64,
+                                 config=cfg_tol)
+        assert bool(with_tol.converged), optimizer
+        cfg_zero = OptimizerConfig(max_iters=40, tolerance=0.0)
+        no_tol = fit_streaming(obj, chunks, dim, l2=1.0,
+                               optimizer=optimizer, dtype=jnp.float64,
+                               config=cfg_zero)
+        assert not bool(no_tol.converged), optimizer
+        assert int(no_tol.iterations) > int(with_tol.iterations), optimizer
+
+
+def test_streamed_margin_converges_at_optimum_on_ls_failure(sparse_problem):
+    """ADVICE r3: a streamed fit warm-started AT its optimum whose line
+    search can make no progress must report converged (gradient test),
+    not a silent not-converged break — mirroring optimize/lbfgs_margin."""
+    X, y, offsets, weights = sparse_problem
+    chunks, dim = make_host_chunks(
+        HostSparse(np.asarray(sparse_from_scipy(X).indices),
+                   np.asarray(sparse_from_scipy(X).values), X.shape[1]),
+        y, offsets, weights, chunk_rows=256,
+    )
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-6)
+    first = fit_streaming(obj, chunks, dim, l2=1.0, config=cfg,
+                          dtype=jnp.float64)
+    assert bool(first.converged)
+    again = fit_streaming(obj, chunks, dim, w0=first.w, l2=1.0, config=cfg,
+                          dtype=jnp.float64)
+    assert bool(again.converged)
+    assert int(again.iterations) <= 3
+
+
+def test_streamed_progress_callback_fires_per_iteration(sparse_problem):
+    X, y, offsets, weights = sparse_problem
+    chunks, dim = make_host_chunks(
+        HostSparse(np.asarray(sparse_from_scipy(X).indices),
+                   np.asarray(sparse_from_scipy(X).values), X.shape[1]),
+        y, offsets, weights, chunk_rows=256,
+    )
+    obj = make_objective("logistic")
+    seen = []
+    res = fit_streaming(
+        obj, chunks, dim, l2=1.0,
+        config=OptimizerConfig(max_iters=5, tolerance=0.0),
+        progress_callback=lambda it, w: seen.append((it, np.asarray(w))))
+    assert [it for it, _ in seen] == list(range(int(res.iterations)))
+    np.testing.assert_array_equal(seen[-1][1], np.asarray(res.w))
