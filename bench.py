@@ -101,11 +101,9 @@ def main() -> None:
     # The axon sitecustomize force-sets jax_platforms=axon,cpu at interpreter
     # startup, overriding the JAX_PLATFORMS env var; honor the env var again
     # so CPU runs don't try to initialize the TPU tunnel.
-    if os.environ.get("JAX_PLATFORMS"):
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except RuntimeError:
-            pass
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
     import jax.numpy as jnp
 
     from photon_ml_tpu.ops.objective import make_objective

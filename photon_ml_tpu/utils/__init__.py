@@ -1,3 +1,3 @@
-from photon_ml_tpu.utils.config import resolve_dtype
+from photon_ml_tpu.utils.config import apply_env_platforms, resolve_dtype
 from photon_ml_tpu.utils.logging import PhotonLogger, Timed
 from photon_ml_tpu.utils.tracing import annotate, profile_trace

@@ -2,6 +2,28 @@
 
 from __future__ import annotations
 
+import os
+
+
+def apply_env_platforms() -> None:
+    """Re-apply an explicit ``JAX_PLATFORMS`` env var over whatever a
+    sitecustomize pinned at interpreter startup.
+
+    This container's axon sitecustomize force-sets
+    ``jax_platforms=axon,cpu`` before any user code runs, which silently
+    overrides the env var; a harness told ``JAX_PLATFORMS=cpu`` (CI smoke,
+    the session dry-run) would otherwise hang in the axon plugin's
+    connect-retry loop when the tunnel is wedged. Call right after
+    ``import jax``, before any device use. No-op when the env var is
+    unset or the backend is already initialized."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
+
 
 def resolve_dtype(name: str):
     """Map a ``--dtype`` flag to a jnp dtype, enabling x64 first when needed

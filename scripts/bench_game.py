@@ -43,11 +43,9 @@ def main():
     _arm_watchdog(float(os.environ.get("BENCH_TIMEOUT_S", 1500)))
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except RuntimeError:
-            pass
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
     import jax.numpy as jnp
 
     from photon_ml_tpu.game.data import REBucket, RandomEffectTrainData

@@ -113,12 +113,9 @@ def main() -> int:
     if args.mode == "run":
         import jax
 
-        if os.environ.get("JAX_PLATFORMS"):
-            try:
-                jax.config.update("jax_platforms",
-                                  os.environ["JAX_PLATFORMS"])
-            except RuntimeError:
-                pass
+        from photon_ml_tpu.utils import apply_env_platforms
+
+        apply_env_platforms()
         if args.dtype == "float64":
             jax.config.update("jax_enable_x64", True)
         print(json.dumps(_run_leg(args.dtype)))

@@ -48,11 +48,9 @@ def bench(fn, warmup=2, reps=5):
 
 
 def main():
-    if os.environ.get("JAX_PLATFORMS"):
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except RuntimeError:
-            pass
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
     platform = jax.devices()[0].platform
     if platform == "cpu":
         n, d, k = 1 << 15, 1 << 14, 39

@@ -51,11 +51,9 @@ def t_fetch(fn, *args, reps=3):
 
 
 def main():
-    if os.environ.get("JAX_PLATFORMS"):
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except RuntimeError:
-            pass
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
     platform = jax.devices()[0].platform
     if platform == "cpu":
         n, d, k, mm = 1 << 14, 1 << 13, 39, 1024

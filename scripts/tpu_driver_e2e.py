@@ -71,6 +71,13 @@ def main():
     args = ap.parse_args()
 
     import jax
+
+    # the axon sitecustomize force-sets jax_platforms=axon,cpu at startup;
+    # honor an explicit JAX_PLATFORMS (the session's CPU dry-run) or this
+    # harness hangs on a wedged tunnel it was told not to use
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
     platform = jax.devices()[0].platform
     print(f"platform={platform} rows={args.rows} users={args.users}",
           flush=True)

@@ -1,0 +1,119 @@
+#!/bin/bash
+# Round-4 hardware measurement session (VERDICT r3 #1/#2): every prepared
+# TPU experiment, ordered SAFEST-FIRST / RISKIEST-LAST, each resumable and
+# transfer-budgeted, so one bad step cannot cost the round its chip again.
+#
+# Structure (vs the r03 session that lost the chip):
+#   - .done markers: a finished experiment is never re-run; a wedged
+#     session re-armed by the autorun probe resumes where it stopped.
+#   - transfer budget env: every sanctioned upload in the harnesses is
+#     byte-accounted (photon_ml_tpu/utils/transfer_budget.py); anything
+#     bulk raises on the HOST instead of crashing the TPU worker.
+#   - inter-experiment probe: if the tunnel died mid-session, stop and
+#     let the autorun re-arm rather than burning timeouts sequentially.
+#   - streaming runs LAST (it wedged the tunnel twice), with stall-exit
+#     + halved-chunk resume handled here.
+#   - results persist immediately: logs + a session summary line per
+#     experiment land in $LOGDIR the moment each run ends.
+#
+# Dry run (mandated by VERDICT r3 #2): SESSION_DRY=1 runs the whole flow
+# on CPU with small shapes; `bash scripts/tpu_r04_session.sh` on hardware.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR=${LOGDIR:-docs/tpu_r04_logs}
+mkdir -p "$LOGDIR"
+SUMMARY="$LOGDIR/session_summary.txt"
+DRY=${SESSION_DRY:-0}
+
+if [ "$DRY" = "1" ]; then
+  export JAX_PLATFORMS=cpu
+  SMALL_ROWS=13; BIG_ROWS=15; E2E_ROWS=4000; E2E_USERS=50
+else
+  SMALL_ROWS=18; BIG_ROWS=21; E2E_ROWS=20000; E2E_USERS=300
+fi
+
+probe() {
+  [ "$DRY" = "1" ] && return 0
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != "cpu"
+x = jnp.ones((128, 128)); float((x @ x)[0, 0])
+EOF
+}
+
+# run NAME TIMEOUT BUDGET_MB -- cmd...  (BUDGET_MB=- disables the env budget;
+# harnesses like bench_streaming then declare their own)
+run() {
+  name=$1; tmo=$2; budget=$3; shift 3
+  if [ -f "$LOGDIR/$name.done" ]; then
+    echo "=== $name: already done, skipping"; return 0
+  fi
+  if ! probe; then
+    echo "=== $name: tunnel dead, stopping session (autorun will resume)"
+    echo "$(date +%H:%M:%S) $name SKIPPED-tunnel-dead" >> "$SUMMARY"
+    exit 9
+  fi
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  if [ "$budget" = "-" ]; then
+    env -u PHOTON_TRANSFER_BUDGET_MB timeout "$tmo" "$@" \
+      > "$LOGDIR/$name.log" 2>&1
+  else
+    PHOTON_TRANSFER_BUDGET_MB=$budget PHOTON_TRANSFER_SINGLE_MB=64 \
+      timeout "$tmo" "$@" > "$LOGDIR/$name.log" 2>&1
+  fi
+  rc=$?
+  tail -5 "$LOGDIR/$name.log"
+  echo "$(date +%H:%M:%S) $name rc=$rc" >> "$SUMMARY"
+  echo "--- $name rc=$rc"
+  [ $rc -eq 0 ] && touch "$LOGDIR/$name.done"
+  return $rc
+}
+
+# --- SAFE TIER: no bulk data, the round's must-have evidence ------------
+# 0. Sync semantics + honest per-op / per-fit timings (first, always)
+run tpu_diag 2400 64 python scripts/tpu_diag.py
+# 1. The headline bench (salted + scalar-fetch-synced, device-synthesized)
+run bench 1800 64 env BENCH_TIMEOUT_S=1700 python bench.py
+# 2. Attribute the utilization gap per op (413-safe since r03)
+run profile 2400 64 python scripts/profile_hot_loop.py
+# 3. f32-vs-f64 parity (tiny data, subprocess per dtype)
+run f32_parity 1500 64 python scripts/f32_parity.py compare
+# 4. GAME / random-effect path (device-synthesized, watchdogged)
+run bench_game 1800 64 python scripts/bench_game.py
+
+# --- RISK TIER: bulk transfers, only after the evidence above is banked -
+# 5. Streamed fit, small shape, with stall-exit + halved-chunk resume
+stream() {
+  name=$1; rows=$2; chunk=$3; tmo=$4
+  [ -f "$LOGDIR/$name.done" ] && { echo "=== $name: done, skip"; return 0; }
+  rm -f /tmp/bench_streaming_ckpt.npz
+  for attempt in 1 2 3; do
+    if ! probe; then
+      echo "$(date +%H:%M:%S) $name SKIPPED-tunnel-dead" >> "$SUMMARY"
+      exit 9
+    fi
+    echo "=== $name (attempt $attempt, chunk_rows=$chunk, $(date +%H:%M:%S))"
+    timeout "$tmo" python scripts/bench_streaming.py \
+      --rows-log2 "$rows" --chunk-rows "$chunk" \
+      --timeout $((tmo - 60)) --stall-timeout 300 \
+      $( [ "$attempt" -gt 1 ] && echo --resume ) \
+      >> "$LOGDIR/$name.log" 2>&1
+    rc=$?
+    tail -3 "$LOGDIR/$name.log"
+    echo "$(date +%H:%M:%S) $name attempt=$attempt chunk=$chunk rc=$rc" >> "$SUMMARY"
+    [ $rc -eq 0 ] && { touch "$LOGDIR/$name.done"; return 0; }
+    [ $rc -ne 3 ] && return $rc      # only the stall exit retries
+    chunk=$((chunk / 2))
+  done
+  return 3
+}
+stream streaming_small "$SMALL_ROWS" 8192 1200
+# 6. End-to-end training+scoring drivers (small Avro dataset)
+run driver_e2e 1800 256 python scripts/tpu_driver_e2e.py \
+  --rows "$E2E_ROWS" --users "$E2E_USERS"
+# 7. Streamed fit at the r02 bench shape — the riskiest experiment in the
+#    repo's history (two tunnel wedges); LAST, after everything is banked
+stream streaming_big "$BIG_ROWS" 32768 2400
+
+echo "session done; logs in $LOGDIR"
+cat "$SUMMARY"
