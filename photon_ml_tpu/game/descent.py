@@ -78,6 +78,9 @@ class CoordinateConfig:
     coordinate_type: str = "fixed"  # "fixed" | "random"
     feature_shard: str = "global"
     entity_column: Optional[str] = None  # required for random
+    # "lbfgs" | "tron" | "owlqn"; random coordinates also accept "newton"
+    # (batched dense IRLS) and "auto" (measured per-platform default —
+    # random_effect.resolve_re_optimizer)
     optimizer: str = "lbfgs"
     max_iters: int = 100
     tolerance: float = 1e-8
@@ -123,10 +126,11 @@ class CoordinateConfig:
             raise ValueError(
                 f"coordinate '{self.name}': streaming applies to fixed "
                 "effects (random-effect data is per-entity bucketed)")
-        if self.optimizer == "newton" and self.coordinate_type != "random":
+        if (self.optimizer in ("newton", "auto")
+                and self.coordinate_type != "random"):
             raise ValueError(
-                f"coordinate '{self.name}': optimizer='newton' is the "
-                "batched dense per-entity solver — random coordinates "
+                f"coordinate '{self.name}': optimizer='{self.optimizer}' "
+                "selects a batched per-entity solver — random coordinates "
                 "only (fixed effects use lbfgs/owlqn/tron)")
         if (self.coordinate_type == "random" and self.normalization is not None
                 and self.projection == "random"):

@@ -322,6 +322,24 @@ def _re_to_model_space(W_opt: np.ndarray, f_loc, s_loc, pos) -> np.ndarray:
     return W
 
 
+# Per-platform random-effect solver default for ``optimizer="auto"``
+# (VERDICT r3 #7). Measured by scripts/bench_game.py: on CPU the vmapped
+# sparse L-BFGS wins (28.4k entities/s vs 16.6k for the batched dense
+# Newton at E=2000, rows/entity=32, d_local=16). The TPU entry is pending
+# the r04 chip session (bench_game times both solvers); until a
+# measurement exists the measured-safe L-BFGS stands everywhere.
+_RE_SOLVER_DEFAULT = {"cpu": "lbfgs"}
+
+
+def resolve_re_optimizer(optimizer: str) -> str:
+    """Resolve ``"auto"`` to the measured per-platform default solver."""
+    if optimizer != "auto":
+        return optimizer
+    import jax
+
+    return _RE_SOLVER_DEFAULT.get(jax.devices()[0].platform, "lbfgs")
+
+
 def train_random_effect(
     data: RandomEffectTrainData,
     offsets: jax.Array,
@@ -345,6 +363,7 @@ def train_random_effect(
     per-entity objective via gathered local factor/shift vectors; incoming
     ``w0`` and returned coefficients stay in raw feature space (conversion
     happens here), so scoring/saving/warm-start paths are unchanged."""
+    optimizer = resolve_re_optimizer(optimizer)
     if np.asarray(l1).item() > 0 and optimizer != "owlqn":
         optimizer = "owlqn"
     offsets = jnp.asarray(offsets, dtype)

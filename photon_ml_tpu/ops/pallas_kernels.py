@@ -60,7 +60,36 @@ def _mps_kernel(v_ref, d_ref, out_ref, tot_ref):
                        preferred_element_type=dtype)  # [rows, 1]
 
     out_ref[:] = lane_cum + row_excl
-    tot_ref[0, 0] = row_excl[rows - 1, 0] + row_tot[rows - 1, 0]
+    # tot_ref is the FULL [n_tiles, 1] totals array in SMEM (Mosaic
+    # requires block shape == array shape for non-(8,128)-divisible
+    # blocks; a (1,1) block per grid step fails to lower); each grid step
+    # writes its own slot
+    tot_ref[pl.program_id(0), 0] = (row_excl[rows - 1, 0]
+                                    + row_tot[rows - 1, 0])
+
+
+def _mps_call(v, d, n_tiles, block_rows, interpret):
+    # under shard_map (manual mode) the output varies over the same mesh
+    # axes as the inputs; plumb the vma through or check_vma rejects the call
+    vma = frozenset(getattr(jax.typeof(v), "vma", frozenset()))
+    def _shape(sh):
+        return (jax.ShapeDtypeStruct(sh, v.dtype, vma=vma) if vma
+                else jax.ShapeDtypeStruct(sh, v.dtype))
+    return pl.pallas_call(
+        _mps_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((n_tiles, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[_shape(v.shape), _shape((n_tiles, 1))],
+        interpret=interpret,
+    )(v, d)
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
@@ -75,10 +104,14 @@ def multiply_prefix_sum(
 
     Returns ``(local, totals, tile)``: ``local`` is [padded] with the
     prefix restarting every ``tile = block_rows * 128`` elements, exactly
-    the pair ``types.blocked_boundary_combine`` consumes. ``interpret=None``
-    auto-selects interpret mode off-TPU."""
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+    the pair ``types.blocked_boundary_combine`` consumes.
+
+    ``interpret=None`` selects per LOWERING platform via
+    ``lax.platform_dependent`` — the compiled Mosaic kernel for TPU,
+    interpret mode elsewhere. The old device-probe auto-detect picked
+    interpret mode whenever the CURRENT backend was CPU, which silently
+    exported interpreter HLO (not the kernel) when lowering for TPU from
+    a CPU host (jax.export / AOT)."""
     nnz = values.shape[0]
     tile = block_rows * _LANES
     n_tiles = max(pl.cdiv(nnz, tile), 1)
@@ -87,27 +120,16 @@ def multiply_prefix_sum(
     v = jnp.pad(values, (0, pad)).reshape(-1, _LANES)
     d = jnp.pad(d_sorted, (0, pad)).reshape(-1, _LANES)
 
-    # under shard_map (manual mode) the output varies over the same mesh
-    # axes as the inputs; plumb the vma through or check_vma rejects the call
-    vma = frozenset(getattr(jax.typeof(v), "vma", frozenset()))
-    def _shape(sh):
-        return (jax.ShapeDtypeStruct(sh, v.dtype, vma=vma) if vma
-                else jax.ShapeDtypeStruct(sh, v.dtype))
-    local, totals = pl.pallas_call(
-        _mps_kernel,
-        grid=(n_tiles,),
-        in_specs=[
-            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
-            pl.BlockSpec((1, 1), lambda i: (i, 0),
-                         memory_space=pltpu.SMEM),
-        ],
-        out_shape=[_shape(v.shape), _shape((n_tiles, 1))],
-        interpret=interpret,
-    )(v, d)
+    if interpret is None:
+        local, totals = jax.lax.platform_dependent(
+            v, d,
+            tpu=functools.partial(_mps_call, n_tiles=n_tiles,
+                                  block_rows=block_rows, interpret=False),
+            default=functools.partial(_mps_call, n_tiles=n_tiles,
+                                      block_rows=block_rows, interpret=True),
+        )
+    else:
+        local, totals = _mps_call(v, d, n_tiles, block_rows, interpret)
     return local.reshape(-1), totals.reshape(-1), tile
 
 

@@ -783,7 +783,10 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
                 converged = True
         loss_hist[it] = f
         gnorm_hist[it] = gnorm
-        if progress_callback is not None:
+        if accept and progress_callback is not None:
+            # only accepted steps produce a new point (the callback
+            # contract); rejected trust-region iterations shrink delta
+            # without moving w
             progress_callback(it, w)
         if prered <= eps * max(abs(f), 1.0):  # model predicts no gain left
             converged = True

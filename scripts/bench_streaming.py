@@ -59,10 +59,16 @@ def main():
                     help="warm-start from --checkpoint (after a stall "
                          "exit; typically with a halved --chunk-rows)")
     ap.add_argument("--skip-in-hbm", action="store_true")
+    ap.add_argument("--dim-log2", type=int, default=None)
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="shard the streamed fit over a data-parallel mesh "
+                         "of this width (VERDICT r3 contingency: the "
+                         "8-virtual-device streamed bench-shape record)")
     args = ap.parse_args()
 
     state = {"iters_done": 0, "elapsed": 0.0, "last_progress": time.time(),
-             "phase": "startup", "resumed_from": 0, "headline_done": False}
+             "phase": "startup", "resumed_from": 0, "headline_done": False,
+             "stall_armed": True}
 
     def emit(metric, value, unit, rc=None):
         print(json.dumps({"metric": metric, "value": round(value, 1),
@@ -94,7 +100,9 @@ def main():
     def stall_watch():
         while True:
             time.sleep(5.0)
-            if time.time() - state["last_progress"] > args.stall_timeout:
+            if (state["stall_armed"]
+                    and time.time() - state["last_progress"]
+                    > args.stall_timeout):
                 fire(f"STALL >{args.stall_timeout:.0f}s")
 
     N_ROWS = [0]  # filled once shapes are known; watchdogs read it
@@ -104,6 +112,11 @@ def main():
     from photon_ml_tpu.utils import apply_env_platforms
 
     apply_env_platforms()
+    if args.mesh_devices > 1:
+        try:
+            jax.config.update("jax_num_cpu_devices", args.mesh_devices)
+        except RuntimeError:
+            pass  # backend already up; the assert below decides
     import jax.numpy as jnp
 
     from photon_ml_tpu.ops.objective import make_objective
@@ -115,10 +128,16 @@ def main():
     from photon_ml_tpu.utils import transfer_budget as tb
 
     platform = jax.devices()[0].platform
+    mesh = None
+    if args.mesh_devices > 1:
+        assert len(jax.devices()) >= args.mesh_devices, (
+            f"need {args.mesh_devices} devices, have {len(jax.devices())}; "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count")
+        mesh = make_mesh({"data": args.mesh_devices})
     rows_log2 = args.rows_log2 or (19 if platform != "cpu" else 14)
     n, k = 1 << rows_log2, 39
     N_ROWS[0] = n
-    dim = 1 << 18 if platform != "cpu" else 1 << 13
+    dim = 1 << (args.dim_log2 or (18 if platform != "cpu" else 13))
     chunk_rows = args.chunk_rows or (1 << 14 if platform != "cpu"
                                      else 1 << 12)
     iters = args.iters
@@ -152,6 +171,19 @@ def main():
     else:
         tb.set_budget(total_mb=need_mb, single_mb=64.0,
                       label="bench_streaming")
+
+    n_chunks = -(-n // chunk_rows)
+    if mesh is not None and platform == "cpu" and n_chunks >= 64:
+        # measured r4: >=64 sequential sharded chunk executions deadlock
+        # XLA CPU's in-process all-reduce rendezvous on a 1-core box
+        # (7 of 8 participants arrive, the 8th never does — SIGABRT after
+        # the terminate timeout); 32 chunks run clean at the same shapes.
+        # Real multi-chip meshes are unaffected.
+        print(f"error: {n_chunks} chunks on a virtual CPU mesh deadlocks "
+              "XLA's in-process collectives (docs/PERF.md); raise "
+              "--chunk-rows to keep chunk count under 64", file=sys.stderr,
+              flush=True)
+        sys.exit(2)
 
     # implicit-ones layout (values=None): Criteo-style one-hot rows, half
     # the host->device bytes per chunk on the transfer-bound streamed path
@@ -195,7 +227,7 @@ def main():
         # (the axon backend memoizes bit-identical executions)
         res = fit_streaming(obj, chunks, dim, w0 + jnp.float32(salt) * 1e-8,
                             l2=1.0, config=run_cfg, optimizer=args.optimizer,
-                            progress_callback=callback)
+                            mesh=mesh, progress_callback=callback)
         int(res.iterations)  # scalar fetch: true end-to-end sync
         return res
 
@@ -218,10 +250,12 @@ def main():
     resumed = (f", resumed@{state['resumed_from']}"
                if state["resumed_from"] else "")
     state["headline_done"] = True
+    mesh_note = (f", data-mesh={args.mesh_devices}"
+                 if args.mesh_devices > 1 else "")
     emit("streaming_examples_per_sec", v_stream,
          f"example-passes/sec end-to-end incl transfer ({platform},"
          f" n={n}, d={dim}, k={k}, chunk_rows={chunk_rows},"
-         f" iters={done}{resumed}, optimizer={args.optimizer})")
+         f" iters={done}{resumed}{mesh_note}, optimizer={args.optimizer})")
 
     if args.skip_in_hbm:
         return
@@ -230,7 +264,11 @@ def main():
     # jnp.asarray(indices) of hundreds of MB is exactly the transfer shape
     # that wedges the axon tunnel (r03 session: 0.33 GB upload -> timeout).
     state["phase"] = "in-hbm"
-    state["last_progress"] = time.time()
+    # disarm the stall watchdog here: mem_fit(1) is a fresh jit compile
+    # (minutes through the tunnel — RUNBOOK rule 5) with no progress
+    # callbacks to feed it, and a false stall would silently lose the
+    # streaming/in-HBM ratio. The hard --timeout still bounds the process.
+    state["stall_armed"] = False
     try:
         tb.waive(2 * indices.nbytes / 1e6 + 64,
                  reason="in-HBM comparison uploads the dataset once, "
@@ -242,17 +280,16 @@ def main():
             SparseFeatures(dev_idx, None, dim=dim),
             jnp.asarray(labels), jnp.zeros((n,), jnp.float32),
             jnp.ones((n,), jnp.float32))
-        mesh = make_mesh()
+        hbm_mesh = mesh if mesh is not None else make_mesh()
 
         def mem_fit(salt):
-            r = fit_distributed(obj, batch, mesh,
+            r = fit_distributed(obj, batch, hbm_mesh,
                                 w0 + jnp.float32(salt) * 1e-8, l2=1.0,
                                 config=cfg)
             int(r.iterations)  # scalar fetch: true sync
             return r
 
         r = mem_fit(1)
-        state["last_progress"] = time.time()
         t0 = time.perf_counter()
         r = mem_fit(2)
         dt_mem = time.perf_counter() - t0

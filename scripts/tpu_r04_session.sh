@@ -72,8 +72,14 @@ run() {
 # --- SAFE TIER: no bulk data, the round's must-have evidence ------------
 # 0. Sync semantics + honest per-op / per-fit timings (first, always)
 run tpu_diag 2400 64 python scripts/tpu_diag.py
-# 1. The headline bench (salted + scalar-fetch-synced, device-synthesized)
-run bench 1800 64 env BENCH_TIMEOUT_S=1700 python bench.py
+# 1. The headline bench (salted + scalar-fetch-synced, device-synthesized).
+#    On hardware, BENCH_REQUIRE_TPU=1: a CPU fallback exiting 0 would mark
+#    bench .done and skip the headline TPU measurement on every resume.
+if [ "$DRY" = "1" ]; then
+  run bench 1800 64 env BENCH_TIMEOUT_S=1700 python bench.py
+else
+  run bench 1800 64 env BENCH_TIMEOUT_S=1700 BENCH_REQUIRE_TPU=1 python bench.py
+fi
 # 2. Attribute the utilization gap per op (413-safe since r03)
 run profile 2400 64 python scripts/profile_hot_loop.py
 # 3. f32-vs-f64 parity (tiny data, subprocess per dtype)

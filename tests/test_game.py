@@ -439,3 +439,30 @@ def test_newton_rejected_for_fixed_coordinates():
     with pytest.raises(ValueError, match="newton"):
         CoordinateConfig("fixed", coordinate_type="fixed",
                          optimizer="newton")
+
+
+def test_re_optimizer_auto_resolves_per_platform(rng):
+    """optimizer="auto" picks the measured per-platform default (CPU:
+    vmapped L-BFGS) and produces the same fit as naming it explicitly
+    (VERDICT r3 #7: the default is data-driven, one table entry per
+    platform in random_effect._RE_SOLVER_DEFAULT)."""
+    from photon_ml_tpu.game.data import build_random_effect_data
+    from photon_ml_tpu.game.random_effect import (
+        resolve_re_optimizer, train_random_effect)
+    from photon_ml_tpu.optimize import OptimizerConfig
+
+    assert resolve_re_optimizer("newton") == "newton"
+    assert resolve_re_optimizer("auto") == "lbfgs"  # tests run on CPU
+
+    n, d, E = 120, 4, 6
+    X = rng.normal(size=(n, d))
+    ids = rng.integers(0, E, n)
+    y = (rng.random(n) < 0.5).astype(float)
+    data = build_random_effect_data(X, y, np.ones(n), ids, num_buckets=1)
+    kw = dict(task="logistic", l2=0.5,
+              config=OptimizerConfig(max_iters=50, tolerance=1e-8))
+    f_auto = train_random_effect(data, np.zeros(n), optimizer="auto", **kw)
+    f_lb = train_random_effect(data, np.zeros(n), optimizer="lbfgs", **kw)
+    for b in range(len(f_lb.coefficients)):
+        np.testing.assert_array_equal(f_auto.coefficients[b],
+                                      f_lb.coefficients[b])
