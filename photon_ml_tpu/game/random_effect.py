@@ -335,8 +335,6 @@ def resolve_re_optimizer(optimizer: str) -> str:
     """Resolve ``"auto"`` to the measured per-platform default solver."""
     if optimizer != "auto":
         return optimizer
-    import jax
-
     return _RE_SOLVER_DEFAULT.get(jax.devices()[0].platform, "lbfgs")
 
 

@@ -329,13 +329,15 @@ def fit_streaming(
 ) -> OptimizationResult:
     """Streamed (larger-than-HBM) full-batch fit.
 
-    ``progress_callback(iteration, w)``, when given, fires after every
-    outer iteration that produced a new point, with the 0-based loop
-    index and the point — measurement harnesses use it for per-iteration
-    progress logging and host-side checkpoints so a tunnel stall loses
-    an iteration, not the run (VERDICT r3 #5). Iterations whose line
-    search fails (history-reset retries) are counted in ``iterations``
-    but fire no callback, so indices can skip.
+    ``progress_callback(iteration, w)``, when given, fires with the
+    0-based loop index and the current point — measurement harnesses use
+    it for per-iteration progress logging and host-side checkpoints so a
+    tunnel stall loses an iteration, not the run (VERDICT r3 #5). The
+    L-BFGS/OWL-QN loops fire only on iterations that accepted a step
+    (line-search-failure retries are counted in ``iterations`` but fire
+    no callback, so indices can skip); TRON fires every outer iteration
+    — a rejected trust-region step still paid a full CG pass sequence,
+    and ``w`` is simply unchanged.
 
     ``optimizer``: "lbfgs" (default — margin-space line search: trials
     stream cached margin vectors instead of paying a sparse pass each,
@@ -783,10 +785,14 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
                 converged = True
         loss_hist[it] = f
         gnorm_hist[it] = gnorm
-        if accept and progress_callback is not None:
-            # only accepted steps produce a new point (the callback
-            # contract); rejected trust-region iterations shrink delta
-            # without moving w
+        if progress_callback is not None:
+            # TRON fires every OUTER iteration, accepted or not: a
+            # rejected step still paid a full Steihaug-CG sequence of
+            # streamed passes (minutes on a slow tunnel), and the stall
+            # watchdog must see that heartbeat. ``w`` is the current
+            # (possibly unmoved) point, so checkpoints stay valid, and
+            # TRON's own ``iterations`` counts rejected outer iterations
+            # the same way.
             progress_callback(it, w)
         if prered <= eps * max(abs(f), 1.0):  # model predicts no gain left
             converged = True

@@ -1,0 +1,83 @@
+"""TPU-target lowering certification (chip readiness without a chip).
+
+``jax.export(..., platforms=["tpu"])`` runs the full StableHLO (and
+Pallas->Mosaic) lowering for the TPU target from a CPU host — the layer
+interpret-mode execution parity can never exercise. Round 4 this caught
+two chip-blocking kernel bugs (docs/PERF.md "Round-4 Mosaic lowering"),
+so every distributed hot-path program is pinned here: a live chip session
+must start at "compile", not "debug the lowering" (VERDICT r3 #4).
+
+These certify LOWERING only; Mosaic's compile to LLO and the numerics
+still need the chip (scripts/tpu_r04_session.sh).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import export
+
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.parallel.data_parallel import fit_distributed
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import LabeledBatch, SparseFeatures
+
+N, D, K = 2048, 512, 8
+
+
+def _fit_exporter(**kw):
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=4, tolerance=0.0)
+    mesh = make_mesh({"data": 8})
+
+    def f(w0, indices, labels):
+        batch = LabeledBatch(
+            SparseFeatures(indices, None, dim=D), labels,
+            jnp.zeros((N,), jnp.float32), jnp.ones((N,), jnp.float32))
+        r = fit_distributed(obj, batch, mesh, w0, l2=0.5, config=cfg, **kw)
+        return r.w, r.value
+
+    return export.export(jax.jit(f), platforms=["tpu"])(
+        jax.ShapeDtypeStruct((D,), jnp.float32),
+        jax.ShapeDtypeStruct((N, K), jnp.int32),
+        jax.ShapeDtypeStruct((N,), jnp.float32))
+
+
+@pytest.mark.parametrize("kw", [
+    dict(optimizer="lbfgs"),                             # margin + scatter
+    dict(optimizer="lbfgs", sparse_grad="csc"),
+    dict(optimizer="lbfgs", sparse_grad="csc_segment"),
+    dict(optimizer="tron", line_search="full"),
+    dict(optimizer="owlqn", line_search="full"),
+], ids=lambda kw: "-".join(str(v) for v in kw.values()))
+def test_distributed_fit_lowers_for_tpu(kw):
+    exp = _fit_exporter(**kw)
+    assert exp.nr_devices == 8
+
+
+def test_sharded_csc_pallas_lowers_with_mosaic_kernel():
+    """Under shard_map, lax.platform_dependent must still pick the REAL
+    Mosaic kernel for the TPU target (not the interpret branch)."""
+    exp = _fit_exporter(optimizer="lbfgs", sparse_grad="csc_pallas")
+    assert exp.nr_devices == 8
+    assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_newton_re_solver_lowers_for_tpu():
+    """The batched dense-Newton RE solver (einsum Hessians + batched SPD
+    solve) under an entity-axis shard_map lowers for TPU."""
+    from photon_ml_tpu.game.random_effect import _jitted_sharded_solver
+
+    E, D_loc, rows = 16, 6, 32
+    run = _jitted_sharded_solver(
+        D_loc, "logistic", "newton",
+        OptimizerConfig(max_iters=5, tolerance=1e-6),
+        False, make_mesh({"entity": 8}), "entity", 0)
+    s = jax.ShapeDtypeStruct
+    exp = export.export(run, platforms=["tpu"])(
+        s((E, rows, D_loc), jnp.int32), s((E, rows, D_loc), jnp.float32),
+        s((E, rows), jnp.float32), s((E, rows), jnp.float32),
+        s((E, rows), jnp.float32), s((E, D_loc), jnp.float32),
+        s((E, 1), jnp.float32), s((E, 1), jnp.float32),
+        s((), jnp.float32), s((), jnp.float32))
+    assert exp.nr_devices == 8
