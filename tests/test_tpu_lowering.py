@@ -25,10 +25,10 @@ from photon_ml_tpu.types import LabeledBatch, SparseFeatures
 N, D, K = 2048, 512, 8
 
 
-def _fit_exporter(**kw):
+def _fit_exporter(mesh_axes={"data": 8}, **kw):
     obj = make_objective("logistic")
     cfg = OptimizerConfig(max_iters=4, tolerance=0.0)
-    mesh = make_mesh({"data": 8})
+    mesh = make_mesh(dict(mesh_axes))
 
     def f(w0, indices, labels):
         batch = LabeledBatch(
@@ -80,4 +80,26 @@ def test_newton_re_solver_lowers_for_tpu():
         s((E, rows), jnp.float32), s((E, D_loc), jnp.float32),
         s((E, 1), jnp.float32), s((E, 1), jnp.float32),
         s((), jnp.float32), s((), jnp.float32))
+    assert exp.nr_devices == 8
+
+
+def test_fixed_fit_lowers_on_two_axis_game_mesh():
+    """The GAME CD loop runs the fixed-effect fit on the 'data' axis of a
+    2-axis (data x entity) mesh — axis-name handling must lower for TPU
+    with the extra axis present."""
+    exp = _fit_exporter(mesh_axes={"data": 2, "entity": 4},
+                        sparse_grad="csc")
+    assert exp.nr_devices == 8
+
+
+def test_device_auc_evaluator_lowers_for_tpu():
+    """The per-iteration device AUC (histogram form on a mesh, exact sort
+    single-device) used for CD validation lowers for TPU."""
+    from photon_ml_tpu.evaluation.device import make_device_evaluator
+
+    mesh = make_mesh({"data": 8})
+    fn = make_device_evaluator("auc", mesh)
+    s = jax.ShapeDtypeStruct
+    exp = export.export(jax.jit(fn), platforms=["tpu"])(
+        s((N,), jnp.float32), s((N,), jnp.float32), s((N,), jnp.float32))
     assert exp.nr_devices == 8
