@@ -201,6 +201,20 @@ def main():
     record("segment_sum (implicit 1s)",
            lambda s: seg_binary(s_rows, sorted_ids, dvec + s), tb / 2)
 
+    # ---- fused Pallas apply (compiled Mosaic on TPU; interpret on CPU) -----
+    from photon_ml_tpu.ops.pallas_kernels import csc_transpose_apply_pallas
+    from photon_ml_tpu.types import CSCTranspose
+
+    csc_view = CSCTranspose(values=s_vals, rows=s_rows,
+                            col_starts=col_starts)
+    pallas_j = jax.jit(lambda c, dv: csc_transpose_apply_pallas(c, dv))
+    try:
+        record("pallas fused apply" + (" [interp]" if platform == "cpu"
+                                       else ""),
+               lambda s: pallas_j(csc_view, dvec + s), tb)
+    except Exception as e:  # a Mosaic compile failure must not kill the run
+        print(f"pallas apply failed: {e}", flush=True)
+
     # ---- cumsum alone (is XLA's cumsum multi-pass?) ------------------------
     flat_contrib = jax.block_until_ready(
         jax.jit(lambda v, r, dv: v * dv[r])(s_vals, s_rows, dvec))
