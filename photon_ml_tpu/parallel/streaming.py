@@ -488,10 +488,13 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
     transpose) per Armijo trial. GLM margins are affine in w (offsets and
     the normalization adjust are the constant/linear parts —
     ``ops/objective.margins``), so this loop instead caches the per-chunk
-    margin vectors ``mw`` in HOST RAM and evaluates every trial by
-    streaming only (mw, mp, labels, weights) — 16 bytes/row against the
-    hundreds of bytes/row of a sparse pass. Per iteration: one gather pass
-    (the direction's margins), pointwise-only trials, and one
+    margin vectors ``mw`` in HOST RAM and evaluates the backtracking
+    ladder in GROUPS of 8 candidate steps per stream of (mw, mp, labels,
+    weights) — 16 bytes/row per group against the hundreds of bytes/row
+    of a sparse pass per trial; the first group almost always decides, so
+    the typical iteration is one gather pass (the direction's margins),
+    one margin-only ladder stream (worst case
+    ceil(max_line_search_steps/8)), and one
     gather+transpose pass for the accepted point's gradient — the same
     2-sparse-pass cost as the in-memory margin optimizer
     (``optimize/lbfgs_margin.py``), where the black-box loop paid
@@ -520,24 +523,43 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
     # per-chunk trial: masked margins -> weighted loss partial (Kahan)
     from photon_ml_tpu.ops.losses import apply_weights, mask_margins
 
+    # Ladder GROUP width: per streamed pass, this many candidate steps are
+    # evaluated together (G x the pointwise math per chunk — nearly free on
+    # device, noticeable on a 1-core CPU host, hence not the full 25-step
+    # ladder). Backtracking rarely goes past the first few halvings, so one
+    # group usually decides; worst case ceil(max_line_search_steps / G)
+    # passes instead of one pass per trial.
+    L = min(max(int(config.max_line_search_steps), 1), 8)
+
     def _make_trial():
-        def trial(mw, mp, labels, weights, alpha, f_acc, f_comp):
-            # DELTA space: sum per-row loss DIFFERENCES l(mw + a*mp) -
-            # l(mw). In f32 a loss total's resolution is eps*|f|, far
-            # coarser than late-stage improvements, so Armijo on totals
-            # stalls; the difference keeps relative accuracy in the
-            # improvement itself (same scheme as the in-memory
-            # lbfgs_margin delta path). Also removes the need for a
-            # separate phi(0) stream: the trial compares against 0.
+        def trial(mw, mp, labels, weights, alphas, f_acc, f_comp):
+            # DELTA space: per-row loss DIFFERENCES l(mw + a*mp) - l(mw).
+            # In f32 a loss total's resolution is eps*|f|, far coarser
+            # than late-stage improvements, so Armijo on totals stalls;
+            # the difference keeps relative accuracy in the improvement
+            # itself (same scheme as the in-memory lbfgs_margin delta
+            # path). Also removes the need for a separate phi(0) stream:
+            # the trial compares against 0.
+            #
+            # LADDER: ``alphas`` is the whole [L] backtracking ladder and
+            # f_acc/f_comp are [L] Kahan accumulators — the streamed
+            # search is transfer-bound, so every candidate step is
+            # evaluated in the SAME streamed visit of the chunk (L x the
+            # pointwise math, ~free on device) instead of one 16B/row
+            # stream per trial.
             mm0 = mask_margins(weights, mw)
-            mm1 = mask_margins(weights, mw + alpha * mp)
-            d = jnp.sum(apply_weights(
-                weights, objective.loss.loss(mm1, labels)
-                - objective.loss.loss(mm0, labels)))
-            return _kahan_add(f_acc, f_comp, d)
+            l0 = apply_weights(weights, objective.loss.loss(mm0, labels))
+
+            def per_alpha(a):
+                mm1 = mask_margins(weights, mw + a * mp)
+                return jnp.sum(apply_weights(
+                    weights, objective.loss.loss(mm1, labels)) - l0)
+
+            return _kahan_add(f_acc, f_comp, jax.vmap(per_alpha)(alphas))
         return trial
 
-    trial_k = cached_jit(objective, ("stream_trial_delta", mesh, axis),
+    trial_k = cached_jit(objective,
+                         ("stream_trial_delta_ladder", mesh, axis, L),
                          _make_trial)
 
     def _put(a):
@@ -564,17 +586,18 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
             out[pending[0]] = np.asarray(pending[1])
         return out
 
-    def phi_delta(mw_h, mp_h, alpha):
-        """f(w + alpha p) - f(w), data term, via margin-only streaming."""
-        f_acc = f_comp = jnp.zeros((), dtype)
-        a = jnp.asarray(alpha, dtype)
+    def phi_delta_ladder(mw_h, mp_h, alphas):
+        """[L] data-term deltas f(w + a p) - f(w) for the whole
+        backtracking ladder, in ONE margin-only streamed pass."""
+        f_acc = f_comp = jnp.zeros((L,), dtype)
+        a = jnp.asarray(alphas, dtype)
         for i, chunk in enumerate(chunks):
             f_acc, f_comp = trial_k(
                 _put(mw_h[i]), _put(mp_h[i]),
                 _put(chunk.labels), _put(chunk.weights),
                 a, f_acc, f_comp)
-        (f_acc,) = _cross_process_sum((f_acc - f_comp,))
-        return float(f_acc)
+        (d,) = _cross_process_sum((f_acc - f_comp,))
+        return np.asarray(d, np.float64)
 
     direction, store_pair = _lbfgs_stream_kernels(objective, mesh, axis, m)
 
@@ -610,18 +633,27 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         l2f = float(np.asarray(l2))
         c1, c2 = wr @ pr, pr @ pr
 
-        alpha = 1.0 if k > 0 else 1.0 / max(g0_norm, 1.0)
+        alpha0 = 1.0 if k > 0 else 1.0 / max(g0_norm, 1.0)
         f_cur = float(f)  # exact value (fg pass) — drives convergence only
+        # delta-space Armijo over ladder GROUPS, each group one streamed
+        # pass: improvement vs 0, accurate at any |f| (and
+        # drift-consistent — both sides live on the cached mw). First
+        # (largest) passing alpha == what sequential backtracking would
+        # have taken.
+        full = alpha0 * 0.5 ** np.arange(config.max_line_search_steps)
         accepted = False
-        for _ in range(config.max_line_search_steps):
-            # delta-space Armijo: improvement vs 0, accurate at any |f|
-            # (and drift-consistent — both sides live on the cached mw)
-            delta = (phi_delta(mw_h, mp_h, alpha)
-                     + l2f * (alpha * c1 + 0.5 * alpha * alpha * c2))
-            if delta <= 1e-4 * alpha * dg and np.isfinite(delta):
+        alpha = 0.0
+        for g0 in range(0, len(full), L):
+            grp = full[g0:g0 + L]
+            if len(grp) < L:  # pad: duplicates of the last alpha are inert
+                grp = np.concatenate([grp, np.full(L - len(grp), grp[-1])])
+            deltas = (phi_delta_ladder(mw_h, mp_h, grp)
+                      + l2f * (grp * c1 + 0.5 * grp * grp * c2))
+            armijo = (deltas <= 1e-4 * grp * dg) & np.isfinite(deltas)
+            if armijo.any():
                 accepted = True
+                alpha = float(grp[int(np.argmax(armijo))])
                 break
-            alpha *= 0.5
         if not accepted:
             # mirror optimize/lbfgs_margin.py: a search failing AT the
             # optimum is convergence, not a stall; otherwise reset the
