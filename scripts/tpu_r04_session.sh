@@ -30,6 +30,10 @@ if [ "$DRY" = "1" ]; then
   SMALL_ROWS=13; BIG_ROWS=15; E2E_ROWS=4000; E2E_USERS=50
 else
   SMALL_ROWS=18; BIG_ROWS=21; E2E_ROWS=20000; E2E_USERS=300
+  # persistent compilation cache: compiles through the tunnel cost
+  # minutes, and the session's harnesses share many programs. JAX falls
+  # back silently if the axon plugin can't serialize executables.
+  export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_tpu_cache}
 fi
 
 probe() {
