@@ -1,0 +1,82 @@
+"""Condense a hardware-session log directory into one markdown summary.
+
+Reads every ``<experiment>.log`` under the log dir (default
+``docs/tpu_r04_logs``), pulls out the machine-readable JSON metric lines
+plus the informative stderr lines (calibration tables, per-op profile
+rows, parity deltas, sync-semantics checks), and writes ``SUMMARY.md``
+next to them. Run after a session (or a partial one — wedges included)
+so acting on the results starts from one page, not eight raw logs.
+
+Usage: python scripts/summarize_session.py [logdir]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+INTERESTING = re.compile(
+    r"calibration|accuracy|utilization|-> |GB/s|TFLOP|parity|dAUC|dloss|"
+    r"block=|fetch=|fit\[|entities/sec|iter \d|resuming|platform=|"
+    r"STALL|TIMEOUT|PARTIAL|rendezvous|train driver|scoring driver|"
+    r"suggested|csc build")
+
+
+def summarize(logdir: str) -> str:
+    lines = [f"# Session summary — `{logdir}`", ""]
+    summary_txt = os.path.join(logdir, "session_summary.txt")
+    if os.path.exists(summary_txt):
+        lines += ["## Experiment status", "", "```"]
+        lines += open(summary_txt).read().strip().splitlines()
+        lines += ["```", ""]
+
+    for name in sorted(os.listdir(logdir)):
+        if not name.endswith(".log"):
+            continue
+        path = os.path.join(logdir, name)
+        metrics, notes = [], []
+        for raw in open(path, errors="replace"):
+            line = raw.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    rec = json.loads(line)
+                    metrics.append(
+                        f"- **{rec.get('metric')}** = {rec.get('value')}"
+                        f"  \n  {rec.get('unit', '')}")
+                    continue
+                except json.JSONDecodeError:
+                    pass
+            elif (line.startswith("{") and '"platform"' in line
+                  and '"event"' not in line):
+                metrics.append(f"- `{line}`")
+                continue
+            if INTERESTING.search(line) and not line.startswith("WARNING"):
+                notes.append(line)
+        if not metrics and not notes:
+            continue
+        lines += [f"## {name[:-4]}", ""]
+        lines += metrics
+        if notes:
+            lines += ["", "```"] + notes[:40] + ["```"]
+        lines += [""]
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "docs/tpu_r04_logs"
+    if not os.path.isdir(logdir):
+        print(f"no log dir {logdir}", file=sys.stderr)
+        return 1
+    out = os.path.join(logdir, "SUMMARY.md")
+    text = summarize(logdir)
+    with open(out, "w") as f:
+        f.write(text)
+    print(text)
+    print(f"(written to {out})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
