@@ -3,7 +3,8 @@
 Generates a synthetic mixed-effect Avro dataset on host (modest size so the
 axon tunnel only sees small, driver-realistic transfers), then runs the full
 ``game_training_driver`` pipeline on the chip: Avro decode -> feature
-indexing -> normalization-free GAME fit (fixed + per-user random effect) ->
+indexing -> normalization-free GAME fit (fixed + per-user + per-item random
+effects — the BASELINE.md north-star '2 random effects end-to-end' shape) ->
 validation AUC -> Avro model out.  Reports stage wall-clocks and the final
 AUC; this exercises every transfer-sensitive piece that the synthetic
 on-device bench deliberately avoids.
@@ -25,14 +26,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def make_dataset(tmp, rows, users, d_g=24, d_u=6, seed=0):
+def make_dataset(tmp, rows, users, d_g=24, d_u=6, d_i=4, seed=0):
+    """Synthetic mixed-effect data with TWO random effects (per-user +
+    per-item) — the north-star GAME shape (BASELINE.md: 'GAME model, 2
+    random effects trains end-to-end on TPU')."""
     rng = np.random.default_rng(seed)
+    items = max(users // 3, 2)
     w_fixed = rng.normal(size=d_g)
     U = rng.normal(size=(users, d_u)) * 1.5
+    V = rng.normal(size=(items, d_i)) * 1.0
     uid = rng.integers(0, users, size=rows)
+    iid = rng.integers(0, items, size=rows)
     Xg = rng.normal(size=(rows, d_g))
     Xu = rng.normal(size=(rows, d_u))
-    marg = Xg @ w_fixed + np.einsum("ij,ij->i", Xu, U[uid])
+    Xi = rng.normal(size=(rows, d_i))
+    marg = (Xg @ w_fixed + np.einsum("ij,ij->i", Xu, U[uid])
+            + np.einsum("ij,ij->i", Xi, V[iid]))
     y = (rng.random(rows) < 1 / (1 + np.exp(-marg))).astype(float)
     perm = rng.permutation(rows)
     tr, va = perm[: int(rows * 0.8)], perm[int(rows * 0.8):]
@@ -44,10 +53,12 @@ def make_dataset(tmp, rows, users, d_g=24, d_u=6, seed=0):
             for i in sel:
                 row = [(f"g{j}", "", float(Xg[i, j])) for j in range(d_g)]
                 row += [(f"u{j}", "", float(Xu[i, j])) for j in range(d_u)]
+                row += [(f"i{j}", "", float(Xi[i, j])) for j in range(d_i)]
                 yield row
         write_training_examples(
             str(path), tuples(), y[sel],
-            entity_ids={"userId": uid[sel]}, uids=[str(i) for i in sel])
+            entity_ids={"userId": uid[sel], "itemId": iid[sel]},
+            uids=[str(i) for i in sel])
 
     write(os.path.join(tmp, "train.avro"), tr)
     write(os.path.join(tmp, "val.avro"), va)
@@ -57,11 +68,14 @@ def make_dataset(tmp, rows, users, d_g=24, d_u=6, seed=0):
         {"name": "per-user", "coordinate_type": "random",
          "feature_shard": "user", "entity_column": "userId",
          "reg_type": "l2", "reg_weight": 1.0, "max_iters": 30},
+        {"name": "per-item", "coordinate_type": "random",
+         "feature_shard": "item", "entity_column": "itemId",
+         "reg_type": "l2", "reg_weight": 1.0, "max_iters": 30},
     ]
     with open(os.path.join(tmp, "coords.json"), "w") as f:
         json.dump(coords, f)
     with open(os.path.join(tmp, "shards.json"), "w") as f:
-        json.dump({"global": ["g"], "user": ["u"]}, f)
+        json.dump({"global": ["g"], "user": ["u"], "item": ["i"]}, f)
 
 
 def main():
