@@ -113,12 +113,14 @@ def main():
 
     # both RE solvers: the vmapped sparse L-BFGS and the batched dense
     # Newton (einsum/MXU) — which wins is the hardware question
+    rates = {}
     for opt_name in ("lbfgs", "newton"):
         re_solve(0.5, opt_name)  # compile + warm-up
         t0 = time.perf_counter()
         fit = re_solve(0.5000001, opt_name)
         dt = time.perf_counter() - t0
         assert float(np.abs(fit.coefficients[0]).sum()) > 0
+        rates[opt_name] = n_entities / dt
         print(json.dumps({
             "metric": f"game_re_{opt_name}_entities_per_sec",
             "value": round(n_entities / dt, 1),
@@ -127,6 +129,10 @@ def main():
                      f"optimizer={opt_name}, mean_iters="
                      f"{fit.mean_iterations:.1f})"),
         }), flush=True)
+    winner = max(rates, key=rates.get)
+    print(f"suggested _RE_SOLVER_DEFAULT entry: '{platform}': '{winner}' "
+          f"({rates[winner]/max(min(rates.values()), 1e-9):.2f}x — wire in "
+          "photon_ml_tpu/game/random_effect.py)", flush=True)
 
     # -- 2. one full CD iteration (fixed + 2 random effects) --------------
     users = rng.integers(0, n_entities, size=n_fixed)
