@@ -277,8 +277,18 @@ def main() -> None:
     print(f"utilization: {util}", file=sys.stderr)
 
     base = _baseline()
-    vs = round(value / base[0], 4) if base else 1.0
-    base_note = f"; vs_baseline vs {base[1]}" if base else ""
+    # The pinned comparator is a TPU hardware number. A CPU record (fallback
+    # OR an explicitly CPU-pinned CI run) divided by it is meaningless, and a
+    # ratio > 1 in the PARSED field reads as a TPU win to any consumer that
+    # never looks at the unit string (VERDICT r4 weak #2) — report 0.0 so no
+    # parser can misbrand a fallback as a measurement.
+    if platform == "cpu" and base:
+        vs = 0.0
+        base_note = (f"; vs_baseline=0.0: comparator {base[1]} is a TPU "
+                     "number, CPU run not comparable")
+    else:
+        vs = round(value / base[0], 4) if base else 1.0
+        base_note = f"; vs_baseline vs {base[1]}" if base else ""
     print(json.dumps({
         "metric": "criteo_shaped_logreg_lbfgs_example_passes_per_sec",
         "value": round(value, 1),

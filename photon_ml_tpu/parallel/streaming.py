@@ -425,7 +425,9 @@ def fit_streaming(
                 rho = jnp.zeros((m,), dtype)
                 k = 0
                 continue
-            break
+            it += 1  # the attempted iteration counts: histories[:iterations]
+            break    # must include the record written above
+
         step = w_try - w
         yv = g_try - g
         sy = float(jnp.sum(step * yv))
@@ -563,10 +565,14 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
                          _make_trial)
 
     def _put(a):
-        if isinstance(a, np.ndarray):
-            # charge the bytes actually moved (post-cast width)
+        if not isinstance(a, jax.Array):
+            # charge the bytes actually moved (post-cast width); any
+            # host array-protocol object counts, not only np.ndarray —
+            # same gate as transfer_budget.device_put (ADVICE r4), and
+            # the charge doubles as the stall-watchdog liveness signal
             transfer_budget.charge(
-                a.size * jnp.dtype(dtype).itemsize, "margin trial chunk")
+                int(np.size(a)) * jnp.dtype(dtype).itemsize,
+                "margin trial chunk")
         dev = jnp.asarray(a, dtype)
         return jax.device_put(dev, sharding) if sharding else dev
 
@@ -674,7 +680,9 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
                 rho = jnp.zeros((m,), dtype)
                 k = 0
                 continue
-            break
+            it += 1  # the attempted iteration counts: histories[:iterations]
+            break    # must include the record written above
+
         w_try = w + jnp.asarray(alpha, dtype) * p
         # accepted point: ONE gather+transpose pass for the exact (f, g)
         f_try_x, g_try = fg(w_try, l2)

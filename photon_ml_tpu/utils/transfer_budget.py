@@ -40,8 +40,22 @@ from typing import Optional
 
 __all__ = [
     "TransferBudgetExceeded", "set_budget", "get_budget", "charge",
-    "device_put", "waive",
+    "device_put", "waive", "set_activity_hook",
 ]
+
+# Optional per-charge callback (no args). Measurement harnesses use it as a
+# liveness signal: every sanctioned upload — including the margin-ladder
+# streams that fire no optimizer-progress callback — proves the run is not
+# wedged, so a stall watchdog fed from here cannot falsely kill a live fit
+# that is mid-line-search (ADVICE r4).
+_activity_hook = None
+
+
+def set_activity_hook(fn) -> None:
+    """Install (or with ``None`` clear) a zero-arg callback fired on every
+    budget charge, regardless of whether a budget is configured."""
+    global _activity_hook
+    _activity_hook = fn
 
 
 class TransferBudgetExceeded(RuntimeError):
@@ -122,16 +136,25 @@ def waive(extra_total_mb: float, reason: str) -> None:
 def charge(nbytes: int, what: str = "") -> None:
     """Account ``nbytes`` of imminent host->device transfer against the
     budget (no-op when none is configured). Call BEFORE the upload."""
+    if _activity_hook is not None:
+        _activity_hook()
     b = get_budget()
     if b is not None and nbytes:
         b.charge(nbytes, what)
 
 
 def device_put(x, sharding=None, what: str = ""):
-    """Budget-accounted ``jax.device_put`` for host (numpy) arrays."""
-    import jax
-    import numpy as np
+    """Budget-accounted ``jax.device_put`` for host-resident arrays.
 
-    if isinstance(x, np.ndarray):
-        charge(x.nbytes, what or "device_put")
+    Charges anything exposing ``nbytes`` that is not already a ``jax.Array``
+    — not just ``np.ndarray`` — so chunks built from array-protocol objects
+    (memoryviews, mmap-backed arrays, torch CPU tensors) cannot silently
+    bypass the budget (ADVICE r4). A committed ``jax.Array`` input is a
+    no-op transfer and charges nothing."""
+    import jax
+
+    if not isinstance(x, jax.Array):
+        nbytes = getattr(x, "nbytes", 0)
+        if nbytes:
+            charge(int(nbytes), what or "device_put")
     return jax.device_put(x, sharding)

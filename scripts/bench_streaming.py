@@ -127,6 +127,14 @@ def main():
     from photon_ml_tpu.types import LabeledBatch, SparseFeatures
     from photon_ml_tpu.utils import transfer_budget as tb
 
+    # liveness: every sanctioned chunk upload refreshes the stall window.
+    # The margin-ladder line search streams whole passes without firing the
+    # optimizer progress callback (only ACCEPTED iterations do), so a
+    # legitimately long ladder/history-reset retry must not be killed as a
+    # stall (ADVICE r4) — per-pass transfer activity is the honest signal.
+    tb.set_activity_hook(
+        lambda: state.__setitem__("last_progress", time.time()))
+
     platform = jax.devices()[0].platform
     mesh = None
     if args.mesh_devices > 1:
@@ -149,21 +157,42 @@ def main():
           f"({indices.nbytes/1e9:.2f} GB idx) chunk_rows={chunk_rows}",
           file=sys.stderr, flush=True)
 
+    # implicit-ones layout (values=None): Criteo-style one-hot rows, half
+    # the host->device bytes per chunk on the transfer-bound streamed path
+    chunks = []
+    zeros = np.zeros(chunk_rows, np.float32)
+    ones = np.ones(chunk_rows, np.float32)
+    for s in range(0, n, chunk_rows):
+        e = s + chunk_rows
+        chunks.append(HostChunk(indices[s:e], None, labels[s:e],
+                                zeros, ones))
+
     # transfer budget: keep the per-transfer cap sharp (a single bulk
     # upload is what crashes the worker); the streamed total is by-design
-    # bulk, so declare it. Bytes/pass ~= indices + labels/offsets/weights
-    # + margin-trial vectors; x(iters+2) passes x2 headroom.
-    chunk_mb = chunk_rows * k * 4 / 1e6
-    per_pass_mb = (indices.nbytes + 3 * 4 * n + 2 * 4 * n) / 1e6
+    # bulk, so declare it. The per-transfer unit is ONE FIELD ARRAY
+    # (streaming's _chunk_to_device/_put upload each chunk field
+    # separately), so the cap pre-check sizes the largest field of the
+    # ACTUAL chunks — a values-carrying layout is sized correctly instead
+    # of dying mid-fit on the budget raise (ADVICE r4). Per-pass bytes ~=
+    # indices + values + labels/offsets/weights + margin-trial vectors;
+    # x(iters+2) passes x2 headroom.
+    chunk_mb = max(
+        a.nbytes
+        for c in chunks
+        for a in (c.indices, c.values, c.labels, c.offsets, c.weights)
+        if a is not None) / 1e6
+    values_bytes = sum(c.values.nbytes for c in chunks
+                       if c.values is not None)
+    per_pass_mb = (indices.nbytes + values_bytes + 3 * 4 * n + 2 * 4 * n) / 1e6
     need_mb = per_pass_mb * (iters + 2) * 6
     if chunk_mb > 64.0:
         # the per-transfer cap is never relaxed: one bulk upload is the
         # worker-crash vector (r03). Refuse up front rather than dying
         # mid-fit on the budget raise.
         print(f"error: chunk_rows={chunk_rows} is a {chunk_mb:.0f} MB "
-              "upload per chunk, above the 64MB tunnel-safe per-transfer "
-              "cap — use a smaller --chunk-rows", file=sys.stderr,
-              flush=True)
+              "upload per chunk field, above the 64MB tunnel-safe "
+              "per-transfer cap — use a smaller --chunk-rows",
+              file=sys.stderr, flush=True)
         sys.exit(2)
     if tb.get_budget() is not None:
         tb.waive(need_mb, reason="streamed fit moves the dataset per pass "
@@ -184,16 +213,6 @@ def main():
               "--chunk-rows to keep chunk count under 64", file=sys.stderr,
               flush=True)
         sys.exit(2)
-
-    # implicit-ones layout (values=None): Criteo-style one-hot rows, half
-    # the host->device bytes per chunk on the transfer-bound streamed path
-    chunks = []
-    zeros = np.zeros(chunk_rows, np.float32)
-    ones = np.ones(chunk_rows, np.float32)
-    for s in range(0, n, chunk_rows):
-        e = s + chunk_rows
-        chunks.append(HostChunk(indices[s:e], None, labels[s:e],
-                                zeros, ones))
 
     obj = make_objective("logistic")
     w0 = jnp.zeros((dim,), jnp.float32)

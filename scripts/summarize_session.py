@@ -1,7 +1,7 @@
 """Condense a hardware-session log directory into one markdown summary.
 
 Reads every ``<experiment>.log`` under the log dir (default
-``docs/tpu_r04_logs``), pulls out the machine-readable JSON metric lines
+``docs/tpu_r05_logs``), pulls out the machine-readable JSON metric lines
 plus the informative stderr lines (calibration tables, per-op profile
 rows, parity deltas, sync-semantics checks), and writes ``SUMMARY.md``
 next to them. Run after a session (or a partial one — wedges included)
@@ -65,7 +65,7 @@ def summarize(logdir: str) -> str:
 
 
 def main():
-    logdir = sys.argv[1] if len(sys.argv) > 1 else "docs/tpu_r04_logs"
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "docs/tpu_r05_logs"
     if not os.path.isdir(logdir):
         print(f"no log dir {logdir}", file=sys.stderr)
         return 1

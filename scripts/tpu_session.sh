@@ -1,5 +1,5 @@
 #!/bin/bash
-# Round-4 hardware measurement session (VERDICT r3 #1/#2): every prepared
+# TPU hardware measurement session (round-agnostic; LOGDIR selects the round) (VERDICT r3 #1/#2): every prepared
 # TPU experiment, ordered SAFEST-FIRST / RISKIEST-LAST, each resumable and
 # transfer-budgeted, so one bad step cannot cost the round its chip again.
 #
@@ -17,10 +17,10 @@
 #     experiment land in $LOGDIR the moment each run ends.
 #
 # Dry run (mandated by VERDICT r3 #2): SESSION_DRY=1 runs the whole flow
-# on CPU with small shapes; `bash scripts/tpu_r04_session.sh` on hardware.
+# on CPU with small shapes; `bash scripts/tpu_session.sh` on hardware.
 set -u
 cd "$(dirname "$0")/.."
-LOGDIR=${LOGDIR:-docs/tpu_r04_logs}
+LOGDIR=${LOGDIR:-docs/tpu_r05_logs}
 mkdir -p "$LOGDIR"
 SUMMARY="$LOGDIR/session_summary.txt"
 DRY=${SESSION_DRY:-0}

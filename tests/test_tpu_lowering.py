@@ -8,7 +8,7 @@ so every distributed hot-path program is pinned here: a live chip session
 must start at "compile", not "debug the lowering" (VERDICT r3 #4).
 
 These certify LOWERING only; Mosaic's compile to LLO and the numerics
-still need the chip (scripts/tpu_r04_session.sh).
+still need the chip (scripts/tpu_session.sh).
 """
 
 import jax
