@@ -108,6 +108,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="larger-than-HBM mode: keep the training set in host "
                         "RAM and stream fixed-shape chunks through the "
                         "device each optimizer pass")
+    p.add_argument("--out-of-core", action="store_true",
+                   help="larger-than-host-RAM mode (implies --streaming): "
+                        "never materialize the training set — each optimizer "
+                        "pass re-decodes Avro block waves from disk on a "
+                        "background thread (io/stream_source.py). Requires "
+                        "--input-format avro and a pinned feature space "
+                        "(--hash-dim or --index-map); full-data validation/"
+                        "summarization/normalization are unavailable (no "
+                        "resident data to scan)")
+    p.add_argument("--pad-nnz", type=int, default=None,
+                   help="fixed per-row feature width incl. intercept for "
+                        "--out-of-core (default: one measuring decode pass)")
     p.add_argument("--chunk-rows", type=int, default=1 << 16,
                    help="rows per streamed chunk (--streaming)")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
@@ -194,6 +206,19 @@ def main(argv: Sequence[str] | None = None) -> int:
                    reason=f"reg_type={args.reg_type} needs OWL-QN")
         optimizer = "owlqn"
 
+    out_of_core = args.out_of_core
+    if out_of_core:
+        if args.input_format != "avro":
+            raise SystemExit("--out-of-core requires --input-format avro")
+        if not (args.hash_dim or args.index_map):
+            raise SystemExit(
+                "--out-of-core needs a pinned feature space (--hash-dim or "
+                "--index-map): building an index map would scan the full "
+                "dataset — run the feature indexing driver first")
+        if args.summarize_features or NormalizationType(args.normalization) != NormalizationType.NONE:
+            raise SystemExit("--out-of-core cannot summarize/normalize: "
+                             "no resident data to scan")
+
     # -- stage: read + index -------------------------------------------------
     with Timed(logger, "read_train_data"):
         index_map = None
@@ -213,9 +238,24 @@ def main(argv: Sequence[str] | None = None) -> int:
                     add_intercept=args.add_intercept,
                     min_count=args.min_feature_count,
                 )
-        host_feats, labels, offsets, weights, index_map, intercept_index = _read(
-            args.train_data, args.input_format, index_map, args.add_intercept
-        )
+        if out_of_core:
+            from photon_ml_tpu.io.stream_source import AvroChunkSource
+
+            n_local_dev = max(len(jax.local_devices()), 1)
+            ooc_chunk_rows = -(-args.chunk_rows // n_local_dev) * n_local_dev
+            src = AvroChunkSource(
+                args.train_data, index_map, chunk_rows=ooc_chunk_rows,
+                pad_nnz=args.pad_nnz, dtype=resolve_dtype(args.dtype),
+                process_part=((jax.process_index(), jax.process_count())
+                              if distributed else None))
+            host_feats = labels = offsets = weights = None
+            intercept_index = index_map.intercept_index
+        else:
+            (host_feats, labels, offsets, weights, index_map,
+             intercept_index) = _read(
+                args.train_data, args.input_format, index_map,
+                args.add_intercept
+            )
     validation = None
     if args.validation_data:
         with Timed(logger, "read_validation_data"):
@@ -224,23 +264,34 @@ def main(argv: Sequence[str] | None = None) -> int:
                 args.add_intercept,
             )
             validation = (vhost, vlabels, voffsets, vweights)
-    logger.log("data_read", num_train=int(labels.shape[0]),
+    logger.log("data_read",
+               num_train=(src.rows if out_of_core else int(labels.shape[0])),
                num_validation=0 if validation is None else int(vlabels.shape[0]),
-               num_features=host_feats.dim)
+               num_features=(index_map.size if out_of_core
+                             else host_feats.dim))
 
     # -- stage: validate (on host, before any device transfer) ---------------
     if args.validate_data:
         with Timed(logger, "validate_data"):
-            validate_training_data(host_feats, labels, offsets, weights,
-                                   task=task)
+            if out_of_core:
+                # no resident training data to scan: structural validation
+                # happens per decoded chunk (the source raises on unlabeled
+                # / malformed records); only validation data is checked here
+                logger.log("validate_skipped_out_of_core")
+            else:
+                validate_training_data(host_feats, labels, offsets, weights,
+                                       task=task)
             if validation is not None:
                 validate_training_data(vhost, vlabels, voffsets, vweights,
                                        task=task)
 
     # -- stage: summarize + normalization ------------------------------------
-    streaming = args.streaming
-    dim = host_feats.dim
-    if streaming:
+    streaming = args.streaming or out_of_core
+    dim = index_map.size if out_of_core else host_feats.dim
+    if out_of_core:
+        chunks = src
+        batch = None
+    elif streaming:
         from photon_ml_tpu.parallel.multihost import process_span
         from photon_ml_tpu.parallel.streaming import make_host_chunks
 

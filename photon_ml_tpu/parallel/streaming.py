@@ -576,6 +576,19 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         dev = jnp.asarray(a, dtype)
         return jax.device_put(dev, sharding) if sharding else dev
 
+    # Host scalar cache: per-chunk labels/weights/offsets, captured during
+    # the first streamed pass. For in-RAM chunk lists these are references
+    # (zero copy); for a disk-backed source (io/stream_source.py) this is
+    # the 12B/row cache that makes every margin-ladder trial DECODE-FREE —
+    # without it each ladder group would re-decode full chunks from disk
+    # just to read two scalar columns, turning the 2-pass/iteration cost
+    # model into ~(2 + groups) full decodes. Same order of host state as
+    # the mw/mp margin caches below (8B/row).
+    n_chunks = len(chunks)
+    labels_h = [None] * n_chunks
+    weights_h = [None] * n_chunks
+    offsets_h = [None] * n_chunks
+
     def margins_of(vec, out):
         """One streamed gather pass: per-chunk margins of ``vec`` (offsets
         included), stored to host numpy in ``out``. One-chunk lookahead:
@@ -583,6 +596,10 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         device->host fetch blocks, mirroring fg's overlap."""
         pending = None
         for i, chunk in enumerate(chunks):
+            if labels_h[i] is None:
+                labels_h[i] = chunk.labels
+                weights_h[i] = chunk.weights
+                offsets_h[i] = chunk.offsets
             dev = _chunk_to_device(chunk, dim, dtype, sharding)
             res = margin_k(vec, dev)
             if pending is not None:
@@ -594,13 +611,14 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
 
     def phi_delta_ladder(mw_h, mp_h, alphas):
         """[L] data-term deltas f(w + a p) - f(w) for the whole
-        backtracking ladder, in ONE margin-only streamed pass."""
+        backtracking ladder, in ONE margin-only streamed pass over the
+        HOST caches — no chunk (re-)decode, no sparse data."""
         f_acc = f_comp = jnp.zeros((L,), dtype)
         a = jnp.asarray(alphas, dtype)
-        for i, chunk in enumerate(chunks):
+        for i in range(n_chunks):
             f_acc, f_comp = trial_k(
                 _put(mw_h[i]), _put(mp_h[i]),
-                _put(chunk.labels), _put(chunk.weights),
+                _put(labels_h[i]), _put(weights_h[i]),
                 a, f_acc, f_comp)
         (d,) = _cross_process_sum((f_acc - f_comp,))
         return np.asarray(d, np.float64)
@@ -631,8 +649,8 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         # ONE gather pass: the direction's margins (offsets subtracted:
         # margins() adds them and they are the affine constant)
         mp_h = margins_of(p, mp_h)
-        for i, chunk in enumerate(chunks):
-            mp_h[i] = mp_h[i] - np.asarray(chunk.offsets, mp_h[i].dtype)
+        for i in range(n_chunks):
+            mp_h[i] = mp_h[i] - np.asarray(offsets_h[i], mp_h[i].dtype)
         # L2 delta along the ray: l2 * (a c1 + a^2/2 c2)
         wr = np.asarray(objective._reg_mask(w), np.float64)
         pr = np.asarray(objective._reg_mask(p), np.float64)

@@ -118,6 +118,16 @@ stream() {
   return 3
 }
 stream streaming_small "$SMALL_ROWS" 8192 1200
+# 5b. Out-of-core streamed fit over an on-disk Avro dataset (r05: the
+#     north-star no-RAM-resident-dataset configuration; decode on a
+#     background thread overlaps device compute)
+if [ "$DRY" = "1" ]; then
+  run ooc_stream 900 - python scripts/bench_ooc_streaming.py \
+    --rows 8000 --chunk-rows 2048 --iters 2 --timeout 800
+else
+  run ooc_stream 1800 - python scripts/bench_ooc_streaming.py \
+    --rows 200000 --chunk-rows 16384 --iters 3 --reuse --timeout 1700
+fi
 # 6. End-to-end training+scoring drivers (small Avro dataset)
 run driver_e2e 1800 256 python scripts/tpu_driver_e2e.py \
   --rows "$E2E_ROWS" --users "$E2E_USERS"
