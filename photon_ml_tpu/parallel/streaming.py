@@ -178,6 +178,81 @@ def _kahan_add(acc, comp, x):
     return t, comp
 
 
+def _shard_width(mesh: Optional[Mesh], axis: str) -> int:
+    return 1 if mesh is None else int(mesh.shape[axis])
+
+
+def _partial_sharding(mesh, axis):
+    """Sharding for per-device partial accumulators ([S] / [S, d] arrays
+    whose leading axis is the device axis)."""
+    return NamedSharding(mesh, P(axis)) if mesh is not None else None
+
+
+def _sharded_zeros(shape, dtype, mesh, axis):
+    z = jnp.zeros(shape, dtype)
+    sh = _partial_sharding(mesh, axis)
+    return jax.device_put(z, sh) if sh is not None else z
+
+
+def _shard_map_chunk(fn, mesh, axis, n_batch_args, acc_ndims):
+    """Wrap a per-shard chunk kernel in ``shard_map`` with NO collective:
+    batch args shard on ``axis`` (rows), accumulators carry a leading
+    device axis ([S, ...], sharded on it), ``w``-like leading args
+    replicate.
+
+    WHY: jit-over-sharded-inputs lets GSPMD insert an all-reduce into
+    every per-chunk program, and the streamed loops dispatch chunks
+    asynchronously (host syncs only at pass end). XLA:CPU's in-process
+    rendezvous deadlocks when ~64+ collective executions queue unsynced
+    (scripts/repro_cpu_collective_deadlock.py — 7 of 8 participants
+    arrive, SIGABRT; r4 contingency). Per-device partials make the
+    per-chunk program collective-free on EVERY backend; the single
+    cross-shard reduction happens once per pass in a reduce kernel whose
+    result the host consumes (and therefore syncs) immediately. On real
+    meshes this is also strictly less ICI traffic: one [d] all-reduce per
+    PASS instead of per chunk.
+
+    ``check_vma=False`` is load-bearing: under vma tracking the AD
+    transpose of "replicated w touches sharded rows" auto-inserts the
+    gradient's all-reduce inside the kernel (see
+    ``data_parallel.distributed_value_and_grad``'s comment), which would
+    put the per-chunk collective right back."""
+    in_specs = ((P(),)                      # w (or other replicated lead)
+                + (P(axis),) * n_batch_args
+                + tuple(P(axis, *([None] * (nd - 1)))
+                        for nd in acc_ndims))
+    out_specs = tuple(P(axis, *([None] * (nd - 1))) for nd in acc_ndims)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def _rebuild_batch(dim, indices, values, labels, offsets, weights
+                   ) -> LabeledBatch:
+    """Rebuild the chunk batch from flat leaves inside a kernel.
+    Implicit-ones chunks pass ``values=()`` — an EMPTY pytree, part of the
+    jit signature, so the two layouts never retrace each other; shard_map
+    specs stay simplest over flat array arguments."""
+    return LabeledBatch(
+        SparseFeatures(indices,
+                       None if isinstance(values, tuple) else values,
+                       dim=dim),
+        labels, offsets, weights)
+
+
+def _batch_args(dev: LabeledBatch):
+    """Flatten a device batch into the kernel's leaf arguments (the
+    inverse of :func:`_rebuild_batch`)."""
+    vals = dev.features.values
+    return (dev.features.indices, () if vals is None else vals,
+            dev.labels, dev.offsets, dev.weights)
+
+
+def _make_kahan_reduce():
+    """The once-per-pass cross-shard fold of [S, ...] Kahan partials —
+    the ONLY collective the sharded streamed paths ever run."""
+    return lambda acc, comp: jnp.sum(acc - comp, axis=0)
+
+
 def streaming_value_and_grad(
     objective: GLMObjective,
     chunks: Sequence[HostChunk],
@@ -189,42 +264,72 @@ def streaming_value_and_grad(
     """Returns fg(w, l2) -> (value, grad) computed in ONE streamed pass over
     the chunks: per-chunk partials accumulate on device, the next chunk's
     host->device transfer overlaps the current chunk's compute (async
-    dispatch + one-chunk lookahead). L2 is added once at the end."""
+    dispatch + one-chunk lookahead). L2 is added once at the end.
+
+    Distributed (``mesh``): the per-chunk kernel is COLLECTIVE-FREE — each
+    device accumulates its own Kahan partial under ``shard_map``; one
+    reduction per pass folds the [S]/[S, d] partials (see
+    ``_shard_map_chunk`` for why this matters on XLA:CPU and saves ICI
+    bandwidth on real meshes)."""
     sharding = None
     if mesh is not None:
         sharding = NamedSharding(mesh, P(axis))
+    S = _shard_width(mesh, axis)
 
     # cached per objective: a GAME CD loop re-enters fit_streaming every
     # iteration — a fresh jit here would recompile the chunk kernel each
     # time (same failure mode the fit_distributed runner cache fixes)
 
     def _make_chunk_fg():
-        def chunk_fg(w, batch, f_acc, f_comp, g_acc, g_comp):
+        def chunk_fg(w, indices, values, labels, offsets, weights,
+                     f_acc, f_comp, g_acc, g_comp):
+            batch = _rebuild_batch(dim, indices, values, labels, offsets,
+                                   weights)
             f, g = objective.value_and_grad(w, batch, 0.0)
-            f_acc, f_comp = _kahan_add(f_acc, f_comp, f)
-            g_acc, g_comp = _kahan_add(g_acc, g_comp, g)
+            f_acc, f_comp = _kahan_add(f_acc, f_comp,
+                                       jnp.reshape(f, f_acc.shape))
+            g_acc, g_comp = _kahan_add(g_acc, g_comp,
+                                       jnp.reshape(g, g_acc.shape))
             return f_acc, f_comp, g_acc, g_comp
-        return chunk_fg
 
-    chunk_fg = cached_jit(objective, ("stream_fg", mesh, axis),
-                          _make_chunk_fg)
+        if mesh is None:
+            return chunk_fg
+        return _shard_map_chunk(chunk_fg, mesh, axis, n_batch_args=5,
+                                acc_ndims=(1, 1, 2, 2))
+
+    def _make_reduce():
+        fold = _make_kahan_reduce()
+
+        def reduce_fg(f_acc, f_comp, g_acc, g_comp):
+            return fold(f_acc, f_comp), fold(g_acc, g_comp)
+        return reduce_fg
+
+    # dim is baked into the kernel closure (the batch rebuild), so it must
+    # be part of the cache key: same objective at a different width must
+    # not reuse a kernel with a stale dim
+    chunk_fg_k = cached_jit(objective, ("stream_fg", mesh, axis, dim),
+                            _make_chunk_fg)
+    reduce_k = cached_jit(objective, ("stream_fg_reduce", mesh, axis, dim),
+                          _make_reduce)
 
     def fg(w, l2=0.0):
         w = jnp.asarray(w, dtype)
-        acc = (jnp.zeros((), dtype), jnp.zeros((), dtype),
-               jnp.zeros((dim,), dtype), jnp.zeros((dim,), dtype))
+        acc = (_sharded_zeros((S,), dtype, mesh, axis),
+               _sharded_zeros((S,), dtype, mesh, axis),
+               _sharded_zeros((S, dim), dtype, mesh, axis),
+               _sharded_zeros((S, dim), dtype, mesh, axis))
         # one-chunk lookahead: transfer chunk i+1 while chunk i computes
         pending = None
         for chunk in chunks:
             dev = _chunk_to_device(chunk, dim, dtype, sharding)
             if pending is not None:
-                acc = chunk_fg(w, pending, *acc)
+                acc = chunk_fg_k(w, *_batch_args(pending), *acc)
             pending = dev
         if pending is not None:
-            acc = chunk_fg(w, pending, *acc)
-        # fold the compensations in before the cross-process reduction
-        # (comp is the accumulated EXCESS: subtract it)
-        f_acc, g_acc = acc[0] - acc[1], acc[2] - acc[3]
+            acc = chunk_fg_k(w, *_batch_args(pending), *acc)
+        # ONE cross-shard reduction per pass; its output is consumed by
+        # the host right away, so at most one collective is ever in flight
+        f_acc, g_acc = reduce_k(*acc)
         f_acc, g_acc = _cross_process_sum((f_acc, g_acc))
         wr = objective._reg_mask(w)
         l2 = jnp.asarray(l2, dtype)
@@ -243,23 +348,42 @@ def streaming_hvp(
 ) -> Callable:
     """Returns hvp(w, v, l2) computed in one streamed pass — the cost model
     of the reference's HessianVectorAggregator treeAggregate per CG step
-    (SURVEY.md §4.2), with chunks instead of cluster partitions."""
+    (SURVEY.md §4.2), with chunks instead of cluster partitions. Sharded:
+    collective-free per-device partials, one reduction per pass
+    (``_shard_map_chunk``)."""
     sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
+    S = _shard_width(mesh, axis)
 
-    chunk_hvp = cached_jit(
-        objective, ("stream_hvp", mesh, axis),
-        lambda: lambda w, v, batch, acc, comp: _kahan_add(
-            acc, comp, objective.hvp(w, v, batch, 0.0)))
+    def _make_chunk_hvp():
+        def chunk_hvp(wv, indices, values, labels, offsets, weights,
+                      acc, comp):
+            w, v = wv
+            batch = _rebuild_batch(dim, indices, values, labels, offsets,
+                                   weights)
+            hv = objective.hvp(w, v, batch, 0.0)
+            return _kahan_add(acc, comp, jnp.reshape(hv, acc.shape))
+
+        if mesh is None:
+            return chunk_hvp
+        return _shard_map_chunk(chunk_hvp, mesh, axis, n_batch_args=5,
+                                acc_ndims=(2, 2))
+
+    chunk_hvp_k = cached_jit(objective, ("stream_hvp", mesh, axis, dim),
+                             _make_chunk_hvp)
+    reduce_k = cached_jit(objective, ("stream_hvp_reduce", mesh, axis, dim),
+                          _make_kahan_reduce)
 
     def hvp(w, v, l2=0.0):
         w = jnp.asarray(w, dtype)
         v = jnp.asarray(v, dtype)
-        acc = comp = jnp.zeros((dim,), dtype)
+        acc = _sharded_zeros((S, dim), dtype, mesh, axis)
+        comp = _sharded_zeros((S, dim), dtype, mesh, axis)
         for chunk in chunks:
-            acc, comp = chunk_hvp(
-                w, v, _chunk_to_device(chunk, dim, dtype, sharding), acc, comp)
-        acc = _cross_process_sum(acc - comp)
-        return acc + jnp.asarray(l2, dtype) * objective._reg_mask(v)
+            dev = _chunk_to_device(chunk, dim, dtype, sharding)
+            acc, comp = chunk_hvp_k((w, v), *_batch_args(dev), acc, comp)
+        total = reduce_k(acc, comp)
+        total = _cross_process_sum(total)
+        return total + jnp.asarray(l2, dtype) * objective._reg_mask(v)
 
     return hvp
 
@@ -294,23 +418,40 @@ def streaming_hessian_diagonal(
     axis: str = "data",
 ) -> jax.Array:
     """Exact Hessian diagonal over one streamed (Kahan-compensated) pass —
-    shared by coefficient variances and TRON's Jacobi preconditioner."""
+    shared by coefficient variances and TRON's Jacobi preconditioner.
+    Sharded: collective-free per-device partials (``_shard_map_chunk``)."""
     sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
-    chunk_diag = cached_jit(
-        objective, ("stream_diag", mesh, axis),
-        lambda: lambda w, batch, acc, comp: _kahan_add(
-            acc, comp, objective.diagonal_hessian(w, batch, 0.0)))
+    S = _shard_width(mesh, axis)
+
+    def _make_chunk_diag():
+        def chunk_diag(w, indices, values, labels, offsets, weights,
+                       acc, comp):
+            batch = _rebuild_batch(dim, indices, values, labels, offsets,
+                                   weights)
+            d = objective.diagonal_hessian(w, batch, 0.0)
+            return _kahan_add(acc, comp, jnp.reshape(d, acc.shape))
+
+        if mesh is None:
+            return chunk_diag
+        return _shard_map_chunk(chunk_diag, mesh, axis, n_batch_args=5,
+                                acc_ndims=(2, 2))
+
+    chunk_diag_k = cached_jit(objective, ("stream_diag", mesh, axis, dim),
+                              _make_chunk_diag)
+    reduce_k = cached_jit(objective, ("stream_diag_reduce", mesh, axis, dim),
+                          _make_kahan_reduce)
 
     w = jnp.asarray(w, dtype)
-    acc = comp = jnp.zeros((dim,), dtype)
+    acc = _sharded_zeros((S, dim), dtype, mesh, axis)
+    comp = _sharded_zeros((S, dim), dtype, mesh, axis)
     for chunk in chunks:
-        acc, comp = chunk_diag(
-            w, _chunk_to_device(chunk, dim, dtype, sharding), acc, comp)
-    acc = _cross_process_sum(acc - comp)
+        dev = _chunk_to_device(chunk, dim, dtype, sharding)
+        acc, comp = chunk_diag_k(w, *_batch_args(dev), acc, comp)
+    total = _cross_process_sum(reduce_k(acc, comp))
     reg = jnp.full((dim,), jnp.asarray(l2, dtype))
     if not objective.regularize_intercept and objective.intercept_index >= 0:
         reg = reg.at[objective.intercept_index].set(0.0)
-    return acc + reg
+    return total + reg
 
 
 def fit_streaming(
@@ -533,8 +674,10 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
     # passes instead of one pass per trial.
     L = min(max(int(config.max_line_search_steps), 1), 8)
 
+    S = _shard_width(mesh, axis)
+
     def _make_trial():
-        def trial(mw, mp, labels, weights, alphas, f_acc, f_comp):
+        def trial(alphas, mw, mp, labels, weights, f_acc, f_comp):
             # DELTA space: per-row loss DIFFERENCES l(mw + a*mp) - l(mw).
             # In f32 a loss total's resolution is eps*|f|, far coarser
             # than late-stage improvements, so Armijo on totals stalls;
@@ -557,12 +700,23 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
                 return jnp.sum(apply_weights(
                     weights, objective.loss.loss(mm1, labels)) - l0)
 
-            return _kahan_add(f_acc, f_comp, jax.vmap(per_alpha)(alphas))
-        return trial
+            return _kahan_add(f_acc, f_comp,
+                              jnp.reshape(jax.vmap(per_alpha)(alphas),
+                                          f_acc.shape))
+
+        if mesh is None:
+            return trial
+        # collective-free per-device [1, L] partials (_shard_map_chunk:
+        # the async ladder loop must queue no rendezvous)
+        return _shard_map_chunk(trial, mesh, axis, n_batch_args=4,
+                                acc_ndims=(2, 2))
 
     trial_k = cached_jit(objective,
                          ("stream_trial_delta_ladder", mesh, axis, L),
                          _make_trial)
+    trial_reduce_k = cached_jit(
+        objective, ("stream_trial_reduce", mesh, axis, L),
+        _make_kahan_reduce)
 
     def _put(a):
         if not isinstance(a, jax.Array):
@@ -612,15 +766,18 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
     def phi_delta_ladder(mw_h, mp_h, alphas):
         """[L] data-term deltas f(w + a p) - f(w) for the whole
         backtracking ladder, in ONE margin-only streamed pass over the
-        HOST caches — no chunk (re-)decode, no sparse data."""
-        f_acc = f_comp = jnp.zeros((L,), dtype)
+        HOST caches — no chunk (re-)decode, no sparse data, and (sharded)
+        no per-chunk collective: per-device [S, L] partials reduce once
+        at the end, synced by the host fetch below."""
+        f_acc = _sharded_zeros((S, L), dtype, mesh, axis)
+        f_comp = _sharded_zeros((S, L), dtype, mesh, axis)
         a = jnp.asarray(alphas, dtype)
         for i in range(n_chunks):
             f_acc, f_comp = trial_k(
-                _put(mw_h[i]), _put(mp_h[i]),
+                a, _put(mw_h[i]), _put(mp_h[i]),
                 _put(labels_h[i]), _put(weights_h[i]),
-                a, f_acc, f_comp)
-        (d,) = _cross_process_sum((f_acc - f_comp,))
+                f_acc, f_comp)
+        (d,) = _cross_process_sum((trial_reduce_k(f_acc, f_comp),))
         return np.asarray(d, np.float64)
 
     direction, store_pair = _lbfgs_stream_kernels(objective, mesh, axis, m)

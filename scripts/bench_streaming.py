@@ -201,18 +201,14 @@ def main():
         tb.set_budget(total_mb=need_mb, single_mb=64.0,
                       label="bench_streaming")
 
-    n_chunks = -(-n // chunk_rows)
-    if mesh is not None and platform == "cpu" and n_chunks >= 64:
-        # measured r4: >=64 sequential sharded chunk executions deadlock
-        # XLA CPU's in-process all-reduce rendezvous on a 1-core box
-        # (7 of 8 participants arrive, the 8th never does — SIGABRT after
-        # the terminate timeout); 32 chunks run clean at the same shapes.
-        # Real multi-chip meshes are unaffected.
-        print(f"error: {n_chunks} chunks on a virtual CPU mesh deadlocks "
-              "XLA's in-process collectives (docs/PERF.md); raise "
-              "--chunk-rows to keep chunk count under 64", file=sys.stderr,
-              flush=True)
-        sys.exit(2)
+    # r5: the >=64-chunk refusal is GONE. Root cause (minimal repro in
+    # scripts/repro_cpu_collective_deadlock.py): async-dispatched sharded
+    # chunk programs each carried a GSPMD all-reduce, and XLA:CPU's
+    # in-process rendezvous loses a participant once ~64 collective
+    # executions queue unsynced. The per-chunk kernels are now
+    # collective-free (shard_map per-device partials, one reduction per
+    # pass — parallel/streaming._shard_map_chunk), so chunk count is
+    # unbounded on every backend.
 
     obj = make_objective("logistic")
     w0 = jnp.zeros((dim,), jnp.float32)

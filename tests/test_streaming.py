@@ -101,6 +101,62 @@ def test_fit_streaming_sharded_over_mesh(sparse_problem):
                                rtol=1e-6, atol=1e-9)
 
 
+def test_sharded_streaming_many_chunks_no_deadlock(rng):
+    """Regression for the r4 XLA:CPU in-process collective deadlock: >=64
+    async-dispatched sharded chunk executions lost a rendezvous participant
+    (SIGABRT) because every per-chunk program carried a GSPMD all-reduce.
+    The per-chunk kernels are now collective-free (shard_map per-device
+    partials, one reduction per pass — streaming._shard_map_chunk +
+    scripts/repro_cpu_collective_deadlock.py), so a 96-chunk sharded fit
+    must complete AND match the single-device fit."""
+    n, k, dim = 96 * 64, 5, 128
+    idx = rng.integers(0, dim, (n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k))
+    y = rng.integers(0, 2, n).astype(np.float64)
+    chunks, _ = make_host_chunks(HostSparse(idx, vals, dim), y,
+                                 chunk_rows=64)  # 96 chunks, 64 % 8 == 0
+    assert len(chunks) == 96
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=6, tolerance=0.0)
+    res_mesh = fit_streaming(obj, chunks, dim, l2=0.5, config=cfg,
+                             dtype=jnp.float64, mesh=make_mesh())
+    res_one = fit_streaming(obj, chunks, dim, l2=0.5, config=cfg,
+                            dtype=jnp.float64)
+    np.testing.assert_allclose(np.asarray(res_mesh.w),
+                               np.asarray(res_one.w), rtol=1e-7, atol=1e-10)
+
+
+def test_sharded_streaming_hvp_diag_many_chunks(rng):
+    """The TRON HVP and Hessian-diagonal streamed passes are also
+    collective-free per chunk; sharded == single-device over >64 chunks."""
+    from photon_ml_tpu.parallel.streaming import (
+        streaming_hessian_diagonal,
+        streaming_hvp,
+    )
+
+    n, k, dim = 80 * 64, 4, 64
+    idx = rng.integers(0, dim, (n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k))
+    y = rng.integers(0, 2, n).astype(np.float64)
+    chunks, _ = make_host_chunks(HostSparse(idx, vals, dim), y,
+                                 chunk_rows=64)
+    assert len(chunks) == 80
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=dim), jnp.float64)
+    v = jnp.asarray(rng.normal(size=dim), jnp.float64)
+    hvp_m = streaming_hvp(obj, chunks, dim, dtype=jnp.float64,
+                          mesh=make_mesh())(w, v, 0.3)
+    hvp_1 = streaming_hvp(obj, chunks, dim, dtype=jnp.float64)(w, v, 0.3)
+    np.testing.assert_allclose(np.asarray(hvp_m), np.asarray(hvp_1),
+                               rtol=1e-8, atol=1e-11)
+    d_m = streaming_hessian_diagonal(obj, chunks, dim, w, 0.3,
+                                     dtype=jnp.float64, mesh=make_mesh())
+    d_1 = streaming_hessian_diagonal(obj, chunks, dim, w, 0.3,
+                                     dtype=jnp.float64)
+    np.testing.assert_allclose(np.asarray(d_m), np.asarray(d_1),
+                               rtol=1e-8, atol=1e-11)
+
+
 def test_make_host_chunks_dense_and_padding():
     X = np.arange(12.0).reshape(6, 2)
     y = np.arange(6.0)

@@ -92,6 +92,65 @@ def test_fixed_fit_lowers_on_two_axis_game_mesh():
     assert exp.nr_devices == 8
 
 
+def test_streamed_chunk_kernels_lower_for_tpu_collective_free():
+    """The streamed per-chunk kernels (fg / hvp / diag / ladder trial)
+    must lower for TPU with ZERO collectives in the chunk program — the
+    per-device-partials design (streaming._shard_map_chunk) that fixed
+    the XLA:CPU rendezvous deadlock is also the one-all-reduce-per-pass
+    ICI cost model; a collective sneaking back in (e.g. check_vma
+    auto-psum) would silently restore both problems."""
+    from photon_ml_tpu.ops.losses import apply_weights, mask_margins  # noqa
+    from photon_ml_tpu.optimize import OptimizerConfig as Cfg
+    from photon_ml_tpu.parallel.data_parallel import cached_jit
+    from photon_ml_tpu.parallel.streaming import (
+        fit_streaming,
+        streaming_hessian_diagonal,
+        streaming_hvp,
+        streaming_value_and_grad,
+    )
+
+    obj = make_objective("logistic")
+    mesh = make_mesh({"data": 8})
+    rows = 256
+    # instantiate every cached kernel (empty chunk lists: the kernels are
+    # built before iteration, and lowering needs only their closures)
+    streaming_value_and_grad(obj, [], D, mesh=mesh)
+    streaming_hvp(obj, [], D, mesh=mesh)
+    streaming_hessian_diagonal(obj, [], D, jnp.zeros((D,)), mesh=mesh)
+    fit_streaming(obj, [], D, config=Cfg(max_iters=1, tolerance=0.0),
+                  mesh=mesh)  # builds the margin trial ladder kernel
+    s = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+
+    def assert_no_collective(exp, name):
+        mlir = exp.mlir_module()
+        for spelling in ("all_reduce", "all-reduce", "psum"):
+            assert spelling not in mlir, f"{name}: {spelling} found"
+
+    batch_args = (s((rows, K), i32), (), s((rows,), f32),
+                  s((rows,), f32), s((rows,), f32))
+    fg_k = cached_jit(obj, ("stream_fg", mesh, "data", D), lambda: None)
+    exp = export.export(fg_k, platforms=["tpu"])(
+        s((D,), f32), *batch_args,
+        s((8,), f32), s((8,), f32), s((8, D), f32), s((8, D), f32))
+    assert exp.nr_devices == 8
+    assert_no_collective(exp, "stream_fg")
+    hvp_k = cached_jit(obj, ("stream_hvp", mesh, "data", D), lambda: None)
+    assert_no_collective(export.export(hvp_k, platforms=["tpu"])(
+        (s((D,), f32), s((D,), f32)), *batch_args,
+        s((8, D), f32), s((8, D), f32)), "stream_hvp")
+    diag_k = cached_jit(obj, ("stream_diag", mesh, "data", D), lambda: None)
+    assert_no_collective(export.export(diag_k, platforms=["tpu"])(
+        s((D,), f32), *batch_args,
+        s((8, D), f32), s((8, D), f32)), "stream_diag")
+    L = 8  # default ladder width (min(max_line_search_steps, 8))
+    trial_k = cached_jit(obj, ("stream_trial_delta_ladder", mesh, "data", L),
+                         lambda: None)
+    assert_no_collective(export.export(trial_k, platforms=["tpu"])(
+        s((L,), f32), s((rows,), f32), s((rows,), f32), s((rows,), f32),
+        s((rows,), f32), s((8, L), f32), s((8, L), f32)), "stream_trial")
+
+
 def test_device_auc_evaluator_lowers_for_tpu():
     """The per-iteration device AUC (histogram form on a mesh, exact sort
     single-device) used for CD validation lowers for TPU."""
