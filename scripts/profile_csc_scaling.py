@@ -1,0 +1,201 @@
+"""Per-op attribution of the 8-way csc collapse (VERDICT r4 #5).
+
+The r4 scaling table shows the csc fixed-effect fit losing ~3x going
+1 -> 8 virtual devices on the 1-core box while scatter holds; the r4
+hypothesis ("fixed per-shard combine overhead vs shrinking per-shard
+nnz") was never verified. This harness times each component of a fit
+iteration per mesh width, in subprocesses (the host device count is
+fixed at backend init), so the collapse is attributed to a specific op
+instead of a story:
+
+- ``dispatch``   — an empty shard_map program: per-execution runtime floor
+                   (thread hops per device on a 1-core host).
+- ``psum``       — dispatch + a [dim] all-reduce: collective floor.
+- ``margins``    — the forward gather pass only.
+- ``transpose``  — apply_t (the blocked cumsum combine) only, from a
+                   prebuilt per-shard CSC view.
+- ``fg``         — the full csc value+grad program.
+- ``fit_iter``   — a full L-BFGS fit divided by its iteration count.
+
+Usage: python scripts/profile_csc_scaling.py [--rows-log2 15] [--dim-log2 13]
+       [--reps 30] [--block N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = r"""
+import json, os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import functools
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+n_dev = int(os.environ["PROF_N_DEV"])
+reps = int(os.environ["PROF_REPS"])
+rows_log2 = int(os.environ["PROF_ROWS_LOG2"])
+dim_log2 = int(os.environ["PROF_DIM_LOG2"])
+block = int(os.environ["PROF_BLOCK"])
+assert len(jax.devices()) == n_dev
+
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.parallel.data_parallel import build_csc, fit_distributed
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import (LabeledBatch, SparseFeatures,
+                                 build_csc_transpose, csc_transpose_apply)
+
+n_rows, dim, k, iters = 1 << rows_log2, 1 << dim_log2, 24, 8
+rng = np.random.default_rng(0)
+indices = jnp.asarray(rng.integers(0, dim, (n_rows, k)), jnp.int32)
+values = jnp.ones((n_rows, k), jnp.float32)
+labels = jnp.asarray(rng.integers(0, 2, n_rows), jnp.float32)
+batch = LabeledBatch(SparseFeatures(indices, values, dim=dim), labels,
+                     jnp.zeros((n_rows,), jnp.float32),
+                     jnp.ones((n_rows,), jnp.float32))
+mesh = make_mesh({"data": n_dev})
+obj = make_objective("logistic")
+w = jnp.zeros((dim,), jnp.float32)
+d_full = jnp.asarray(rng.normal(size=n_rows), jnp.float32)
+
+def timeit(fn, *args):
+    fn(*args)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.tree.map(lambda a: a.block_until_ready(), out)
+    return (time.perf_counter() - t0) / reps * 1e3  # ms
+
+out = {"n_dev": n_dev, "per_shard_nnz": n_rows * k // n_dev}
+sm = functools.partial(jax.shard_map, mesh=mesh)
+
+# 1. empty sharded program: per-execution dispatch floor
+@jax.jit
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=P("data"),
+                   out_specs=P("data"))
+def empty(x):
+    return x + 1.0
+xs = jax.device_put(jnp.zeros((n_dev,), jnp.float32),
+                    NamedSharding(mesh, P("data")))
+out["dispatch_ms"] = timeit(empty, xs)
+
+# 2. psum floor: [dim] all-reduce
+@jax.jit
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=P("data"),
+                   out_specs=P())
+def psum_prog(x):
+    return jax.lax.psum(jnp.zeros((dim,), jnp.float32) + x[0], "data")
+out["psum_ms"] = timeit(psum_prog, xs)
+
+shard_rows = NamedSharding(mesh, P("data"))
+batch_sh = jax.device_put(batch, shard_rows)
+d_sh = jax.device_put(d_full, shard_rows)
+
+# 3. margins: forward gather only
+@jax.jit
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(), P("data")),
+                   out_specs=P("data"))
+def margins(w, b):
+    return obj.margins(w, b)
+out["margins_ms"] = timeit(margins, w, batch_sh)
+
+# 4. transpose apply only (per-shard csc built once, outside the timer)
+@jax.jit
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=P("data"),
+                   out_specs=P("data"))
+def build_shard_csc(b):
+    csc = build_csc_transpose(b.features.indices, b.features.values, dim)
+    return jax.tree.map(lambda a: a[None], csc)
+csc_sh = build_shard_csc(batch_sh)
+
+@jax.jit
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P("data"), P("data")), out_specs=P())
+def transpose_only(csc_s, d):
+    csc = jax.tree.map(lambda a: a[0], csc_s)
+    g = csc_transpose_apply(csc, d, block=block)
+    return jax.lax.psum(g, "data")
+out["transpose_ms"] = timeit(transpose_only, csc_sh, d_sh)
+
+# 4b. the same WITHOUT the psum (combine cost alone, per-shard outputs)
+@jax.jit
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P("data"), P("data")), out_specs=P("data"))
+def transpose_nopsum(csc_s, d):
+    csc = jax.tree.map(lambda a: a[0], csc_s)
+    return csc_transpose_apply(csc, d, block=block)[None]
+out["transpose_nopsum_ms"] = timeit(transpose_nopsum, csc_sh, d_sh)
+
+# 5. full csc fg
+from photon_ml_tpu.parallel.data_parallel import make_csc_path
+csc_glob = build_csc(obj, batch, mesh)
+fg = make_csc_path(obj, mesh)[1]
+fg_j = jax.jit(lambda w, b, c: fg(w, b, c, 1.0))
+out["fg_ms"] = timeit(fg_j, w, batch_sh, csc_glob)
+
+# 6. full fit / iteration
+cfg = OptimizerConfig(max_iters=iters, tolerance=0.0)
+def fit():
+    r = fit_distributed(obj, batch, mesh, w, l2=1.0, config=cfg,
+                        sparse_grad="csc", precomputed_csc=csc_glob)
+    jax.block_until_ready(r.w)
+    return r
+fit()
+t0 = time.perf_counter(); fit(); dt = time.perf_counter() - t0
+out["fit_iter_ms"] = round(dt / iters * 1e3, 3)
+out["fit_rows_per_s"] = round(n_rows * iters / dt, 1)
+for kk in list(out):
+    if kk.endswith("_ms"):
+        out[kk] = round(out[kk], 3)
+print("PROF_RESULT " + json.dumps(out))
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows-log2", type=int, default=15)
+    ap.add_argument("--dim-log2", type=int, default=13)
+    ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--block", type=int, default=1 << 16)
+    ap.add_argument("--widths", default="1,2,4,8")
+    args = ap.parse_args()
+
+    rows = []
+    for n_dev in [int(w) for w in args.widths.split(",")]:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=f"--xla_force_host_platform_device_count={n_dev}",
+                   PROF_N_DEV=str(n_dev), PROF_REPS=str(args.reps),
+                   PROF_ROWS_LOG2=str(args.rows_log2),
+                   PROF_DIM_LOG2=str(args.dim_log2),
+                   PROF_BLOCK=str(args.block),
+                   PYTHONPATH=os.path.dirname(
+                       os.path.dirname(os.path.abspath(__file__))))
+        proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                              capture_output=True, text=True, timeout=1800)
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("PROF_RESULT ")]
+        if not line:
+            print(f"n_dev={n_dev} FAILED:\n{proc.stderr[-2000:]}",
+                  file=sys.stderr)
+            continue
+        rows.append(json.loads(line[0][len("PROF_RESULT "):]))
+
+    cols = ["n_dev", "per_shard_nnz", "dispatch_ms", "psum_ms",
+            "margins_ms", "transpose_nopsum_ms", "transpose_ms", "fg_ms",
+            "fit_iter_ms", "fit_rows_per_s"]
+    print("\t".join(cols))
+    for r in rows:
+        print("\t".join(str(r.get(c, "-")) for c in cols))
+
+
+if __name__ == "__main__":
+    main()
