@@ -215,6 +215,50 @@ def test_fit_distributed_implicit_ones(rng, mesh):
                                    err_msg=mode)
 
 
+@pytest.mark.parametrize("mode", ["csc", "csc_segment", "csc_pallas"])
+def test_csc_modes_single_vs_eight_device_equivalence(rng, mesh, mode):
+    """Every dryrun sparse-gradient variant asserted allclose between a
+    1-device and the 8-device mesh — not merely finite (VERDICT r4 #6).
+    Covers the margin line search WITH a precomputed csc on both widths,
+    the exact headline-bench configuration."""
+    from photon_ml_tpu.parallel.data_parallel import build_csc
+
+    batch, X, y = _problem(rng, sparse=True)
+    d = X.shape[1]
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-10)
+    mesh1 = make_mesh({"data": 1})
+    res = {}
+    for name, m in (("one", mesh1), ("eight", mesh)):
+        csc = build_csc(obj, batch, m)
+        res[name] = fit_distributed(obj, batch, m, jnp.zeros(d), l2=0.5,
+                                    config=cfg, sparse_grad=mode,
+                                    precomputed_csc=csc,
+                                    line_search="margin")
+    np.testing.assert_allclose(res["eight"].w, res["one"].w,
+                               rtol=1e-6, atol=1e-9, err_msg=mode)
+    np.testing.assert_allclose(res["eight"].value, res["one"].value,
+                               rtol=1e-9, err_msg=mode)
+
+
+@pytest.mark.parametrize("optimizer", ["tron", "owlqn"])
+def test_tron_owlqn_single_vs_eight_device_sparse(rng, mesh, optimizer):
+    """TRON and OWL-QN on SPARSE data: 1-device mesh == 8-device mesh
+    (the dense variants are covered against the raw single-device
+    optimizers above; the dryrun exercises these on sparse batches)."""
+    batch, X, y = _problem(rng, sparse=True)
+    d = X.shape[1]
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=80, tolerance=1e-10)
+    l1 = 0.3 if optimizer == "owlqn" else 0.0
+    r1 = fit_distributed(obj, batch, make_mesh({"data": 1}), jnp.zeros(d),
+                         l2=0.5, l1=l1, optimizer=optimizer, config=cfg)
+    r8 = fit_distributed(obj, batch, mesh, jnp.zeros(d),
+                         l2=0.5, l1=l1, optimizer=optimizer, config=cfg)
+    np.testing.assert_allclose(r8.w, r1.w, rtol=1e-6, atol=1e-9)
+    np.testing.assert_allclose(r8.value, r1.value, rtol=1e-9)
+
+
 def test_fit_runner_compilation_reused(rng, mesh):
     """Repeated fit_distributed calls (same objective/config, different l2
     or data) must reuse ONE jitted runner — round 2's per-call
