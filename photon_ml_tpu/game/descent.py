@@ -754,27 +754,28 @@ class CoordinateDescent:
                 coeffs = []
                 for bucket in st.train_data.buckets:
                     W = np.zeros((bucket.num_entities, bucket.local_dim))
+                    # one dict probe per entity; ALL slot remapping below is
+                    # numpy group ops (VERDICT r4 #7: the per-entity x
+                    # per-slot Python loops were O(minutes) at the survey's
+                    # thousands-to-millions-of-entities scale)
+                    rows, pbs, prs = [], [], []
                     for r, eid in enumerate(bucket.entity_ids):
                         slot = prev_index.get(eid)
                         if slot is None:  # loaded models key entities as str
                             slot = prev_index.get(str(eid))
-                        if slot is None:
-                            continue
-                        pb, pr = slot
-                        prev_bucket = prev.buckets[pb]
-                        prev_coef = np.asarray(prev_bucket.coefficients[pr])
-                        lm = bucket.local_maps[r]
-                        if prev_bucket.sketch is not None:
-                            # sketched spaces line up only when the sketch is
-                            # identical; otherwise start that entity cold
-                            if (isinstance(lm, SketchProjection)
-                                    and lm == prev_bucket.sketch):
-                                W[r, : len(prev_coef)] = prev_coef
-                            continue
-                        prev_proj = np.asarray(prev_bucket.projection[pr])
-                        for slot_local, gid in enumerate(prev_proj):
-                            if gid >= 0 and int(gid) in lm:
-                                W[r, lm[int(gid)]] = prev_coef[slot_local]
+                        if slot is not None:
+                            rows.append(r)
+                            pbs.append(slot[0])
+                            prs.append(slot[1])
+                    if rows:
+                        rows_a = np.asarray(rows)
+                        pbs_a = np.asarray(pbs)
+                        prs_a = np.asarray(prs)
+                        for pb in np.unique(pbs_a):
+                            sel = pbs_a == pb
+                            _warm_fill_bucket(W, bucket, rows_a[sel],
+                                              prev.buckets[int(pb)],
+                                              prs_a[sel])
                     coeffs.append(W)
                 st.coeffs = coeffs
                 scores[cfg.name] = score_random_effect(
@@ -784,3 +785,56 @@ class CoordinateDescent:
                     val_scores[cfg.name] = score_random_effect(
                         val_states[cfg.name], coeffs, validation.num_samples, self.dtype
                     )
+
+
+def _warm_fill_bucket(W, bucket, rows, prev_bucket, prs) -> None:
+    """Vectorized warm-start slot remap for one (current-bucket,
+    previous-bucket) entity group: ``W[rows]`` receives the previous
+    coefficients of rows ``prs`` of ``prev_bucket``, re-addressed from the
+    previous per-entity subspaces to the current ones.
+
+    The remap is a composite-key join (entity-local row id * 2^32 +
+    global feature id; projection slots hold int32 ids so keys cannot
+    collide) between the previous and current projection arrays — no
+    per-entity or per-slot Python. Sketched cases: identical sketches
+    copy rows wholesale; a previous EXACT subspace warm-starts a sketched
+    current coordinate by pushing each (gid, coef) through the sketch
+    (the projector's own embedding — collisions sum, like any count
+    sketch); a previous sketch cannot be inverted into an exact subspace,
+    so those entities start cold."""
+    cur_lm0 = bucket.local_maps[0] if bucket.num_entities else None
+    cur_sketched = isinstance(cur_lm0, SketchProjection)
+    C = np.asarray(prev_bucket.coefficients)[prs]        # [M, Dp]
+    if prev_bucket.sketch is not None:
+        if cur_sketched and cur_lm0 == prev_bucket.sketch:
+            W[rows, : C.shape[1]] = C
+        return
+    P = np.asarray(prev_bucket.projection)[prs]          # [M, Dp] gids, -1 pad
+    valid_p = (P >= 0) & (C != 0)
+    if cur_sketched:
+        slots, signs = cur_lm0.slots_signs(np.maximum(P, 0).ravel())
+        flat = valid_p.ravel()
+        np.add.at(
+            W,
+            (np.repeat(rows, P.shape[1])[flat], slots[flat]),
+            (C.ravel() * signs)[flat],
+        )
+        return
+    curP = np.asarray(bucket.projection)[rows]           # [M, Dc] gids, -1 pad
+    M, Dp = P.shape
+    Dc = curP.shape[1]
+    BIG = np.int64(1) << 32
+    m_ids = np.arange(M, dtype=np.int64)
+    kp = (m_ids[:, None] * BIG + P).ravel()[valid_p.ravel()]
+    cvals = C.ravel()[valid_p.ravel()]
+    if not len(kp):
+        return
+    order = np.argsort(kp)
+    kp, cvals = kp[order], cvals[order]
+    valid_c = (curP >= 0).ravel()
+    kc = (m_ids[:, None] * BIG + curP).ravel()[valid_c]
+    pos = np.minimum(np.searchsorted(kp, kc), len(kp) - 1)
+    hit = kp[pos] == kc
+    rows_flat = np.repeat(rows, Dc)[valid_c]
+    slots_flat = np.tile(np.arange(Dc), M)[valid_c]
+    W[rows_flat[hit], slots_flat[hit]] = cvals[pos[hit]]

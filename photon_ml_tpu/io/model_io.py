@@ -233,16 +233,21 @@ def _rebuild_random_effect(name, records, imap: IndexMap, task, shard,
         E, D = len(members), max(size, 1)
         proj = np.full((E, D), -1, np.int32)
         coefs = np.zeros((E, D))
+        eids = [m[0] for m in members]
+        if size:
+            # every member of a bucket has exactly `size` support ids, so
+            # the fill is two stacks, not a per-entity Python loop
+            # (VERDICT r4 #7 — model load at 100k+ entities)
+            proj[:, :size] = np.stack([m[1] for m in members])
+            coefs[:, :size] = np.stack([m[2] for m in members])
         has_var = any(m[3] for m in members)
-        variances = np.zeros((E, D)) if has_var else None
-        eids = []
-        for r, (eid, ids, vals, var) in enumerate(members):
-            proj[r, : len(ids)] = ids
-            coefs[r, : len(ids)] = vals
-            if has_var:
-                for slot, gid in enumerate(ids):
-                    variances[r, slot] = var.get(int(gid), 0.0)
-            eids.append(eid)
+        variances = None
+        if has_var:
+            variances = np.zeros((E, D))
+            if size:
+                variances[:, :size] = np.stack([
+                    [m[3].get(int(g), 0.0) for g in m[1]] for m in members
+                ])
         buckets.append(RandomEffectBucket(eids, coefs, proj, variances))
     return RandomEffectModel(name, buckets, task, shard, entity_column=entity_column)
 

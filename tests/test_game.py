@@ -466,3 +466,99 @@ def test_re_optimizer_auto_resolves_per_platform(rng):
     for b in range(len(f_lb.coefficients)):
         np.testing.assert_array_equal(f_auto.coefficients[b],
                                       f_lb.coefficients[b])
+
+
+def test_warm_fill_bucket_vectorized_matches_loop_and_scales(rng):
+    """The warm-start slot remap is a numpy composite-key join, not a
+    per-entity/per-slot Python loop (VERDICT r4 #7): it must match the
+    straightforward loop on a small case AND warm-start 100k entities
+    well under 2s."""
+    import time
+
+    from photon_ml_tpu.game.descent import _warm_fill_bucket
+    from photon_ml_tpu.models import RandomEffectBucket
+
+    def make_pair(E, D_prev, D_cur, gid_space):
+        prev_proj = np.full((E, D_prev), -1, np.int32)
+        cur_proj = np.full((E, D_cur), -1, np.int32)
+        for r in range(E):
+            gids = rng.choice(gid_space, size=D_prev + D_cur // 2,
+                              replace=False)
+            prev_proj[r] = np.sort(gids[:D_prev])
+            # current subspace overlaps ~half the previous one
+            cur = np.concatenate([gids[D_prev // 2: D_prev],
+                                  gids[D_prev:]])[:D_cur]
+            cur_proj[r, : len(cur)] = np.sort(cur)
+        coefs = rng.normal(size=(E, D_prev))
+        return prev_proj, cur_proj, coefs
+
+    # correctness vs the reference loop
+    E, Dp, Dc = 40, 6, 8
+    prev_proj, cur_proj, coefs = make_pair(E, Dp, Dc, 200)
+    prev_bucket = RandomEffectBucket([f"e{i}" for i in range(E)],
+                                     coefs, prev_proj)
+    local_maps = [{int(g): s for s, g in enumerate(cur_proj[r])
+                   if g >= 0} for r in range(E)]
+    bucket = type("B", (), {})()
+    bucket.num_entities = E
+    bucket.local_maps = local_maps
+    bucket.projection = cur_proj
+    rows = np.arange(E)
+    prs = rng.permutation(E)
+    W = np.zeros((E, Dc))
+    _warm_fill_bucket(W, bucket, rows, prev_bucket, prs)
+    W_ref = np.zeros((E, Dc))
+    for r in range(E):
+        pr = prs[r]
+        for slot, gid in enumerate(prev_proj[pr]):
+            if gid >= 0 and int(gid) in local_maps[r]:
+                W_ref[r, local_maps[r][int(gid)]] = coefs[pr, slot]
+    np.testing.assert_allclose(W, W_ref)
+
+    # scale: 100k entities x 16 slots in well under 2s
+    E, Dp, Dc = 100_000, 16, 16
+    prev_proj = rng.integers(0, 1 << 20, (E, Dp)).astype(np.int32)
+    prev_proj.sort(axis=1)
+    prs = rng.permutation(E)
+    # each current row carries its MATCHED prev row's subspace: every slot
+    # should remap
+    cur_proj = prev_proj[prs]
+    coefs = rng.normal(size=(E, Dp))
+    prev_bucket = RandomEffectBucket(np.arange(E), coefs, prev_proj)
+    bucket = type("B", (), {})()
+    bucket.num_entities = E
+    bucket.local_maps = [None]  # only [0] is touched, for the sketch check
+    bucket.projection = cur_proj
+    W = np.zeros((E, Dc))
+    t0 = time.perf_counter()
+    _warm_fill_bucket(W, bucket, np.arange(E), prev_bucket, prs)
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"warm-fill at 100k entities took {dt:.2f}s"
+    assert np.count_nonzero(W) > 0.99 * E * Dp
+
+
+def test_warm_start_prev_subspace_into_sketch(rng):
+    """A previous exact-subspace model warm-starts a sketched coordinate by
+    pushing (gid, coef) through the sketch (the projector's own embedding);
+    the old per-slot loop raised TypeError on this path."""
+    from photon_ml_tpu.game.data import SketchProjection
+    from photon_ml_tpu.game.descent import _warm_fill_bucket
+    from photon_ml_tpu.models import RandomEffectBucket
+
+    E, Dp, dim = 10, 4, 32
+    sketch = SketchProjection(dim, seed=3)
+    prev_proj = rng.integers(0, 1000, (E, Dp)).astype(np.int32)
+    coefs = rng.normal(size=(E, Dp))
+    prev_bucket = RandomEffectBucket(np.arange(E), coefs, prev_proj)
+    bucket = type("B", (), {})()
+    bucket.num_entities = E
+    bucket.local_maps = [sketch] * E
+    bucket.projection = np.full((E, dim), -1, np.int32)
+    W = np.zeros((E, dim))
+    _warm_fill_bucket(W, bucket, np.arange(E), prev_bucket, np.arange(E))
+    for r in range(3):  # spot-check the embedding
+        expect = np.zeros(dim)
+        slots, signs = sketch.slots_signs(prev_proj[r])
+        for j in range(Dp):
+            expect[slots[j]] += signs[j] * coefs[r, j]
+        np.testing.assert_allclose(W[r], expect, rtol=1e-12)
