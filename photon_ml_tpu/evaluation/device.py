@@ -127,7 +127,119 @@ def make_device_evaluator(name: str, mesh=None):
             return jnp.where(z <= 0, 0.5 - z,
                              jnp.where(z < 1, 0.5 * (1 - z) ** 2, 0.0))
         return wmean(point)
+    if key.startswith("precision_at_"):
+        k = int(key[len("precision_at_"):])
+
+        def pk(scores, labels, weights):
+            kk = min(k, scores.shape[0])  # static at trace time
+            _, idx = jax.lax.top_k(scores, kk)
+            # ties at the k boundary may resolve differently than the host
+            # mergesort — monitoring only; finals are host f64
+            return jnp.mean((labels[idx] > 0.5).astype(scores.dtype))
+        return jax.jit(pk)
     return None
+
+
+def _finite_mean(vals):
+    """Mean over finite entries (nan when none) — the grouped evaluators'
+    aggregation rule (``evaluators.Evaluator.evaluate``)."""
+    ok = jnp.isfinite(vals)
+    cnt = jnp.sum(ok)
+    return jnp.where(cnt > 0,
+                     jnp.sum(jnp.where(ok, vals, 0.0)) / cnt, jnp.nan)
+
+
+def make_grouped_device_evaluator(name: str, group_ids, mesh=None):
+    """Device form of a ``per_group_*`` evaluator, closed over the
+    factorized group ids (static per validation set — factorization
+    happens ONCE on host; every CD iteration then runs segment ops on
+    device with no score-vector round trip, VERDICT r4 #8). Returns
+    ``(scores, labels, weights) -> device scalar`` mirroring the host
+    ``grouped_fn`` + finite-mean aggregation exactly, or None when the
+    metric has no grouped device form."""
+    import numpy as np
+
+    key = name.lower()
+    if not key.startswith("per_group_"):
+        return None
+    inner = key[len("per_group_"):]
+    _, inv_np = np.unique(np.asarray(group_ids), return_inverse=True)
+    G = int(inv_np.max()) + 1 if len(inv_np) else 0
+    if G == 0:
+        return None
+    inv = jnp.asarray(inv_np, jnp.int32)
+    seg = partial(jax.ops.segment_sum, segment_ids=inv, num_segments=G)
+
+    if inner == "auc":
+        @jax.jit
+        def grouped_auc_dev(scores, labels, weights):
+            # one lexsort by (group, score) then segment ops — the exact
+            # device mirror of evaluators.grouped_auc
+            order = jnp.lexsort((scores, inv))
+            g, s, w = inv[order], scores[order], weights[order]
+            p = labels[order] > 0.5
+            w_grp = jax.ops.segment_sum(w, g, num_segments=G)
+            before = jnp.concatenate(
+                (jnp.zeros((1,), w.dtype), jnp.cumsum(w_grp)[:-1]))
+            ranks = jnp.cumsum(w) - before[g] - w / 2.0
+            n = s.shape[0]
+            block_start = jnp.concatenate(
+                (jnp.ones((1,), bool), (g[1:] != g[:-1]) | (s[1:] != s[:-1])))
+            block_id = jnp.cumsum(block_start) - 1
+            block_w = jnp.zeros(n, w.dtype).at[block_id].add(w)
+            block_rw = jnp.zeros(n, w.dtype).at[block_id].add(ranks * w)
+            ranks = (block_rw / block_w)[block_id]
+            w_pos = jax.ops.segment_sum(jnp.where(p, w, 0.0), g,
+                                        num_segments=G)
+            w_neg = jax.ops.segment_sum(jnp.where(p, 0.0, w), g,
+                                        num_segments=G)
+            r_pos = jax.ops.segment_sum(jnp.where(p, w * ranks, 0.0), g,
+                                        num_segments=G)
+            vals = (r_pos - w_pos * w_pos / 2.0) / (w_pos * w_neg)
+            vals = jnp.where((w_pos > 0) & (w_neg > 0), vals, jnp.nan)
+            return _finite_mean(vals)
+        return grouped_auc_dev
+
+    if inner.startswith("precision_at_"):
+        k = int(inner[len("precision_at_"):])
+
+        @jax.jit
+        def grouped_pk_dev(scores, labels, weights):
+            order = jnp.lexsort((-scores, inv))
+            g, lab = inv[order], labels[order]
+            counts = jax.ops.segment_sum(jnp.ones_like(scores), g,
+                                         num_segments=G)
+            starts = jnp.concatenate(
+                (jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]))
+            rank = jnp.arange(g.shape[0]) - starts[g]
+            top = rank < k
+            hits = jax.ops.segment_sum(
+                jnp.where(top, (lab > 0.5).astype(scores.dtype), 0.0), g,
+                num_segments=G)
+            return _finite_mean(hits / jnp.minimum(counts, float(k)))
+        return grouped_pk_dev
+
+    pointwise = {
+        "rmse": lambda s, l: (s - l) ** 2,
+        "logistic_loss": lambda s, l: jnp.logaddexp(0.0, s) - l * s,
+        "poisson_loss": lambda s, l: jnp.exp(s) - l * s,
+        "squared_loss": lambda s, l: 0.5 * (s - l) ** 2,
+        "smoothed_hinge_loss": lambda s, l: jnp.where(
+            (z := (2.0 * l - 1.0) * s) <= 0, 0.5 - z,
+            jnp.where(z < 1, 0.5 * (1 - z) ** 2, 0.0)),
+    }.get(inner)
+    if pointwise is None:
+        return None
+    post = jnp.sqrt if inner == "rmse" else (lambda x: x)
+
+    @jax.jit
+    def grouped_mean_dev(scores, labels, weights):
+        num = seg(weights * pointwise(scores, labels))
+        den = seg(weights)
+        vals = post(num / den)
+        return _finite_mean(jnp.where(den > 0, vals, jnp.nan))
+
+    return grouped_mean_dev
 
 
 def histogram_auc(scores, labels, weights=None, n_bins=4096, mesh=None,

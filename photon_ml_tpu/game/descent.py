@@ -601,15 +601,26 @@ class CoordinateDescent:
         # recomputed once for the final history record below.
         device_evals: dict = {}
         if validation is not None and evaluators:
-            from photon_ml_tpu.evaluation.device import make_device_evaluator
+            from photon_ml_tpu.evaluation.device import (
+                make_device_evaluator,
+                make_grouped_device_evaluator,
+            )
 
             data_mesh = (self.mesh if self.mesh is not None
                          and "data" in self.mesh.shape
                          and self.mesh.shape["data"] > 1 else None)
             for ev in evaluators:
-                device_evals[ev.name] = (
-                    None if ev.grouped
-                    else make_device_evaluator(ev.name, data_mesh))
+                if ev.grouped:
+                    # grouped metrics run as device segment ops over the
+                    # once-factorized group ids — no full score-vector
+                    # host round trip per CD iteration (VERDICT r4 #8)
+                    device_evals[ev.name] = (
+                        None if validation.group_ids is None
+                        else make_grouped_device_evaluator(
+                            ev.name, validation.group_ids))
+                else:
+                    device_evals[ev.name] = make_device_evaluator(
+                        ev.name, data_mesh)
             val_labels_dev = jnp.asarray(validation.labels, dtype)
             val_weights_dev = jnp.asarray(validation.weights, dtype)
             val_offsets_dev = jnp.asarray(validation.offsets, dtype)

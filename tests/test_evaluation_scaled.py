@@ -3,6 +3,7 @@ per-group loop) and on-device / histogram AUC parity."""
 
 import time
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -199,3 +200,91 @@ def test_cd_loop_device_metrics_match_host(rng):
     # formula regressions in the device path)
     assert abs(history[0]["auc"] - history[-1]["auc"]) < 1e-4
     assert abs(history[0]["logistic_loss"] - history[-1]["logistic_loss"]) < 1e-4
+
+
+@pytest.mark.parametrize("name", [
+    "per_group_auc", "per_group_rmse", "per_group_logistic_loss",
+    "per_group_poisson_loss", "per_group_squared_loss",
+    "per_group_smoothed_hinge_loss", "per_group_precision_at_3",
+])
+def test_grouped_device_evaluator_matches_host(rng, name):
+    """Device-side grouped evaluators (segment ops over once-factorized
+    group ids — VERDICT r4 #8) must match the host f64 references,
+    including tie handling and single-class-group nan exclusion."""
+    from photon_ml_tpu.evaluation import get_evaluator
+    from photon_ml_tpu.evaluation.device import make_grouped_device_evaluator
+
+    n = 600
+    scores = np.round(rng.normal(size=n), 1)  # coarse: force score ties
+    labels = (rng.random(n) < 0.5).astype(np.float64)
+    weights = rng.uniform(0.5, 2.0, n)
+    groups = rng.integers(0, 37, n)
+    groups[groups == 5] = 6  # a missing raw id: factorization must handle
+    # one single-class group: must be excluded exactly like the host
+    labels[groups == 7] = 1.0
+
+    host = get_evaluator(name).evaluate(scores, labels, weights, groups)
+    fn = make_grouped_device_evaluator(name, groups)
+    assert fn is not None
+    dev = float(fn(jnp.asarray(scores, jnp.float64),
+                   jnp.asarray(labels, jnp.float64),
+                   jnp.asarray(weights, jnp.float64)))
+    np.testing.assert_allclose(dev, host, rtol=1e-10)
+
+
+def test_precision_at_k_device_form(rng):
+    from photon_ml_tpu.evaluation import get_evaluator
+    from photon_ml_tpu.evaluation.device import make_device_evaluator
+
+    n = 200
+    scores = rng.normal(size=n)  # unique scores: tie-break parity is exact
+    labels = (rng.random(n) < 0.4).astype(np.float64)
+    host = get_evaluator("precision_at_10").evaluate(scores, labels)
+    fn = make_device_evaluator("precision_at_10")
+    dev = float(fn(jnp.asarray(scores), jnp.asarray(labels),
+                   jnp.ones(n)))
+    np.testing.assert_allclose(dev, host, rtol=1e-12)
+
+
+def test_cd_loop_uses_device_grouped_evaluator(rng):
+    """With a per_group_* evaluator configured, every per-iteration record
+    must come from the device path (no host numpy fallback), and the final
+    record must match the host f64 reference."""
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        GameDataset,
+    )
+    from photon_ml_tpu.game.data import HostSparse
+
+    n, d = 400, 10
+    X = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-X @ w_true))).astype(float)
+    idx = np.broadcast_to(np.arange(d, dtype=np.int32), (n, d)).copy()
+    groups = rng.integers(0, 8, n)
+    mk = lambda s: GameDataset(
+        {"global": HostSparse(idx[s], X[s], d)}, y[s], None, None,
+        {}, group_ids=groups[s])
+    tr, va = mk(slice(0, 300)), mk(slice(300, None))
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", "fixed", max_iters=15)],
+        n_iterations=2, evaluators=["per_group_auc"])
+    import photon_ml_tpu.evaluation.evaluators as hev
+    calls = {"n": 0}
+    orig = hev.Evaluator.evaluate
+
+    def spy(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+
+    hev.Evaluator.evaluate = spy
+    try:
+        model, history = cd.run(tr, validation=va)
+    finally:
+        hev.Evaluator.evaluate = orig
+    per_iter = [h for h in history if "per_group_auc" in h]
+    assert len(per_iter) == 2
+    # host evaluator ran ONLY for the definitive final record
+    assert calls["n"] == 1
+    assert np.isfinite(history[-1]["per_group_auc"])

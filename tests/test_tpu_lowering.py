@@ -162,3 +162,21 @@ def test_device_auc_evaluator_lowers_for_tpu():
     exp = export.export(jax.jit(fn), platforms=["tpu"])(
         s((N,), jnp.float32), s((N,), jnp.float32), s((N,), jnp.float32))
     assert exp.nr_devices == 8
+
+
+def test_grouped_device_evaluators_lower_for_tpu():
+    """The per_group_* device evaluators (lexsort + segment ops over
+    factorized group ids) used for CD per-iteration monitoring lower for
+    the TPU target."""
+    import numpy as np
+
+    from photon_ml_tpu.evaluation.device import make_grouped_device_evaluator
+
+    groups = np.arange(N) % 17
+    s = jax.ShapeDtypeStruct
+    for name in ("per_group_auc", "per_group_logistic_loss",
+                 "per_group_precision_at_5"):
+        fn = make_grouped_device_evaluator(name, groups)
+        exp = export.export(jax.jit(fn), platforms=["tpu"])(
+            s((N,), jnp.float32), s((N,), jnp.float32), s((N,), jnp.float32))
+        assert "stablehlo" in exp.mlir_module(), name
