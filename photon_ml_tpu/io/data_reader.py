@@ -163,9 +163,12 @@ def write_training_examples(
     entity_ids: Optional[Dict[str, Sequence]] = None,
     uids: Optional[Sequence] = None,
     codec: str = "deflate",
+    block_size: int = 4096,
 ) -> None:
     """Write TrainingExampleAvro records; ``features`` yields per-row lists
-    of (name, term, value). ``labels=None`` writes unlabeled scoring data."""
+    of (name, term, value). ``labels=None`` writes unlabeled scoring data.
+    ``block_size`` (records per container block) controls the granularity
+    available to block-level consumers (AvroChunkSource process_part)."""
     entity_ids = entity_ids or {}
 
     def records():
@@ -183,7 +186,8 @@ def write_training_examples(
                 "metadataMap": {c: str(vals[i]) for c, vals in entity_ids.items()},
             }
 
-    write_avro_file(path, records(), TRAINING_EXAMPLE_SCHEMA, codec=codec)
+    write_avro_file(path, records(), TRAINING_EXAMPLE_SCHEMA, codec=codec,
+                    block_size=block_size)
 
 
 def feature_tuples_from_dense(X: np.ndarray, prefix: str = "f"):
