@@ -90,6 +90,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="larger-than-HBM mode for fixed-effect coordinates: "
                         "features stay in host RAM, each optimizer pass "
                         "streams fixed-shape chunks through the device")
+    p.add_argument("--out-of-core-shards", nargs="*", default=(),
+                   help="feature shards that must NEVER materialize in "
+                        "host RAM: their coordinates (streaming fixed "
+                        "effects) re-decode Avro block waves from disk "
+                        "every optimizer pass (io/stream_source.py). "
+                        "Requires a pinned feature space for those shards "
+                        "(--hash-dim or --index-map) and no "
+                        "normalization/summarization on them")
     p.add_argument("--chunk-rows", type=int, default=1 << 16,
                    help="rows per streamed chunk (--streaming)")
     p.add_argument("--tuning-mode", default="none",
@@ -252,9 +260,37 @@ def main(argv: Sequence[str] | None = None) -> int:
             else:
                 index_maps[s] = base_map
 
+    ooc_shards = set(args.out_of_core_shards or ())
+    if ooc_shards:
+        unknown = ooc_shards - set(shards)
+        if unknown:
+            raise SystemExit(f"--out-of-core-shards: {sorted(unknown)} not "
+                             f"used by any coordinate (shards: {sorted(shards)})")
+        if not (args.hash_dim or args.index_map):
+            raise SystemExit("--out-of-core-shards needs a pinned feature "
+                             "space (--hash-dim or --index-map): building "
+                             "an index map scans the full dataset")
+        if distributed:
+            raise SystemExit("--out-of-core-shards is single-process (give "
+                             "each process its own source via the API)")
+
     with Timed(logger, "read_train_data"):
-        train = _read_dataset(args.train_data, index_maps, entity_columns,
-                              columns)
+        train = _read_dataset(
+            args.train_data,
+            {s_: m for s_, m in index_maps.items() if s_ not in ooc_shards},
+            entity_columns, columns)
+        if ooc_shards:
+            from photon_ml_tpu.io.stream_source import AvroChunkSource
+
+            import jax
+
+            n_local = max(len(jax.local_devices()), 1)
+            cr = -(-args.chunk_rows // n_local) * n_local
+            train.feature_sources = {
+                s_: AvroChunkSource(args.train_data, index_maps[s_],
+                                    chunk_rows=cr, columns=columns)
+                for s_ in ooc_shards
+            }
     validation = None
     if args.validation_data:
         with Timed(logger, "read_validation_data"):
@@ -267,8 +303,17 @@ def main(argv: Sequence[str] | None = None) -> int:
     norm_type = NormalizationType(args.normalization)
     if norm_type != NormalizationType.NONE or args.summarize_features:
         contexts = {}
+        if ooc_shards and norm_type != NormalizationType.NONE:
+            raise SystemExit("--normalization needs per-feature statistics "
+                             "of every shard; out-of-core shards "
+                             f"{sorted(ooc_shards)} have no resident data "
+                             "to scan")
         with Timed(logger, "feature_summarization"):
             for shard in shards:
+                if shard in ooc_shards:
+                    logger.log("summarization_skipped_out_of_core",
+                               shard=shard)
+                    continue
                 sp = train.features[shard]
                 batch = make_batch(_to_sparse_features(sp), train.labels)
                 summary = summarize_features(batch)

@@ -162,6 +162,12 @@ class GameDataset:
     offsets: np.ndarray
     entity_ids: Dict[str, np.ndarray]
     group_ids: Optional[np.ndarray] = None  # for per_group_* evaluators
+    # larger-than-host-RAM shards: a disk-backed chunk source (e.g.
+    # io.stream_source.AvroChunkSource over the same rows, in order) per
+    # shard that should NOT be materialized in `features`. A streaming
+    # fixed-effect coordinate on such a shard re-decodes its features from
+    # disk every optimizer pass (O(12B/row) host state for the scalars)
+    feature_sources: Optional[Dict[str, object]] = None
 
     def __post_init__(self):
         self.labels = np.asarray(self.labels, np.float64)
@@ -205,11 +211,15 @@ class _FixedState:
 
     def __init__(self, cfg: CoordinateConfig, data: GameDataset, dtype,
                  task: str, mesh: Optional[Mesh]):
-        sp = data.features[cfg.feature_shard]
+        source = (data.feature_sources or {}).get(cfg.feature_shard)
+        sp = None if source is not None else data.features[cfg.feature_shard]
         self.cfg = cfg
         self.dtype = dtype
-        self.dim = sp.dim
+        self.dim = source.dim if source is not None else sp.dim
         self.n_all = data.num_samples
+        if source is not None:
+            self._init_out_of_core(cfg, data, source, task, mesh)
+            return
         if cfg.down_sampling_rate < 1.0:
             rows, w = down_sample(data.labels, data.weights,
                                   cfg.down_sampling_rate, task=task, seed=0)
@@ -399,6 +409,81 @@ class _FixedState:
             self.full_features = _device_features(sp, dtype)
         self._batch_parts = (feats, labels, weights)
         self._fit_jit = jax.jit(_fit)
+
+    def _init_out_of_core(self, cfg: CoordinateConfig, data: GameDataset,
+                          source, task: str, mesh: Optional[Mesh]) -> None:
+        """Fixed effect over a shard that never materializes in host RAM:
+        every optimizer pass re-decodes the source's chunks from disk
+        (io/stream_source.py), with the CD residual offsets — which change
+        every step and live as an O(12B/row) host array — overlaid onto
+        the streamed scalars (ScalarOverlaySource). Streaming semantics
+        otherwise match the in-RAM streaming branch."""
+        from photon_ml_tpu.io.stream_source import ScalarOverlaySource
+        from photon_ml_tpu.parallel.streaming import fit_streaming
+
+        if not cfg.streaming:
+            raise ValueError(
+                f"coordinate '{cfg.name}': shard '{cfg.feature_shard}' is "
+                "disk-backed (feature_sources) — set streaming=True")
+        if cfg.down_sampling_rate < 1.0:
+            raise ValueError(
+                f"coordinate '{cfg.name}': down-sampling needs row "
+                "indexing; not supported out of core")
+        if jax.process_count() > 1:
+            raise ValueError(
+                f"coordinate '{cfg.name}': multi-process out-of-core "
+                "training passes each process its own "
+                "AvroChunkSource(process_part=...) — a shared source "
+                "cannot be row-sliced per process")
+        if source.rows != data.num_samples:
+            raise ValueError(
+                f"coordinate '{cfg.name}': source has {source.rows} rows, "
+                f"dataset has {data.num_samples} — they must be the same "
+                "data in the same order")
+        self.streaming = True
+        self.train_rows = jnp.arange(data.num_samples)
+        self.w = None
+        self.variances = None
+        reg = cfg.reg_context()
+        self.l2 = reg.l2_weight(cfg.reg_weight)
+        self.l1 = reg.l1_weight(cfg.reg_weight)
+        optimizer = cfg.optimizer
+        if self.l1 > 0 and optimizer != "owlqn":
+            optimizer = "owlqn"
+        self.obj = make_objective(task, normalization=cfg.normalization,
+                                  intercept_index=cfg.intercept_index)
+        cfg_opt = cfg.opt_config()
+        use_mesh = mesh is not None and "data" in mesh.shape
+        self._stream_mesh = mesh if use_mesh else None
+        if use_mesh and source.chunk_rows % len(jax.local_devices()):
+            raise ValueError(
+                f"coordinate '{cfg.name}': source chunk_rows="
+                f"{source.chunk_rows} must divide the "
+                f"{len(jax.local_devices())}-device data mesh")
+        self._offset_pad = 0
+        self._offset_sharding = None
+        self._ooc_source = source
+        self._score_chunks = source  # features-only streamed scoring
+        self._score_span = (0, self.n_all)
+        self._batch_parts = None
+        labels = data.labels
+        weights = data.weights
+        dim = self.dim
+
+        def _fit(w0, offs, l2, l1):
+            overlay = ScalarOverlaySource(source, labels=labels,
+                                          weights=weights,
+                                          offsets=np.asarray(offs))
+            self._last_chunks = overlay
+            return fit_streaming(
+                self.obj, overlay, dim, w0=w0, l2=float(l2), l1=float(l1),
+                optimizer=optimizer, config=cfg_opt, dtype=self.dtype,
+                mesh=self._stream_mesh,
+            )
+
+        self._last_chunks = ScalarOverlaySource(source, labels=labels,
+                                                weights=weights)
+        self._fit_jit = _fit
 
     def fit(self, offsets_full: jax.Array):
         offs = jnp.take(offsets_full, self.train_rows, axis=0).astype(self.dtype)

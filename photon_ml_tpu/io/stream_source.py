@@ -48,7 +48,8 @@ from photon_ml_tpu.io.avro import (
 )
 from photon_ml_tpu.parallel.streaming import HostChunk
 
-__all__ = ["AvroChunkSource", "scan_blocks", "BlockRef"]
+__all__ = ["AvroChunkSource", "ScalarOverlaySource", "scan_blocks",
+           "BlockRef"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -160,6 +161,49 @@ def _pad_fixed(counts, flat_idx, flat_val, intercept: int, k: int,
         indices[rows, valid] = intercept
         values[rows, valid] = 1.0
     return indices, values
+
+
+class ScalarOverlaySource:
+    """Wrap a chunk source, substituting the scalar columns
+    (labels/offsets/weights) from dataset-level host arrays addressed by
+    running row index — feature columns stream from the wrapped source
+    untouched.
+
+    This is what lets a GAME coordinate-descent step run its fixed effect
+    OUT OF CORE: the residual offsets (base + other coordinates' scores)
+    change every CD step and live in host RAM (O(12B/row)), while the
+    fixed shard's features re-decode from disk per pass. Trailing padding
+    rows of the last chunk keep zeroed scalars (weight 0 = inert)."""
+
+    def __init__(self, src, labels=None, offsets=None, weights=None):
+        self._src = src
+        self._labels = labels
+        self._offsets = offsets
+        self._weights = weights
+
+    def __len__(self) -> int:
+        return len(self._src)
+
+    def __iter__(self) -> Iterator[HostChunk]:
+        at = 0
+        for c in self._src:
+            rows = c.indices.shape[0]
+
+            def take(arr, cur):
+                if arr is None:
+                    return cur
+                seg = np.asarray(arr[at:at + rows], dtype=cur.dtype)
+                if len(seg) < rows:  # final-chunk padding rows stay inert
+                    seg = np.pad(seg, (0, rows - len(seg)))
+                return seg
+
+            yield dataclasses.replace(
+                c,
+                labels=take(self._labels, c.labels),
+                offsets=take(self._offsets, c.offsets),
+                weights=take(self._weights, c.weights),
+            )
+            at += rows
 
 
 class AvroChunkSource:

@@ -205,3 +205,61 @@ def test_tuning_through_driver(game_fixture):
     assert done["best_metrics"]["auc"] == pytest.approx(
         max(grid_aucs + tuned_aucs), abs=1e-12
     )
+
+
+def test_training_driver_out_of_core_fixed_shard(game_fixture):
+    """--out-of-core-shards: the fixed shard's features never materialize
+    in host RAM (disk-backed AvroChunkSource per optimizer pass); the
+    trained model must match the fully-resident streaming run."""
+    imap = str(game_fixture / "imap.json")
+    assert index_main(["--data", str(game_fixture / "train.avro"),
+                       "--output", imap]) == 0
+    coords = [
+        {"name": "fixed", "coordinate_type": "fixed",
+         "feature_shard": "global", "streaming": True, "chunk_rows": 64,
+         "reg_type": "l2", "reg_weight": 1.0, "max_iters": 60},
+        {"name": "per-user", "coordinate_type": "random",
+         "feature_shard": "user", "entity_column": "userId",
+         "reg_type": "l2", "reg_weight": 1.0, "max_iters": 40},
+    ]
+    common = [
+        "--train-data", str(game_fixture / "train.avro"),
+        "--validation-data", str(game_fixture / "val.avro"),
+        "--coordinates", json.dumps(coords),
+        "--feature-shards", str(game_fixture / "shards.json"),
+        "--index-map", imap,
+        "--n-iterations", "2",
+        "--dtype", "float64",
+    ]
+    assert train_main(common + ["--output-dir",
+                                str(game_fixture / "out_ram")]) == 0
+    assert train_main(common + ["--output-dir",
+                                str(game_fixture / "out_ooc"),
+                                "--out-of-core-shards", "global"]) == 0
+
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    w_ram = np.asarray(
+        load_game_model(str(game_fixture / "out_ram" / "best"))["fixed"]
+        .model.coefficients.means)
+    w_ooc = np.asarray(
+        load_game_model(str(game_fixture / "out_ooc" / "best"))["fixed"]
+        .model.coefficients.means)
+    np.testing.assert_allclose(w_ooc, w_ram, rtol=1e-7, atol=1e-10)
+    log = [json.loads(l) for l in
+           (game_fixture / "out_ooc" / "photon.log.jsonl")
+           .read_text().splitlines()]
+    aucs = [r["auc"] for r in log if r["event"] == "cd_iteration"]
+    assert aucs and aucs[-1] > 0.72
+
+
+def test_training_driver_out_of_core_needs_pinned_space(game_fixture):
+    with pytest.raises(SystemExit, match="pinned feature space"):
+        train_main([
+            "--train-data", str(game_fixture / "train.avro"),
+            "--output-dir", str(game_fixture / "out_bad"),
+            "--coordinates", json.dumps([
+                {"name": "fixed", "coordinate_type": "fixed",
+                 "streaming": True, "reg_type": "l2", "reg_weight": 1.0}]),
+            "--out-of-core-shards", "global",
+        ])
