@@ -90,6 +90,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="larger-than-HBM mode for fixed-effect coordinates: "
                         "features stay in host RAM, each optimizer pass "
                         "streams fixed-shape chunks through the device")
+    p.add_argument("--pad-nnz", type=int, default=None,
+                   help="fixed per-row feature width incl. intercept for "
+                        "--out-of-core-shards sources (default: one "
+                        "measuring decode pass per shard — pass the known "
+                        "value at scale to skip it)")
     p.add_argument("--out-of-core-shards", nargs="*", default=(),
                    help="feature shards that must NEVER materialize in "
                         "host RAM: their coordinates (streaming fixed "
@@ -262,6 +267,8 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     ooc_shards = set(args.out_of_core_shards or ())
     if ooc_shards:
+        # every check here is argv-only: fail BEFORE the (potentially
+        # hours-long at the scale this feature targets) dataset reads
         unknown = ooc_shards - set(shards)
         if unknown:
             raise SystemExit(f"--out-of-core-shards: {sorted(unknown)} not "
@@ -273,6 +280,28 @@ def main(argv: Sequence[str] | None = None) -> int:
         if distributed:
             raise SystemExit("--out-of-core-shards is single-process (give "
                              "each process its own source via the API)")
+        if NormalizationType(args.normalization) != NormalizationType.NONE:
+            raise SystemExit("--normalization needs per-feature statistics "
+                             "of every shard; out-of-core shards "
+                             f"{sorted(ooc_shards)} have no resident data "
+                             "to scan")
+        # only streaming FIXED coordinates can consume a disk-backed
+        # shard; a random coordinate's data layer needs resident features
+        ooc_chunk_rows: Dict[str, int] = {}
+        for cfg in grid[0]:
+            if cfg.feature_shard not in ooc_shards:
+                continue
+            if cfg.coordinate_type != "fixed" or not cfg.streaming:
+                raise SystemExit(
+                    f"--out-of-core-shards: shard '{cfg.feature_shard}' is "
+                    f"used by coordinate '{cfg.name}' "
+                    f"({cfg.coordinate_type}"
+                    f"{'' if cfg.streaming else ', streaming=false'}) — "
+                    "only streaming fixed-effect coordinates can train "
+                    "from a disk-backed shard")
+            ooc_chunk_rows[cfg.feature_shard] = min(
+                cfg.chunk_rows,
+                ooc_chunk_rows.get(cfg.feature_shard, cfg.chunk_rows))
 
     with Timed(logger, "read_train_data"):
         train = _read_dataset(
@@ -285,10 +314,17 @@ def main(argv: Sequence[str] | None = None) -> int:
             import jax
 
             n_local = max(len(jax.local_devices()), 1)
-            cr = -(-args.chunk_rows // n_local) * n_local
+
+            def _cr(shard):
+                # the consuming coordinate's chunk_rows (min across
+                # coordinates sharing the shard), device-rounded
+                base = ooc_chunk_rows.get(shard, args.chunk_rows)
+                return -(-base // n_local) * n_local
+
             train.feature_sources = {
                 s_: AvroChunkSource(args.train_data, index_maps[s_],
-                                    chunk_rows=cr, columns=columns)
+                                    chunk_rows=_cr(s_), columns=columns,
+                                    pad_nnz=args.pad_nnz, dtype=dtype)
                 for s_ in ooc_shards
             }
     validation = None
@@ -303,11 +339,6 @@ def main(argv: Sequence[str] | None = None) -> int:
     norm_type = NormalizationType(args.normalization)
     if norm_type != NormalizationType.NONE or args.summarize_features:
         contexts = {}
-        if ooc_shards and norm_type != NormalizationType.NONE:
-            raise SystemExit("--normalization needs per-feature statistics "
-                             "of every shard; out-of-core shards "
-                             f"{sorted(ooc_shards)} have no resident data "
-                             "to scan")
         with Timed(logger, "feature_summarization"):
             for shard in shards:
                 if shard in ooc_shards:

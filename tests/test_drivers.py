@@ -263,3 +263,20 @@ def test_training_driver_out_of_core_needs_pinned_space(game_fixture):
                  "streaming": True, "reg_type": "l2", "reg_weight": 1.0}]),
             "--out-of-core-shards", "global",
         ])
+
+
+def test_training_driver_out_of_core_rejects_random_shard(game_fixture):
+    """A shard consumed by a random-effect coordinate cannot go out of
+    core — rejected on argv, before any data is read."""
+    imap = str(game_fixture / "imap2.json")
+    assert index_main(["--data", str(game_fixture / "train.avro"),
+                       "--output", imap]) == 0
+    with pytest.raises(SystemExit, match="streaming fixed-effect"):
+        train_main([
+            "--train-data", str(game_fixture / "train.avro"),
+            "--output-dir", str(game_fixture / "out_bad2"),
+            "--coordinates", str(game_fixture / "coords.json"),
+            "--feature-shards", str(game_fixture / "shards.json"),
+            "--index-map", imap,
+            "--out-of-core-shards", "user",
+        ])

@@ -235,44 +235,27 @@ def test_game_cd_fixed_out_of_core_matches_in_ram(tmp_path, rng):
     users = rng.integers(0, 12, n).astype(str)
     hs = feats["global"]
 
-    def run(ooc):
-        src = (AvroChunkSource(path, imap, chunk_rows=64) if ooc else None)
-        ds = GameDataset(
-            features={} if ooc else {"global": hs},
-            labels=labels, weights=weights, offsets=offsets,
-            entity_ids={"userId": users},
-            feature_sources={"global": src} if ooc else None,
-        )
-        if ooc:
-            # random effects still need in-RAM features for THEIR shard;
-            # here the single shard doubles for both, so provide it for
-            # the RE under a second name backed by the same arrays
-            ds.features["re"] = hs
-        cd = CoordinateDescent(
-            [CoordinateConfig("fixed", "fixed", feature_shard="global",
-                              streaming=True, chunk_rows=64, max_iters=12,
-                              reg_type="l2", reg_weight=0.5),
-             CoordinateConfig("per-user", "random",
-                              feature_shard="re" if ooc else "global",
-                              entity_column="userId", max_iters=12,
-                              reg_type="l2", reg_weight=1.0)],
-            n_iterations=2)
-        return cd.run(ds)
-
-    # in-RAM reference needs the same extra shard name to share configs
-    model_ram, hist_ram = None, None
+    configs = [
+        CoordinateConfig("fixed", "fixed", feature_shard="global",
+                         streaming=True, chunk_rows=64, max_iters=12,
+                         reg_type="l2", reg_weight=0.5),
+        # the RE keeps resident features for ITS shard; here the single
+        # shard doubles for both, under a second name
+        CoordinateConfig("per-user", "random", feature_shard="re",
+                         entity_column="userId", max_iters=12,
+                         reg_type="l2", reg_weight=1.0),
+    ]
     ds_ram = GameDataset({"global": hs, "re": hs}, labels, weights,
                          offsets, {"userId": users})
-    cd_ram = CoordinateDescent(
-        [CoordinateConfig("fixed", "fixed", feature_shard="global",
-                          streaming=True, chunk_rows=64, max_iters=12,
-                          reg_type="l2", reg_weight=0.5),
-         CoordinateConfig("per-user", "random", feature_shard="re",
-                          entity_column="userId", max_iters=12,
-                          reg_type="l2", reg_weight=1.0)],
-        n_iterations=2)
-    model_ram, hist_ram = cd_ram.run(ds_ram)
-    model_ooc, hist_ooc = run(ooc=True)
+    model_ram, hist_ram = CoordinateDescent(
+        configs, n_iterations=2).run(ds_ram)
+
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    ds_ooc = GameDataset({"re": hs}, labels, weights, offsets,
+                         {"userId": users},
+                         feature_sources={"global": src})
+    model_ooc, hist_ooc = CoordinateDescent(
+        configs, n_iterations=2).run(ds_ooc)
 
     w_ram = np.asarray(model_ram.coordinates["fixed"]
                        .model.coefficients.means)
