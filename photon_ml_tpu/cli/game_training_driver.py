@@ -280,11 +280,6 @@ def main(argv: Sequence[str] | None = None) -> int:
         if distributed:
             raise SystemExit("--out-of-core-shards is single-process (give "
                              "each process its own source via the API)")
-        if NormalizationType(args.normalization) != NormalizationType.NONE:
-            raise SystemExit("--normalization needs per-feature statistics "
-                             "of every shard; out-of-core shards "
-                             f"{sorted(ooc_shards)} have no resident data "
-                             "to scan")
         # only streaming FIXED coordinates can consume a disk-backed
         # shard; a random coordinate's data layer needs resident features
         ooc_chunk_rows: Dict[str, int] = {}
@@ -342,12 +337,19 @@ def main(argv: Sequence[str] | None = None) -> int:
         with Timed(logger, "feature_summarization"):
             for shard in shards:
                 if shard in ooc_shards:
-                    logger.log("summarization_skipped_out_of_core",
-                               shard=shard)
-                    continue
-                sp = train.features[shard]
-                batch = make_batch(_to_sparse_features(sp), train.labels)
-                summary = summarize_features(batch)
+                    # one extra streamed pass over the disk-backed shard:
+                    # per-feature moments without a resident copy
+                    from photon_ml_tpu.ops.statistics import (
+                        summarize_features_streamed,
+                    )
+
+                    src = train.feature_sources[shard]
+                    summary = summarize_features_streamed(
+                        src, src.dim, src.rows)
+                else:
+                    sp = train.features[shard]
+                    batch = make_batch(_to_sparse_features(sp), train.labels)
+                    summary = summarize_features(batch)
                 if args.summarize_features:
                     _write_summary(args.output_dir, summary, index_maps[shard],
                                    suffix=shard)

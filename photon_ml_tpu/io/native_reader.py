@@ -408,6 +408,11 @@ def read_training_examples_native(
         raise NativeUnsupported(str(e)) from e
 
     shards = sorted(index_maps)
+    if not shards:
+        # scalars/entity-columns-only read (every feature shard is
+        # disk-backed out of core): the decoder requires >=1 shard, and
+        # the python codec handles the no-features case directly
+        raise NativeUnsupported("no feature shards requested")
     resolvers: List[_Resolver] = []
     try:
         for s in shards:

@@ -280,3 +280,39 @@ def test_training_driver_out_of_core_rejects_random_shard(game_fixture):
             "--index-map", imap,
             "--out-of-core-shards", "user",
         ])
+
+
+def test_training_driver_out_of_core_with_normalization(game_fixture):
+    """--normalization standardization composes with --out-of-core-shards:
+    the per-feature statistics come from one extra streamed pass
+    (summarize_features_streamed) and the model matches the resident run."""
+    imap = str(game_fixture / "imap3.json")
+    assert index_main(["--data", str(game_fixture / "train.avro"),
+                       "--output", imap]) == 0
+    coords = json.dumps([
+        {"name": "fixed", "coordinate_type": "fixed",
+         "feature_shard": "global", "streaming": True, "chunk_rows": 64,
+         "reg_type": "l2", "reg_weight": 1.0, "max_iters": 60}])
+    common = [
+        "--train-data", str(game_fixture / "train.avro"),
+        "--validation-data", str(game_fixture / "val.avro"),
+        "--coordinates", coords,
+        "--feature-shards", str(game_fixture / "shards.json"),
+        "--index-map", imap,
+        "--normalization", "standardization",
+        "--dtype", "float64",
+    ]
+    assert train_main(common + ["--output-dir",
+                                str(game_fixture / "norm_ram")]) == 0
+    assert train_main(common + ["--output-dir",
+                                str(game_fixture / "norm_ooc"),
+                                "--out-of-core-shards", "global"]) == 0
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    w_ram = np.asarray(
+        load_game_model(str(game_fixture / "norm_ram" / "best"))["fixed"]
+        .model.coefficients.means)
+    w_ooc = np.asarray(
+        load_game_model(str(game_fixture / "norm_ooc" / "best"))["fixed"]
+        .model.coefficients.means)
+    np.testing.assert_allclose(w_ooc, w_ram, rtol=1e-7, atol=1e-10)

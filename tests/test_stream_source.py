@@ -269,3 +269,49 @@ def test_game_cd_fixed_out_of_core_matches_in_ram(tmp_path, rng):
     for a, b in zip(hist_ram, hist_ooc):
         if "loss" in a:
             np.testing.assert_allclose(b["loss"], a["loss"], rtol=2e-4)
+
+
+def test_streamed_summary_matches_in_ram(tmp_path, rng):
+    """summarize_features_streamed over a disk-backed source (padded final
+    chunk included) == summarize_features over the resident data."""
+    from photon_ml_tpu.ops.statistics import (
+        summarize_features,
+        summarize_features_streamed,
+    )
+    from photon_ml_tpu.types import LabeledBatch
+
+    path, imap = _write_dataset(tmp_path, rng, n=190)
+    feats, labels, *_ = read_training_examples(path, {"global": imap})
+    hs = feats["global"]
+    ref = summarize_features(
+        LabeledBatch(hs, labels, np.zeros_like(labels),
+                     np.ones_like(labels)))
+    # f64 source: exact parity (an f32 source quantizes INPUTS to 1e-7
+    # relative; accumulation is f64 either way)
+    src = AvroChunkSource(path, imap, chunk_rows=64,  # 190 % 64: pad tail
+                          dtype=np.float64)
+    got = summarize_features_streamed(src, src.dim, src.rows)
+    assert got.count == ref.count == 190
+    for field in ("mean", "variance", "std", "min", "max", "num_nonzeros"):
+        np.testing.assert_allclose(getattr(got, field), getattr(ref, field),
+                                   rtol=1e-12, atol=1e-12, err_msg=field)
+
+
+def test_streamed_summary_implicit_ones(rng):
+    from photon_ml_tpu.ops.statistics import (
+        summarize_features,
+        summarize_features_streamed,
+    )
+    from photon_ml_tpu.types import LabeledBatch
+
+    n, d, k = 100, 20, 4
+    idx = np.stack([rng.choice(d, size=k, replace=False)
+                    for _ in range(n)]).astype(np.int32)
+    hs = HostSparse(idx, None, d)
+    labels = np.zeros(n)
+    ref = summarize_features(LabeledBatch(hs, labels, labels, labels + 1))
+    chunks, _ = make_host_chunks(hs, labels, chunk_rows=32)  # padded tail
+    got = summarize_features_streamed(chunks, d, n)
+    for field in ("mean", "variance", "num_nonzeros", "min", "max"):
+        np.testing.assert_allclose(getattr(got, field), getattr(ref, field),
+                                   err_msg=field)
