@@ -520,3 +520,47 @@ def test_streamed_progress_callback_fires_per_iteration(sparse_problem):
         progress_callback=lambda it, w: seen.append((it, np.asarray(w))))
     assert [it for it, _ in seen] == list(range(int(res.iterations)))
     np.testing.assert_array_equal(seen[-1][1], np.asarray(res.w))
+
+
+def test_streamed_fit_with_normalization_matches_in_memory(sparse_problem):
+    """The streamed margin L-BFGS composes with a normalization context
+    exactly like the in-memory fit (the OOC --normalization path relies
+    on this: the margin caches carry normalized margins consistently)."""
+    from photon_ml_tpu.ops.normalization import NormalizationContext
+
+    X, y, offsets, weights = sparse_problem
+    feats = sparse_from_scipy(X, dtype=jnp.float64)
+    d = feats.dim
+    rng = np.random.default_rng(3)
+    norm = NormalizationContext(
+        factors=jnp.asarray(rng.uniform(0.5, 2.0, d)),
+        shifts=jnp.asarray(rng.normal(size=d) * 0.1),
+        intercept_index=0,
+    )
+    obj = make_objective("logistic", normalization=norm, intercept_index=0)
+    chunks, dim = make_host_chunks(
+        HostSparse(np.asarray(feats.indices), np.asarray(feats.values),
+                   feats.dim), y, offsets, weights, chunk_rows=256)
+    # exact single-pass parity first: the margin caches rely on margins()
+    # being affine in w under the normalization map
+    from photon_ml_tpu.parallel.streaming import streaming_value_and_grad
+
+    w_probe = jnp.asarray(np.random.default_rng(5).normal(size=dim))
+    batch = make_batch(feats, y, offsets, weights, dtype=jnp.float64)
+    f_s, g_s = streaming_value_and_grad(obj, chunks, dim,
+                                        dtype=jnp.float64)(w_probe, 0.3)
+    f_m, g_m = obj.value_and_grad(w_probe, batch, 0.3)
+    np.testing.assert_allclose(float(f_s), float(f_m), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(g_s), np.asarray(g_m),
+                               rtol=1e-10, atol=1e-12)
+    # and same optimum (trajectories differ: delta-space Armijo vs strong
+    # Wolfe — the same tolerance discipline as the unnormalized parity test)
+    cfg = OptimizerConfig(max_iters=200, tolerance=1e-12)
+    res_s = fit_streaming(obj, chunks, dim, l2=0.5, config=cfg,
+                          dtype=jnp.float64)
+    res_m = fit_distributed(obj, batch, make_mesh(), jnp.zeros(dim),
+                            l2=0.5, config=cfg)
+    np.testing.assert_allclose(float(res_s.value), float(res_m.value),
+                               rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(res_s.w), np.asarray(res_m.w),
+                               rtol=1e-4, atol=1e-6)
