@@ -43,6 +43,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-rows", type=int, default=None,
                    help="score in row batches of this size (bounds device "
                         "memory for large scoring sets)")
+    p.add_argument("--out-of-core", action="store_true",
+                   help="larger-than-host-RAM scoring: decode block "
+                        "windows of ~--batch-rows rows one at a time "
+                        "(io/data_reader.read_training_examples_chunked), "
+                        "score each, and append its ScoringResult records "
+                        "before the next window decodes — host RAM holds "
+                        "one window plus O(16B/row) evaluator state")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
     return p
 
@@ -77,6 +84,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         entity_columns = entity_columns + [args.group_column]
 
     from photon_ml_tpu.cli.game_training_driver import _load_input_columns
+
+    if args.out_of_core:
+        return _score_out_of_core(args, model, index_maps, entity_columns,
+                                  logger, dtype)
 
     with Timed(logger, "read_data"):
         feats, labels, offsets, weights, ents, uids = read_training_examples(
@@ -138,6 +149,80 @@ def main(argv: Sequence[str] | None = None) -> int:
     if metrics:
         logger.log("evaluation", **metrics)
     logger.log("driver_done", num_scored=len(scores))
+    logger.close()
+    return 0
+
+
+def _score_out_of_core(args, model, index_maps, entity_columns, logger,
+                       dtype) -> int:
+    """Stream decode -> score -> write, one block window at a time. The
+    Avro writer consumes a generator, so output records append as each
+    window finishes; only evaluator inputs (scores/labels/weights/groups,
+    16B/row) accumulate in host RAM."""
+    from photon_ml_tpu.game.scoring import score_game_model
+    from photon_ml_tpu.io.data_reader import read_training_examples_chunked
+
+    cols = None
+    from photon_ml_tpu.cli.game_training_driver import _load_input_columns
+
+    cols = _load_input_columns(args.input_columns)
+    chunk_rows = args.batch_rows or (1 << 16)
+    acc_scores, acc_labels, acc_weights, acc_groups = [], [], [], []
+    n_scored = [0]
+
+    def scored_records():
+        windows = read_training_examples_chunked(
+            args.data, index_maps, entity_columns=entity_columns,
+            columns=cols, chunk_rows=chunk_rows, require_response=False)
+        for feats, labels, offsets, weights, ents, uids in windows:
+            result = score_game_model(
+                model, feats, ents, offsets=offsets, dtype=dtype,
+                per_coordinate=args.per_coordinate_scores)
+            if args.per_coordinate_scores:
+                scores, parts = result
+                parts = {k: np.asarray(v) for k, v in parts.items()}
+            else:
+                scores, parts = result, {}
+            scores = np.asarray(scores)
+            acc_scores.append(scores)
+            acc_labels.append(labels)
+            acc_weights.append(weights)
+            if args.group_column:
+                acc_groups.append(ents[args.group_column])
+            n_scored[0] += len(scores)
+            for i, uid in enumerate(uids):
+                yield {
+                    "uid": uid,
+                    "predictionScore": float(scores[i]),
+                    "label": (None if np.isnan(labels[i])
+                              else float(labels[i])),
+                    "scoreComponents": {
+                        k: float(v[i]) for k, v in parts.items()},
+                }
+
+    with Timed(logger, "score_and_write"):
+        write_avro_file(os.path.join(args.output_dir, "scores.avro"),
+                        scored_records(), SCORING_RESULT_SCHEMA)
+
+    metrics = {}
+    if args.evaluators and acc_scores:
+        scores = np.concatenate(acc_scores)
+        labels = np.concatenate(acc_labels)
+        weights = np.concatenate(acc_weights)
+        labeled = ~np.isnan(labels)
+        if labeled.any():
+            groups = (np.concatenate(acc_groups)[labeled]
+                      if acc_groups else None)
+            for name in args.evaluators:
+                ev = get_evaluator(name)
+                metrics[name] = ev.evaluate(scores[labeled],
+                                            labels[labeled],
+                                            weights[labeled], groups)
+        else:
+            logger.log("evaluation_skipped", reason="no labeled rows")
+    if metrics:
+        logger.log("evaluation", **metrics)
+    logger.log("driver_done", num_scored=n_scored[0])
     logger.close()
     return 0
 
