@@ -162,7 +162,6 @@ def _score_out_of_core(args, model, index_maps, entity_columns, logger,
     from photon_ml_tpu.game.scoring import score_game_model
     from photon_ml_tpu.io.data_reader import read_training_examples_chunked
 
-    cols = None
     from photon_ml_tpu.cli.game_training_driver import _load_input_columns
 
     cols = _load_input_columns(args.input_columns)
@@ -184,11 +183,14 @@ def _score_out_of_core(args, model, index_maps, entity_columns, logger,
             else:
                 scores, parts = result, {}
             scores = np.asarray(scores)
-            acc_scores.append(scores)
-            acc_labels.append(labels)
-            acc_weights.append(weights)
-            if args.group_column:
-                acc_groups.append(ents[args.group_column])
+            if args.evaluators:
+                # evaluator state is the ONLY per-row accumulation
+                # (16B/row); without evaluators nothing accumulates at all
+                acc_scores.append(scores)
+                acc_labels.append(labels)
+                acc_weights.append(weights)
+                if args.group_column:
+                    acc_groups.append(ents[args.group_column])
             n_scored[0] += len(scores)
             for i, uid in enumerate(uids):
                 yield {
@@ -205,10 +207,13 @@ def _score_out_of_core(args, model, index_maps, entity_columns, logger,
                         scored_records(), SCORING_RESULT_SCHEMA)
 
     metrics = {}
-    if args.evaluators and acc_scores:
-        scores = np.concatenate(acc_scores)
-        labels = np.concatenate(acc_labels)
-        weights = np.concatenate(acc_weights)
+    if args.evaluators:
+        scores = (np.concatenate(acc_scores) if acc_scores
+                  else np.zeros(0))
+        labels = (np.concatenate(acc_labels) if acc_labels
+                  else np.zeros(0))
+        weights = (np.concatenate(acc_weights) if acc_weights
+                   else np.zeros(0))
         labeled = ~np.isnan(labels)
         if labeled.any():
             groups = (np.concatenate(acc_groups)[labeled]

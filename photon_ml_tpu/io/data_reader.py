@@ -93,39 +93,15 @@ def read_training_examples(
     entity_vals: Dict[str, List] = {c: [] for c in entity_columns}
 
     for rec in iter_avro_records(paths):
-        if require_response:
-            val = rec.get(cols.response)
-            if val is None:
-                raise ValueError(
-                    f"record uid={rec.get(cols.uid)} has no "
-                    f"'{cols.response}' — training data must be labeled"
-                )
-            labels.append(float(val))
-        else:
-            # scoring data may be unlabeled (the reference scores label-less
-            # rows); NaN marks "no label" downstream
-            val = rec.get(cols.response)
-            labels.append(float("nan") if val is None else float(val))
-        offsets.append(float(rec[cols.offset])
-                       if rec.get(cols.offset) is not None else 0.0)
-        weights.append(float(rec[cols.weight])
-                       if rec.get(cols.weight) is not None else 1.0)
-        uids.append(rec.get(cols.uid))
-        meta = rec.get(cols.metadata_map) or {}
-        for c in entity_columns:
-            if c not in meta:
-                raise ValueError(f"record uid={rec.get(cols.uid)} missing "
-                                 f"entity column '{c}' in "
-                                 f"{cols.metadata_map}")
-            entity_vals[c].append(meta[c])
-        for shard, imap in index_maps.items():
-            row: List[Tuple[int, float]] = []
-            for feat in rec[cols.features]:
-                idx = imap.index_of(feat["name"], feat.get("term", ""))
-                if idx is not None:
-                    row.append((idx, float(feat["value"])))
-            if imap.intercept_index >= 0:
-                row.append((imap.intercept_index, 1.0))
+        label, offset, weight, uid, evals, shard_rows = _parse_record(
+            rec, cols, index_maps, entity_columns, require_response)
+        labels.append(label)
+        offsets.append(offset)
+        weights.append(weight)
+        uids.append(uid)
+        for c, v in zip(entity_columns, evals):
+            entity_vals[c].append(v)
+        for shard, row in shard_rows.items():
             rows_per_shard[shard].append(row)
 
     features = {
@@ -140,6 +116,46 @@ def read_training_examples(
         {c: np.asarray(v) for c, v in entity_vals.items()},
         uids,
     )
+
+
+def _parse_record(rec, cols: InputColumnsNames, index_maps, entity_columns,
+                  require_response: bool):
+    """Parse ONE TrainingExampleAvro record — the single definition of the
+    record contract, shared by the bulk python fallback and the chunked
+    (out-of-core scoring) reader so the two can never desynchronize.
+    Returns (label, offset, weight, uid, entity_values, per-shard rows)."""
+    val = rec.get(cols.response)
+    if val is None:
+        if require_response:
+            raise ValueError(
+                f"record uid={rec.get(cols.uid)} has no "
+                f"'{cols.response}' — training data must be labeled")
+        label = float("nan")
+    else:
+        label = float(val)
+    offset = (float(rec[cols.offset])
+              if rec.get(cols.offset) is not None else 0.0)
+    weight = (float(rec[cols.weight])
+              if rec.get(cols.weight) is not None else 1.0)
+    uid = rec.get(cols.uid)
+    meta = rec.get(cols.metadata_map) or {}
+    evals = []
+    for c in entity_columns:
+        if c not in meta:
+            raise ValueError(f"record uid={uid} missing entity column "
+                             f"'{c}' in {cols.metadata_map}")
+        evals.append(meta[c])
+    shard_rows = {}
+    for shard, imap in index_maps.items():
+        row: List[Tuple[int, float]] = []
+        for feat in rec[cols.features]:
+            idx = imap.index_of(feat["name"], feat.get("term", ""))
+            if idx is not None:
+                row.append((idx, float(feat["value"])))
+        if imap.intercept_index >= 0:
+            row.append((imap.intercept_index, 1.0))
+        shard_rows[shard] = row
+    return label, offset, weight, uid, evals, shard_rows
 
 
 def _rows_to_host_sparse(rows: List[List[Tuple[int, float]]], dim: int) -> HostSparse:
@@ -311,13 +327,7 @@ def _chunked_native(windows, index_maps, entity_columns, cols,
                 at = 0
                 while at < len(window):
                     path = window[at].path
-                    prog = prog_cache.get(path)
-                    if prog is None:
-                        with open(path, "rb") as fh:
-                            schema, _, _ = _read_header(fh, path)
-                        prog = compile_field_program(
-                            schema, cols, bool(entity_columns))
-                        prog_cache[path] = prog
+                    prog = prog_cache[path]  # precompiled before any yield
                     part = []
                     with open(path, "rb") as f:
                         while at < len(window) and window[at].path == path:
@@ -410,36 +420,15 @@ def _chunked_python(windows, index_maps, entity_columns, cols,
         labels, offsets, weights, uids = [], [], [], []
         entity_vals = {c: [] for c in entity_columns}
         for rec in window_records(window):
-            val = rec.get(cols.response)
-            if val is None:
-                if require_response:
-                    raise ValueError(
-                        f"record uid={rec.get(cols.uid)} has no "
-                        f"'{cols.response}' — training data must be "
-                        "labeled")
-                val = float("nan")
-            labels.append(float(val))
-            offsets.append(float(rec[cols.offset])
-                           if rec.get(cols.offset) is not None else 0.0)
-            weights.append(float(rec[cols.weight])
-                           if rec.get(cols.weight) is not None else 1.0)
-            uids.append(rec.get(cols.uid))
-            meta = rec.get(cols.metadata_map) or {}
-            for c in entity_columns:
-                if c not in meta:
-                    raise ValueError(
-                        f"record uid={rec.get(cols.uid)} missing entity "
-                        f"column '{c}' in {cols.metadata_map}")
-                entity_vals[c].append(meta[c])
-            for shard, imap in index_maps.items():
-                row = []
-                for feat in rec[cols.features]:
-                    idx = imap.index_of(feat["name"],
-                                        feat.get("term", ""))
-                    if idx is not None:
-                        row.append((idx, float(feat["value"])))
-                if imap.intercept_index >= 0:
-                    row.append((imap.intercept_index, 1.0))
+            label, offset, weight, uid, evals, shard_rows = _parse_record(
+                rec, cols, index_maps, entity_columns, require_response)
+            labels.append(label)
+            offsets.append(offset)
+            weights.append(weight)
+            uids.append(uid)
+            for c, v in zip(entity_columns, evals):
+                entity_vals[c].append(v)
+            for shard, row in shard_rows.items():
                 rows_per_shard[shard].append(row)
         features = {
             shard: _rows_to_host_sparse(rows, index_maps[shard].size)
