@@ -132,7 +132,8 @@ def main():
     winner = max(rates, key=rates.get)
     print(f"suggested _RE_SOLVER_DEFAULT entry: '{platform}': '{winner}' "
           f"({rates[winner]/max(min(rates.values()), 1e-9):.2f}x — wire in "
-          "photon_ml_tpu/game/random_effect.py)", flush=True)
+          "photon_ml_tpu/game/random_effect.py and add the platform to "
+          "_RE_SOLVER_MEASURED)", flush=True)
 
     # -- 2. one full CD iteration (fixed + 2 random effects) --------------
     users = rng.integers(0, n_entities, size=n_fixed)
