@@ -54,6 +54,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _scoring_record(uid, score: float, label: float, parts, i: int) -> dict:
+    """One ScoringResultAvro record (shared by the resident and
+    out-of-core writers)."""
+    return {
+        "uid": uid,
+        "predictionScore": float(score),
+        "label": None if np.isnan(label) else float(label),
+        "scoreComponents": {k: float(v[i]) for k, v in parts.items()},
+    }
+
+
 def _slice_host_sparse(sp, row_slice):
     from photon_ml_tpu.game.data import HostSparse
 
@@ -123,14 +134,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     with Timed(logger, "write_scores"):
         def records():
             for i, uid in enumerate(uids):
-                yield {
-                    "uid": uid,
-                    "predictionScore": float(scores[i]),
-                    "label": None if np.isnan(labels[i]) else float(labels[i]),
-                    "scoreComponents": {
-                        k: float(v[i]) for k, v in parts.items()
-                    },
-                }
+                yield _scoring_record(uid, scores[i], labels[i], parts, i)
 
         write_avro_file(os.path.join(args.output_dir, "scores.avro"),
                         records(), SCORING_RESULT_SCHEMA)
@@ -193,14 +197,7 @@ def _score_out_of_core(args, model, index_maps, entity_columns, logger,
                     acc_groups.append(ents[args.group_column])
             n_scored[0] += len(scores)
             for i, uid in enumerate(uids):
-                yield {
-                    "uid": uid,
-                    "predictionScore": float(scores[i]),
-                    "label": (None if np.isnan(labels[i])
-                              else float(labels[i])),
-                    "scoreComponents": {
-                        k: float(v[i]) for k, v in parts.items()},
-                }
+                yield _scoring_record(uid, scores[i], labels[i], parts, i)
 
     with Timed(logger, "score_and_write"):
         write_avro_file(os.path.join(args.output_dir, "scores.avro"),

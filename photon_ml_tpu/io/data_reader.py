@@ -399,37 +399,13 @@ def _chunked_native(windows, index_maps, entity_columns, cols,
 
 def _chunked_python(windows, index_maps, entity_columns, cols,
                     require_response):
-    import io as _io
-    import zlib
-
-    from photon_ml_tpu.io.avro import _read_header, read_datum
-
-    def window_records(window):
-        open_path, f, schema = None, None, None
-        try:
-            for blk in window:
-                if blk.path != open_path:
-                    if f is not None:
-                        f.close()
-                    f = open(blk.path, "rb")
-                    schema, _, _ = _read_header(f, blk.path)
-                    open_path = blk.path
-                f.seek(blk.payload_offset)
-                payload = f.read(blk.payload_size)
-                if blk.codec == "deflate":
-                    payload = zlib.decompress(payload, -15)
-                buf = _io.BytesIO(payload)
-                for _ in range(blk.count):
-                    yield read_datum(buf, schema)
-        finally:
-            if f is not None:
-                f.close()
+    from photon_ml_tpu.io.stream_source import iter_block_records
 
     for window in windows:
         rows_per_shard = {s: [] for s in index_maps}
         labels, offsets, weights, uids = [], [], [], []
         entity_vals = {c: [] for c in entity_columns}
-        for rec in window_records(window):
+        for rec in iter_block_records(window):
             label, offset, weight, uid, evals, shard_rows = _parse_record(
                 rec, cols, index_maps, entity_columns, require_response)
             labels.append(label)

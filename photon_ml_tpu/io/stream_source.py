@@ -49,7 +49,7 @@ from photon_ml_tpu.io.avro import (
 from photon_ml_tpu.parallel.streaming import HostChunk
 
 __all__ = ["AvroChunkSource", "ScalarOverlaySource", "scan_blocks",
-           "BlockRef"]
+           "iter_block_records", "BlockRef"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,6 +90,39 @@ def scan_blocks(paths) -> Tuple[List[BlockRef], object]:
     if schema is None:
         raise ValueError(f"no Avro input files under {paths!r}")
     return blocks, schema
+
+
+def iter_block_records(blocks: Sequence[BlockRef]) -> Iterator[dict]:
+    """Decode an explicit block list with the pure-Python codec, one block
+    payload resident at a time — shared by the chunk source's python
+    fallback and the chunked scoring reader (io/data_reader.py), so the
+    block-walk contract has one definition."""
+    import io as _io
+    import zlib
+
+    from photon_ml_tpu.io.avro import read_datum
+
+    open_path, f, schema = None, None, None
+    try:
+        for blk in blocks:
+            if blk.path != open_path:
+                if f is not None:
+                    f.close()
+                f = open(blk.path, "rb")
+                schema, _, _ = _read_header(f, blk.path)
+                open_path = blk.path
+            f.seek(blk.payload_offset)
+            payload = f.read(blk.payload_size)
+            if len(payload) != blk.payload_size:
+                raise ValueError(f"{blk.path}: truncated block")
+            if blk.codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            buf = _io.BytesIO(payload)
+            for _ in range(blk.count):
+                yield read_datum(buf, schema)
+    finally:
+        if f is not None:
+            f.close()
 
 
 class _Ragged:
@@ -434,36 +467,6 @@ class AvroChunkSource:
             if f is not None:
                 f.close()
 
-    def _python_records(self) -> Iterator[dict]:
-        """Decode exactly ``self._blocks`` (honors ``process_part``) with
-        the pure-Python codec, one block payload resident at a time."""
-        import io as _io
-        import zlib
-
-        from photon_ml_tpu.io.avro import read_datum
-
-        open_path, f, schema = None, None, None
-        try:
-            for blk in self._blocks:
-                if blk.path != open_path:
-                    if f is not None:
-                        f.close()
-                    f = open(blk.path, "rb")
-                    schema, _, _ = _read_header(f, blk.path)
-                    open_path = blk.path
-                f.seek(blk.payload_offset)
-                payload = f.read(blk.payload_size)
-                if len(payload) != blk.payload_size:
-                    raise ValueError(f"{blk.path}: truncated block")
-                if blk.codec == "deflate":
-                    payload = zlib.decompress(payload, -15)
-                buf = _io.BytesIO(payload)
-                for _ in range(blk.count):
-                    yield read_datum(buf, schema)
-        finally:
-            if f is not None:
-                f.close()
-
     def _python_waves(self) -> Iterator[tuple]:
         """Pure-Python fallback: block-at-a-time record streaming through
         the codec, mapped through the index map — bounded memory, no
@@ -482,7 +485,7 @@ class AvroChunkSource:
                     np.asarray(lab, np.float64), np.asarray(off, np.float64),
                     np.asarray(wt, np.float64))
 
-        for rec in self._python_records():
+        for rec in iter_block_records(self._blocks):
             val = rec.get(cols.response)
             if val is None:
                 if self._require_response:
