@@ -13,10 +13,8 @@ import argparse
 import os
 from typing import Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.game.descent import GameDataset
 from photon_ml_tpu.game.scoring import score_game_model
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.data_reader import read_training_examples

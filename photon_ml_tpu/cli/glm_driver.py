@@ -25,7 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
-from typing import Dict, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp

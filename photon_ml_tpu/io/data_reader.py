@@ -18,11 +18,7 @@ import numpy as np
 from photon_ml_tpu.game.data import HostSparse
 from photon_ml_tpu.io.avro import iter_avro_records, write_avro_file
 from photon_ml_tpu.io.index_map import IndexMap
-from photon_ml_tpu.io.schemas import (
-    INTERCEPT_KEY,
-    TRAINING_EXAMPLE_SCHEMA,
-    feature_key,
-)
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
 import dataclasses
 
 
