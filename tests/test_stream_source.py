@@ -315,3 +315,31 @@ def test_streamed_summary_implicit_ones(rng):
     for field in ("mean", "variance", "num_nonzeros", "min", "max"):
         np.testing.assert_allclose(getattr(got, field), getattr(ref, field),
                                    err_msg=field)
+
+
+def test_corrupt_file_fails_cleanly_not_hangs(tmp_path, rng):
+    """Truncation or flipped sync markers surface as a clean ValueError
+    from the consuming iterator (propagated out of the producer thread),
+    never a hang or a silent short read."""
+    path, imap = _write_dataset(tmp_path, rng, n=120, block_size=16)
+    raw = open(path, "rb").read()
+
+    # truncated mid-block: scan (header walk) must reject it
+    trunc = tmp_path / "trunc.avro"
+    trunc.write_bytes(raw[: len(raw) - 37])
+    with pytest.raises(ValueError):
+        AvroChunkSource(str(trunc), imap, chunk_rows=32, pad_nnz=8)
+
+    # valid scan, corrupted payload byte: decode must raise, and the
+    # error must reach the CONSUMER of the bounded queue
+    src_ok = AvroChunkSource(path, imap, chunk_rows=32)
+    blocks, _ = scan_blocks(path)
+    corrupt = bytearray(raw)
+    mid = blocks[1].payload_offset + blocks[1].payload_size // 2
+    corrupt[mid] ^= 0xFF
+    bad = tmp_path / "bad.avro"
+    bad.write_bytes(bytes(corrupt))
+    src = AvroChunkSource(str(bad), imap, chunk_rows=32,
+                          pad_nnz=src_ok.pad_nnz)
+    with pytest.raises(Exception):
+        list(src)
