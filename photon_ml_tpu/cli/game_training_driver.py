@@ -99,10 +99,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="feature shards that must NEVER materialize in "
                         "host RAM: their coordinates (streaming fixed "
                         "effects) re-decode Avro block waves from disk "
-                        "every optimizer pass (io/stream_source.py). "
-                        "Requires a pinned feature space for those shards "
-                        "(--hash-dim or --index-map) and no "
-                        "normalization/summarization on them")
+                        "every optimizer pass (io/stream_source.py); "
+                        "multi-process runs give each process its own "
+                        "contiguous block share. Requires a pinned "
+                        "feature space (--hash-dim or --index-map); "
+                        "normalization works via a streamed "
+                        "summarization pass")
     p.add_argument("--chunk-rows", type=int, default=1 << 16,
                    help="rows per streamed chunk (--streaming)")
     p.add_argument("--tuning-mode", default="none",
@@ -277,9 +279,6 @@ def main(argv: Sequence[str] | None = None) -> int:
             raise SystemExit("--out-of-core-shards needs a pinned feature "
                              "space (--hash-dim or --index-map): building "
                              "an index map scans the full dataset")
-        if distributed:
-            raise SystemExit("--out-of-core-shards is single-process (give "
-                             "each process its own source via the API)")
         # only streaming FIXED coordinates can consume a disk-backed
         # shard; a random coordinate's data layer needs resident features
         ooc_chunk_rows: Dict[str, int] = {}
@@ -316,10 +315,16 @@ def main(argv: Sequence[str] | None = None) -> int:
                 base = ooc_chunk_rows.get(shard, args.chunk_rows)
                 return -(-base // n_local) * n_local
 
+            # multi-process: each process keeps its own contiguous block
+            # share; per-pass partials reduce across processes and scoring
+            # reassembles via the recorded part spans
+            part = ((jax.process_index(), jax.process_count())
+                    if distributed else None)
             train.feature_sources = {
                 s_: AvroChunkSource(args.train_data, index_maps[s_],
                                     chunk_rows=_cr(s_), columns=columns,
-                                    pad_nnz=args.pad_nnz, dtype=dtype)
+                                    pad_nnz=args.pad_nnz, dtype=dtype,
+                                    process_part=part)
                 for s_ in ooc_shards
             }
     validation = None

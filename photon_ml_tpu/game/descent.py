@@ -428,17 +428,34 @@ class _FixedState:
             raise ValueError(
                 f"coordinate '{cfg.name}': down-sampling needs row "
                 "indexing; not supported out of core")
-        if jax.process_count() > 1:
+        pc = jax.process_count()
+        total_rows = getattr(source, "total_rows", source.rows)
+        if pc > 1:
+            # multi-controller: every process holds its OWN contiguous
+            # block share of the same file set
+            # (AvroChunkSource(process_part=(i, pc))); per-pass partials
+            # reduce across processes inside parallel/streaming.py, and
+            # scoring reassembles via the parts' recorded row spans
+            spans = getattr(source, "part_spans", None)
+            if not spans or len(spans) != pc:
+                raise ValueError(
+                    f"coordinate '{cfg.name}': multi-process out-of-core "
+                    "training needs a per-process "
+                    f"AvroChunkSource(process_part=(i, {pc})) — this "
+                    "source has no matching part_spans")
+            if (spans[0][0] != 0 or spans[-1][1] != total_rows or any(
+                    spans[i][1] != spans[i + 1][0] for i in range(pc - 1))):
+                raise ValueError(
+                    f"coordinate '{cfg.name}': part spans {spans} do not "
+                    "tile the dataset (need >= one container block per "
+                    "process — rewrite the data with a smaller "
+                    "block_size)")
+        if total_rows != data.num_samples:
             raise ValueError(
-                f"coordinate '{cfg.name}': multi-process out-of-core "
-                "training passes each process its own "
-                "AvroChunkSource(process_part=...) — a shared source "
-                "cannot be row-sliced per process")
-        if source.rows != data.num_samples:
-            raise ValueError(
-                f"coordinate '{cfg.name}': source has {source.rows} rows, "
+                f"coordinate '{cfg.name}': source has {total_rows} rows, "
                 f"dataset has {data.num_samples} — they must be the same "
                 "data in the same order")
+        lo, hi = getattr(source, "row_span", (0, source.rows))
         self.streaming = True
         self.train_rows = jnp.arange(data.num_samples)
         self.w = None
@@ -453,8 +470,15 @@ class _FixedState:
                                   intercept_index=cfg.intercept_index)
         cfg_opt = cfg.opt_config()
         use_mesh = mesh is not None and "data" in mesh.shape
-        self._stream_mesh = mesh if use_mesh else None
-        if use_mesh and source.chunk_rows % len(jax.local_devices()):
+        if use_mesh and pc > 1:
+            # chunk sharding stays on a process-LOCAL mesh so per-process
+            # partials are local sums (same policy as the in-RAM branch)
+            self._stream_mesh = make_mesh({"data": len(jax.local_devices())},
+                                          devices=jax.local_devices())
+        else:
+            self._stream_mesh = mesh if use_mesh else None
+        if (self._stream_mesh is not None
+                and source.chunk_rows % len(jax.local_devices())):
             raise ValueError(
                 f"coordinate '{cfg.name}': source chunk_rows="
                 f"{source.chunk_rows} must divide the "
@@ -463,16 +487,19 @@ class _FixedState:
         self._offset_sharding = None
         self._ooc_source = source
         self._score_chunks = source  # features-only streamed scoring
-        self._score_span = (0, self.n_all)
+        self._score_span = (lo, hi)
+        self._ooc_part_spans = getattr(source, "part_spans", None)
         self._batch_parts = None
-        labels = data.labels
-        weights = data.weights
+        # this process's slice of the dataset-level scalars (full slice
+        # in single-process mode)
+        labels = data.labels[lo:hi]
+        weights = data.weights[lo:hi]
         dim = self.dim
 
         def _fit(w0, offs, l2, l1):
-            overlay = ScalarOverlaySource(source, labels=labels,
-                                          weights=weights,
-                                          offsets=np.asarray(offs))
+            overlay = ScalarOverlaySource(
+                source, labels=labels, weights=weights,
+                offsets=np.asarray(offs)[lo:hi])
             self._last_chunks = overlay
             return fit_streaming(
                 self.obj, overlay, dim, w0=w0, l2=float(l2), l1=float(l1),
@@ -530,7 +557,10 @@ class _FixedState:
         streamed pass, so no device-resident feature copy exists."""
         if not self.streaming:
             return _margins(self.full_features, w_model)
-        from photon_ml_tpu.parallel.multihost import allgather_spans
+        from photon_ml_tpu.parallel.multihost import (
+            allgather_spans,
+            allgather_varspans,
+        )
 
         w_model = jnp.asarray(w_model, self.dtype)
         outs = []
@@ -543,6 +573,11 @@ class _FixedState:
             outs.append(np.asarray(_margins_jit(feats, w_model)))
         s0, s1 = self._score_span
         local = np.concatenate(outs)[: s1 - s0]
+        # out-of-core block parts are contiguous but not span_of-aligned:
+        # reassemble via the parts' recorded row spans
+        if getattr(self, "_ooc_part_spans", None) is not None:
+            return jnp.asarray(allgather_varspans(local,
+                                                  self._ooc_part_spans))
         return jnp.asarray(allgather_spans(local, self.n_all))
 
     def model_space_w(self) -> jax.Array:

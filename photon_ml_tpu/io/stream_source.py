@@ -283,6 +283,13 @@ class AvroChunkSource:
         self._prefetch = max(int(prefetch), 0)
         self._require_response = bool(require_response)
         self._blocks, self._schema = scan_blocks(paths)
+        self.total_rows = sum(b.count for b in self._blocks)
+        # absolute-row span of the kept blocks (block parts are CONTIGUOUS
+        # row ranges); with process_part, every part's span is recorded so
+        # multi-controller consumers can reassemble globally-ordered
+        # vectors (multihost.allgather_varspans)
+        self.row_span = (0, self.total_rows)
+        self.part_spans = None
         if process_part is not None:
             part, n_parts = process_part
             if not 0 <= part < n_parts:
@@ -290,10 +297,25 @@ class AvroChunkSource:
             counts = np.asarray([b.count for b in self._blocks])
             starts = np.cumsum(counts) - counts
             total = int(counts.sum())
-            lo = part * total // n_parts
-            hi = (part + 1) * total // n_parts
-            self._blocks = [b for b, s in zip(self._blocks, starts)
-                            if lo <= s < hi]
+
+            def kept(i):
+                lo = i * total // n_parts
+                hi = (i + 1) * total // n_parts
+                return [(b, int(s)) for b, s in zip(self._blocks, starts)
+                        if lo <= s < hi]
+
+            self.part_spans = []
+            for i in range(n_parts):
+                blocks_i = kept(i)
+                if blocks_i:
+                    s0 = blocks_i[0][1]
+                    s1 = blocks_i[-1][1] + blocks_i[-1][0].count
+                else:
+                    s0 = s1 = 0
+                self.part_spans.append((s0, s1))
+            mine = kept(part)
+            self._blocks = [b for b, _ in mine]
+            self.row_span = self.part_spans[part]
         self.rows = sum(b.count for b in self._blocks)
         if self.rows == 0:
             raise ValueError(f"no records under {paths!r}")

@@ -96,3 +96,28 @@ def runtime_info() -> dict:
         "global_devices": len(jax.devices()),
         "platform": jax.devices()[0].platform,
     }
+
+
+def allgather_varspans(local: "np.ndarray", spans) -> "np.ndarray":
+    """Reassemble a globally-ordered vector from per-process CONTIGUOUS
+    row spans of ARBITRARY sizes (``spans``: one (start, stop) per
+    process, identical on every process — e.g. block-aligned out-of-core
+    input splits, which are contiguous but not ``process_span``-aligned).
+    Generalizes :func:`allgather_spans` (which assumes ``span_of``
+    slicing)."""
+    import jax
+    import numpy as np
+
+    local = np.asarray(local)
+    p = jax.process_count()
+    if p == 1:
+        return local
+    assert len(spans) == p, (len(spans), p)
+    from jax.experimental import multihost_utils
+
+    max_len = max(stop - start for start, stop in spans)
+    padded = np.zeros((max_len,) + local.shape[1:], local.dtype)
+    padded[: len(local)] = local
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return np.concatenate([gathered[i, : stop - start]
+                           for i, (start, stop) in enumerate(spans)])

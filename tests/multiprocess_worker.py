@@ -139,6 +139,65 @@ def run_ooc_streamed_fit(data_dir):
             "data_path": path}
 
 
+def run_game_ooc_step(data_dir):
+    """One GAME CD run whose FIXED EFFECT streams from disk with
+    per-process block shares (GameDataset.feature_sources +
+    AvroChunkSource(process_part)): partials reduce across processes,
+    scores reassemble via part spans."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        GameDataset,
+    )
+    from photon_ml_tpu.game.data import HostSparse
+    from photon_ml_tpu.io.data_reader import write_training_examples
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+
+    path = os.path.join(data_dir, "game_ooc_mp.avro")
+    X, y, ids = make_problem()
+    n, d = X.shape
+    if jax.process_index() == 0:
+        rows = [[(f"f{j}", "", float(v)) for j, v in enumerate(r)
+                 if v != 0] for r in X]
+        write_training_examples(path, rows, y,
+                                entity_ids={"userId": ids.astype(str)},
+                                block_size=16)
+        open(path + ".done2", "w").close()
+    else:
+        import time
+
+        while not os.path.exists(path + ".done2"):
+            time.sleep(0.05)
+    imap = IndexMap({f"f{j}": j for j in range(d)}, add_intercept=False)
+    src = AvroChunkSource(
+        path, imap, chunk_rows=32, dtype=np.float64,
+        process_part=(jax.process_index(), jax.process_count()))
+    # RE shard stays resident per process (dense X rebuilt as sparse rows)
+    idx = np.broadcast_to(np.arange(d, dtype=np.int32), X.shape).copy()
+    ds = GameDataset({"re": HostSparse(idx, X, d)}, y, None, None,
+                     {"userId": ids.astype(str)},
+                     feature_sources={"global": src})
+    cfgs = [
+        CoordinateConfig("global", streaming=True, chunk_rows=32,
+                         reg_type="l2", reg_weight=0.5,
+                         max_iters=150, tolerance=1e-13),
+        CoordinateConfig("per-user", coordinate_type="random",
+                         feature_shard="re", entity_column="userId",
+                         reg_type="l2", reg_weight=1.0, max_iters=150,
+                         tolerance=1e-13),
+    ]
+    cd = CoordinateDescent(cfgs, task="logistic", n_iterations=2,
+                           dtype=jnp.float64)
+    model, _ = cd.run(ds)
+    w = np.asarray(model.coordinates["global"].model.coefficients.means)
+    return {"w_fixed": w.tolist(), "data_path": path}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True)
@@ -163,6 +222,7 @@ def main():
         "fit_distributed": run_fit_distributed(),
         "game_streaming": run_game_streaming_step(),
         "ooc_streaming": run_ooc_streamed_fit(os.path.dirname(args.out)),
+        "game_ooc": run_game_ooc_step(os.path.dirname(args.out)),
     }
     if args.process_id == 0:
         with open(args.out, "w") as f:

@@ -109,3 +109,44 @@ def test_ooc_streamed_fit_across_processes(two_process_results):
         os.path.dirname(two_process_results["__file__"]), "ooc_mp.avro")) \
         if "__file__" in two_process_results else []
     assert mp["value"] > 0
+
+
+def test_game_ooc_fixed_across_processes(two_process_results):
+    """GAME CD with the fixed effect streaming from disk in per-process
+    block shares == the single-process run over the same file."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.data import HostSparse
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        GameDataset,
+    )
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+    from multiprocess_worker import make_problem
+
+    mp = two_process_results["game_ooc"]
+    X, y, ids = make_problem()
+    n, d = X.shape
+    imap = IndexMap({f"f{j}": j for j in range(d)}, add_intercept=False)
+    src = AvroChunkSource(mp["data_path"], imap, chunk_rows=32,
+                          dtype=np.float64)
+    idx = np.broadcast_to(np.arange(d, dtype=np.int32), X.shape).copy()
+    ds = GameDataset({"re": HostSparse(idx, X, d)}, y, None, None,
+                     {"userId": ids.astype(str)},
+                     feature_sources={"global": src})
+    cfgs = [
+        CoordinateConfig("global", streaming=True, chunk_rows=32,
+                         reg_type="l2", reg_weight=0.5,
+                         max_iters=150, tolerance=1e-13),
+        CoordinateConfig("per-user", coordinate_type="random",
+                         feature_shard="re", entity_column="userId",
+                         reg_type="l2", reg_weight=1.0, max_iters=150,
+                         tolerance=1e-13),
+    ]
+    model, _ = CoordinateDescent(cfgs, task="logistic", n_iterations=2,
+                                 dtype=jnp.float64).run(ds)
+    w_one = np.asarray(model.coordinates["global"].model.coefficients.means)
+    np.testing.assert_allclose(np.asarray(mp["w_fixed"]), w_one,
+                               rtol=1e-6, atol=1e-9)
