@@ -28,6 +28,7 @@ import json
 import os
 from typing import Dict, List, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -185,6 +186,7 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     distributed = initialize_multihost(args.coordinator_address,
                                        args.num_processes, args.process_id)
+    is_lead = (not distributed) or jax.process_index() == 0
     dtype = resolve_dtype(args.dtype)
     task = TASK_TO_LOSS.get(args.task, args.task)
     os.makedirs(args.output_dir, exist_ok=True)
@@ -343,19 +345,31 @@ def main(argv: Sequence[str] | None = None) -> int:
             for shard in shards:
                 if shard in ooc_shards:
                     # one extra streamed pass over the disk-backed shard:
-                    # per-feature moments without a resident copy
+                    # per-feature moments without a resident copy. A
+                    # multi-controller run streams only the local block
+                    # part, so the raw moments are all-reduced and
+                    # finalized against the GLOBAL row count — otherwise
+                    # each process would build a normalization context
+                    # from its own data half and the summed gradients
+                    # would mix feature spaces.
                     from photon_ml_tpu.ops.statistics import (
                         summarize_features_streamed,
+                    )
+                    from photon_ml_tpu.parallel.multihost import (
+                        allreduce_summary_moments,
                     )
 
                     src = train.feature_sources[shard]
                     summary = summarize_features_streamed(
-                        src, src.dim, src.rows)
+                        src, src.dim, src.rows,
+                        total_rows=src.total_rows,
+                        part_reduce=(allreduce_summary_moments
+                                     if distributed else None))
                 else:
                     sp = train.features[shard]
                     batch = make_batch(_to_sparse_features(sp), train.labels)
                     summary = summarize_features(batch)
-                if args.summarize_features:
+                if args.summarize_features and is_lead:
                     _write_summary(args.output_dir, summary, index_maps[shard],
                                    suffix=shard)
                 if norm_type != NormalizationType.NONE:
@@ -423,12 +437,18 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     best = estimator.select_best(results)
     with Timed(logger, "save_models"):
-        save_game_model(best.model, os.path.join(args.output_dir, "best"), index_maps)
-        if args.save_all_models:
-            for gi, r in enumerate(results):
-                save_game_model(r.model,
-                                os.path.join(args.output_dir, "all", f"config-{gi}"),
-                                index_maps)
+        # every process reaches the same model; only the lead writes, so
+        # co-located multi-controller processes never interleave writes
+        # to one output path
+        if is_lead:
+            save_game_model(best.model, os.path.join(args.output_dir, "best"),
+                            index_maps)
+            if args.save_all_models:
+                for gi, r in enumerate(results):
+                    save_game_model(
+                        r.model,
+                        os.path.join(args.output_dir, "all", f"config-{gi}"),
+                        index_maps)
     logger.log("driver_done",
                best_config=[dataclasses_asdict(c) for c in best.configs],
                best_metrics=None if best.evaluation is None else best.evaluation.metrics)

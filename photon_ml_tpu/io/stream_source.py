@@ -297,25 +297,29 @@ class AvroChunkSource:
             counts = np.asarray([b.count for b in self._blocks])
             starts = np.cumsum(counts) - counts
             total = int(counts.sum())
-
-            def kept(i):
-                lo = i * total // n_parts
-                hi = (i + 1) * total // n_parts
-                return [(b, int(s)) for b, s in zip(self._blocks, starts)
-                        if lo <= s < hi]
-
+            # one vectorized boundary pass: part i owns the blocks whose
+            # start row falls in [i*total//n_parts, (i+1)*total//n_parts)
+            lows = np.asarray([i * total // n_parts
+                               for i in range(n_parts + 1)])
+            edges = np.searchsorted(starts, lows, side="left")
             self.part_spans = []
             for i in range(n_parts):
-                blocks_i = kept(i)
-                if blocks_i:
-                    s0 = blocks_i[0][1]
-                    s1 = blocks_i[-1][1] + blocks_i[-1][0].count
+                e0, e1 = int(edges[i]), int(edges[i + 1])
+                if e0 < e1:
+                    s0 = int(starts[e0])
+                    s1 = int(starts[e1 - 1]) + self._blocks[e1 - 1].count
                 else:
                     s0 = s1 = 0
                 self.part_spans.append((s0, s1))
-            mine = kept(part)
-            self._blocks = [b for b, _ in mine]
+            e0, e1 = int(edges[part]), int(edges[part + 1])
+            self._blocks = self._blocks[e0:e1]
             self.row_span = self.part_spans[part]
+            if not self._blocks:
+                raise ValueError(
+                    f"process_part {part}/{n_parts} owns no container "
+                    f"blocks ({len(counts)} blocks for {n_parts} parts): "
+                    "rewrite the dataset with a smaller block_size so "
+                    "every process gets >= one block")
         self.rows = sum(b.count for b in self._blocks)
         if self.rows == 0:
             raise ValueError(f"no records under {paths!r}")

@@ -25,12 +25,11 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 _LANES = 128
 
 
-def _mps_kernel(v_ref, d_ref, out_ref, tot_ref):
+def _mps_kernel(v_ref, d_ref, out_ref):
     """One [T, 128] tile: fused multiply + TILE-LOCAL inclusive prefix sum,
     plus the tile's total. No cross-tile carry: a global running prefix
     would reintroduce the f32 boundary-difference cancellation the blocked
@@ -59,13 +58,12 @@ def _mps_kernel(v_ref, d_ref, out_ref, tot_ref):
     row_excl = jnp.dot(match_vma((rb < ra).astype(dtype), x), row_tot,
                        preferred_element_type=dtype)  # [rows, 1]
 
+    # the tile total is the local prefix's last element; the wrapper slices
+    # it out of this output, so the kernel has no second (scalar-shaped)
+    # output — the r05 chip session showed Mosaic pads an [n_tiles, 1]
+    # SMEM output window to 512 B/element, overflowing SMEM at bench-shape
+    # tile counts (docs/tpu_r05_logs/bench.log: u8[1277952] > 1 MB)
     out_ref[:] = lane_cum + row_excl
-    # tot_ref is the FULL [n_tiles, 1] totals array in SMEM (Mosaic
-    # requires block shape == array shape for non-(8,128)-divisible
-    # blocks; a (1,1) block per grid step fails to lower); each grid step
-    # writes its own slot
-    tot_ref[pl.program_id(0), 0] = (row_excl[rows - 1, 0]
-                                    + row_tot[rows - 1, 0])
 
 
 def _mps_call(v, d, n_tiles, block_rows, interpret):
@@ -82,12 +80,8 @@ def _mps_call(v, d, n_tiles, block_rows, interpret):
             pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
             pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
-            pl.BlockSpec((n_tiles, 1), lambda i: (0, 0),
-                         memory_space=pltpu.SMEM),
-        ],
-        out_shape=[_shape(v.shape), _shape((n_tiles, 1))],
+        out_specs=pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+        out_shape=_shape(v.shape),
         interpret=interpret,
     )(v, d)
 
@@ -121,7 +115,7 @@ def multiply_prefix_sum(
     d = jnp.pad(d_sorted, (0, pad)).reshape(-1, _LANES)
 
     if interpret is None:
-        local, totals = jax.lax.platform_dependent(
+        local = jax.lax.platform_dependent(
             v, d,
             tpu=functools.partial(_mps_call, n_tiles=n_tiles,
                                   block_rows=block_rows, interpret=False),
@@ -129,8 +123,9 @@ def multiply_prefix_sum(
                                       block_rows=block_rows, interpret=True),
         )
     else:
-        local, totals = _mps_call(v, d, n_tiles, block_rows, interpret)
-    return local.reshape(-1), totals.reshape(-1), tile
+        local = _mps_call(v, d, n_tiles, block_rows, interpret)
+    totals = local.reshape(n_tiles, -1)[:, -1]
+    return local.reshape(-1), totals, tile
 
 
 def csc_transpose_apply_pallas(csc, d: jax.Array) -> jax.Array:
@@ -140,10 +135,10 @@ def csc_transpose_apply_pallas(csc, d: jax.Array) -> jax.Array:
     grow with nnz). The implicit-ones layout materializes a ones vector
     here (the kernel is a two-operand scan); prefer sparse_grad='csc' for
     binary data."""
-    from photon_ml_tpu.types import blocked_boundary_combine
+    from photon_ml_tpu.types import blocked_boundary_combine, table_gather
 
-    values = (jnp.ones_like(d[csc.rows]) if csc.values is None
-              else csc.values)
-    local, totals, tile = multiply_prefix_sum(values, d[csc.rows])
+    dg = table_gather(d, csc.rows)
+    values = jnp.ones_like(dg) if csc.values is None else csc.values
+    local, totals, tile = multiply_prefix_sum(values, dg)
     out = blocked_boundary_combine(local, totals, csc.col_starts, tile)
     return out.astype(d.dtype)

@@ -97,8 +97,9 @@ def summarize_features(batch: LabeledBatch) -> FeatureSummary:
     )
 
 
-def summarize_features_streamed(chunks, dim: int,
-                                num_rows: int) -> FeatureSummary:
+def summarize_features_streamed(chunks, dim: int, num_rows: int,
+                                total_rows: int = None,
+                                part_reduce=None) -> FeatureSummary:
     """``summarize_features`` over ONE streamed pass of a chunk source
     (``parallel.streaming.HostChunk`` iterable — in-RAM lists or the
     disk-backed ``io.stream_source.AvroChunkSource``): per-feature f64
@@ -109,7 +110,15 @@ def summarize_features_streamed(chunks, dim: int,
     with trailing padding rows in the final chunk, and padding must not
     count as rows of implicit zeros (it would bias means/variances). A
     genuine weight-0 row, by contrast, still counts — summarization is
-    unweighted, matching the in-RAM function."""
+    unweighted, matching the in-RAM function.
+
+    Multi-controller runs stream only the local process part: pass the
+    GLOBAL row count as ``total_rows`` (``num_rows`` stays the LOCAL count
+    that caps final-chunk padding) and a ``part_reduce(s1, s2, nnz, mx,
+    mn)`` that all-reduces the raw moments across processes
+    (``multihost.allreduce_summary_moments``) — otherwise each process
+    would finalize a summary of only its own rows and normalization
+    contexts would silently diverge between processes."""
     s1 = np.zeros(dim)
     s2 = np.zeros(dim)
     nnz = np.zeros(dim)
@@ -140,7 +149,9 @@ def summarize_features_streamed(chunks, dim: int,
             np.add.at(nnz, idx, 1.0)
             np.maximum.at(mx, idx, val)
             np.minimum.at(mn, idx, val)
-    n = num_rows
+    if part_reduce is not None:
+        s1, s2, nnz, mx, mn = part_reduce(s1, s2, nnz, mx, mn)
+    n = num_rows if total_rows is None else total_rows
     has_zero = nnz < n
     mx = np.where(has_zero, np.maximum(mx, 0.0), mx)
     mn = np.where(has_zero, np.minimum(mn, 0.0), mn)

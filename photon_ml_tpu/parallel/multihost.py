@@ -63,26 +63,40 @@ def process_span(total_rows: int) -> Tuple[int, int]:
 
 def allgather_spans(local: "np.ndarray", total_rows: int) -> "np.ndarray":
     """Reassemble a globally-ordered [total_rows] vector from per-process
-    ``process_span`` slices (each process passes its own slice). Spans are
-    padded to a common length for the allgather, then re-trimmed."""
+    ``process_span`` slices (each process passes its own slice): the
+    ``span_of``-sliced special case of :func:`allgather_varspans`."""
+    import jax
+
+    p = jax.process_count()
+    return allgather_varspans(local,
+                              [span_of(total_rows, i, p) for i in range(p)])
+
+
+def allreduce_summary_moments(s1, s2, nnz, mx, mn):
+    """All-reduce the raw per-feature moment accumulators of a streamed
+    feature summarization across processes (sum for the power sums and
+    nonzero counts, max/min for the extrema). Passed as ``part_reduce`` to
+    ``ops.statistics.summarize_features_streamed`` by multi-controller
+    drivers so every process finalizes the same GLOBAL summary."""
     import jax
     import numpy as np
 
-    p = jax.process_count()
-    if p == 1:
-        return np.asarray(local)
+    if jax.process_count() == 1:
+        return s1, s2, nnz, mx, mn
     from jax.experimental import multihost_utils
 
-    local = np.asarray(local)
-    max_len = -(-total_rows // p)  # ceil: no span is longer
-    padded = np.zeros((max_len,) + local.shape[1:], local.dtype)
-    padded[: len(local)] = local
-    gathered = np.asarray(multihost_utils.process_allgather(padded))
-    parts = []
-    for i in range(p):
-        start, stop = span_of(total_rows, i, p)
-        parts.append(gathered[i, : stop - start])
-    return np.concatenate(parts)
+    def gather_f64(a):
+        # process_allgather round-trips through jax arrays, which silently
+        # downcast f64 to f32 without jax_enable_x64 — destroying exactly
+        # the accumulator precision the streamed summarization guarantees.
+        # An int32 view is bit-preserving through the gather.
+        a = np.ascontiguousarray(np.asarray(a, np.float64))
+        bits = multihost_utils.process_allgather(a.view(np.int32))
+        return np.ascontiguousarray(np.asarray(bits)).view(np.float64)
+
+    g1, g2, gn, gx, gm = (gather_f64(a) for a in (s1, s2, nnz, mx, mn))
+    return (g1.sum(axis=0), g2.sum(axis=0), gn.sum(axis=0),
+            gx.max(axis=0), gm.min(axis=0))
 
 
 def runtime_info() -> dict:

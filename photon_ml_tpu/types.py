@@ -15,12 +15,98 @@ the hot ops — margin gather and gradient scatter-add — vectorized.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct
+
+# ---------------------------------------------------------------------------
+# 1-D table gather for the sparse hot path.
+#
+# XLA:TPU lowers a word-granular gather (slice size 1) to a serial loop —
+# ~1 element/cycle. Measured on the v5e chip (docs/tpu_r05_logs/tpu_diag.log):
+# the 82M-element margin gather ran at ~1 GB/s, 0.1% of HBM peak, and the
+# whole L-BFGS iteration was 2x that gather. The fix is the standard TPU
+# embedding-lookup shape: reshape the table to [d/128, 128] so each gathered
+# element is a full 128-lane row (a vectorizable (1,128)-slice gather), then
+# select the wanted lane with a one-hot multiply+reduce on the VPU. The sum
+# adds exactly one real value and 127 zeros, so the result is bit-identical
+# to ``table[idx]``.
+#
+# The row-gather materializes a [m, 128] intermediate; for large m it runs
+# under ``lax.map`` over fixed-size chunks so the intermediate stays ~128 MB
+# regardless of nnz (the bench shape's 82M nnz would otherwise need 42 GB).
+# ---------------------------------------------------------------------------
+
+_LANES = 128
+_GATHER_CHUNK = 1 << 18  # rows per lax.map step: [2^18, 128] f32 = 128 MB
+_GATHER_MIN_SIZE = 1 << 14  # below this, the serial gather costs < ~20 us
+_gather_mode = os.environ.get("PHOTON_GATHER", "auto")
+
+
+def set_gather_mode(mode: str) -> None:
+    """'auto' (vector on TPU, scalar elsewhere), 'scalar', or 'vector'.
+
+    The mode is read at TRACE time, so a change must invalidate every
+    cached executable that baked the old mode in — otherwise an A/B
+    (bench calibration, parity tests) would silently re-time the cached
+    path and measure nothing. Flipping the mode is a rare, human-driven
+    event; the recompile cost is accepted."""
+    global _gather_mode
+    if mode not in ("auto", "scalar", "vector"):
+        raise ValueError(f"unknown gather mode {mode!r}")
+    if mode != _gather_mode:
+        _gather_mode = mode
+        jax.clear_caches()
+
+
+def gather_mode() -> str:
+    return _gather_mode
+
+
+def _vector_gather_rows(table2d: jax.Array, idx: jax.Array) -> jax.Array:
+    rows = jnp.take(table2d, jnp.right_shift(idx, 7), axis=0)
+    lane = jnp.bitwise_and(idx, 127)
+    onehot = lane[:, None] == jnp.arange(_LANES, dtype=idx.dtype)[None, :]
+    return jnp.sum(jnp.where(onehot, rows, 0), axis=-1)
+
+
+def table_gather(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """``table[idx]`` for a 1-D table, vectorized for TPU when profitable.
+
+    Bit-identical to the serial gather on every path (the lane select adds
+    one real value and 127 zeros). 'auto' resolves per trace-time backend:
+    the vector form pays an extra [m, 128] stream, which wins ~15x on TPU
+    where the serial gather is the bottleneck but loses on CPU where the
+    serial gather is already fast.
+    """
+    mode = _gather_mode
+    if mode == "auto":
+        # TPU only: the serial-gather pathology is a TPU lowering property
+        # (measured docs/tpu_r05_logs/tpu_diag.log); GPUs and CPUs gather
+        # words natively and would only pay the [m, 128] expansion
+        mode = "vector" if jax.default_backend() == "tpu" else "scalar"
+    if (mode == "scalar" or table.ndim != 1
+            or idx.size < _GATHER_MIN_SIZE or table.shape[0] < _LANES):
+        return table[idx]
+    d = table.shape[0]
+    dp = -(-d // _LANES) * _LANES
+    table2d = jnp.pad(table, (0, dp - d)).reshape(dp // _LANES, _LANES)
+    flat = idx.reshape(-1).astype(jnp.int32)
+    m = flat.shape[0]
+    if m <= _GATHER_CHUNK:
+        out = _vector_gather_rows(table2d, flat)
+    else:
+        c = -(-m // _GATHER_CHUNK)
+        flat = jnp.pad(flat, (0, c * _GATHER_CHUNK - m))  # pad idx 0: valid
+        out = jax.lax.map(
+            lambda ix: _vector_gather_rows(table2d, ix),
+            flat.reshape(c, _GATHER_CHUNK),
+        ).reshape(-1)[:m]
+    return out.reshape(idx.shape)
 
 
 @struct.dataclass
@@ -147,8 +233,8 @@ def csc_transpose_apply(csc: CSCTranspose, d: jax.Array,
     ``precise=True`` keeps the old full-f64 global prefix (meaningful
     only under jax_enable_x64; without it, f64 silently degrades to f32,
     which is exactly what the blocked default repairs)."""
-    contrib = (d[csc.rows] if csc.values is None
-               else csc.values * d[csc.rows])
+    dg = table_gather(d, csc.rows)
+    contrib = dg if csc.values is None else csc.values * dg
     if precise:
         prefix = jnp.concatenate([
             jnp.zeros((1,), jnp.float64),
@@ -208,8 +294,8 @@ def csc_segment_apply(csc: CSCTranspose, d: jax.Array) -> jax.Array:
     if csc.cols is None:
         raise ValueError("csc.cols missing: rebuild the CSC view "
                          "(build_csc_transpose now stores sorted cols)")
-    contrib = (d[csc.rows] if csc.values is None
-               else csc.values * d[csc.rows])
+    dg = table_gather(d, csc.rows)
+    contrib = dg if csc.values is None else csc.values * dg
     dim = csc.col_starts.shape[0] - 1
     return jax.ops.segment_sum(contrib, csc.cols, num_segments=dim,
                                indices_are_sorted=True)
@@ -219,8 +305,9 @@ def margins(features: Features, w: jax.Array) -> jax.Array:
     """Per-row margin ``x_i . w`` for dense ``[n, d]`` or sparse features."""
     if isinstance(features, SparseFeatures):
         if features.values is None:  # implicit ones: no value read
-            return jnp.sum(w[features.indices], axis=-1)
-        return jnp.sum(features.values * w[features.indices], axis=-1)
+            return jnp.sum(table_gather(w, features.indices), axis=-1)
+        return jnp.sum(features.values * table_gather(w, features.indices),
+                       axis=-1)
     return features @ w
 
 

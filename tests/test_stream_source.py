@@ -343,3 +343,54 @@ def test_corrupt_file_fails_cleanly_not_hangs(tmp_path, rng):
                           pad_nnz=src_ok.pad_nnz)
     with pytest.raises(Exception):
         list(src)
+
+
+def test_part_reduced_summarization_matches_global(tmp_path, rng):
+    """Per-part streamed summaries, all-reduced via the moment hook +
+    finalized against the GLOBAL row count, must equal the single-source
+    summary — the multi-controller normalization contract (each process
+    streams only its block part; without the reduce each would build a
+    divergent normalization context)."""
+    from photon_ml_tpu.ops.statistics import summarize_features_streamed
+
+    path, imap = _write_dataset(tmp_path, rng, n=210, block_size=16)
+    full = AvroChunkSource(path, imap, chunk_rows=32)
+    want = summarize_features_streamed(full, full.dim, full.rows)
+
+    n_parts = 3
+    parts = [AvroChunkSource(path, imap, chunk_rows=32, pad_nnz=full.pad_nnz,
+                             process_part=(i, n_parts))
+             for i in range(n_parts)]
+    # emulate allreduce_summary_moments without a multi-process runtime:
+    # capture each part's raw moments, then hand every part the reduced set
+    raw = []
+
+    def capture(*m):
+        raw.append(m)
+        return m
+
+    for p in parts:
+        summarize_features_streamed(p, p.dim, p.rows, part_reduce=capture)
+    reduced = (sum(m[0] for m in raw), sum(m[1] for m in raw),
+               sum(m[2] for m in raw),
+               np.maximum.reduce([m[3] for m in raw]),
+               np.minimum.reduce([m[4] for m in raw]))
+    for p in parts:
+        got = summarize_features_streamed(
+            p, p.dim, p.rows, total_rows=full.rows,
+            part_reduce=lambda *m: reduced)
+        for f in ("mean", "variance", "std", "min", "max", "num_nonzeros"):
+            np.testing.assert_allclose(getattr(got, f), getattr(want, f),
+                                       err_msg=f, atol=1e-12)
+        assert got.count == full.rows
+
+
+def test_empty_process_part_raises_actionable_error(tmp_path, rng):
+    """Fewer container blocks than processes: the starved process must get
+    the 'rewrite with a smaller block_size' diagnosis, not a misleading
+    'no records' error."""
+    path, imap = _write_dataset(tmp_path, rng, n=50, block_size=4096)
+    full = AvroChunkSource(path, imap, chunk_rows=32)  # one block
+    with pytest.raises(ValueError, match="smaller block_size"):
+        AvroChunkSource(path, imap, chunk_rows=32, pad_nnz=full.pad_nnz,
+                        process_part=(1, 2))
