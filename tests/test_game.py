@@ -562,3 +562,30 @@ def test_warm_start_prev_subspace_into_sketch(rng):
         for j in range(Dp):
             expect[slots[j]] += signs[j] * coefs[r, j]
         np.testing.assert_allclose(W[r], expect, rtol=1e-12)
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_train_random_effect_blocked_matches_unblocked(rng, monkeypatch,
+                                                       use_mesh):
+    """Entity-block bounded execution (the v5e HBM guard) must reproduce
+    the single-program solve exactly — including with an entity mesh,
+    where the block width rounds to the mesh axis."""
+    from photon_ml_tpu.game import random_effect as re_mod
+    from photon_ml_tpu.parallel import make_mesh
+
+    n, d = 160, 6
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) < 0.5).astype(float)
+    ids = rng.integers(0, 13, size=n)  # 13 entities
+    data = build_random_effect_data(X, y, np.ones(n), ids)
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-10)
+    mesh = make_mesh({"entity": 4}) if use_mesh else None
+    want = train_random_effect(data, np.zeros(n), l2=0.4, dtype=jnp.float64,
+                               config=cfg, mesh=mesh)
+    monkeypatch.setattr(re_mod, "_RE_BLOCK_ENTITIES", 5)  # forces blocks
+    got = train_random_effect(data, np.zeros(n), l2=0.4, dtype=jnp.float64,
+                              config=cfg, mesh=mesh)
+    for a, b in zip(want.coefficients, got.coefficients):
+        np.testing.assert_allclose(a, b, rtol=1e-12, atol=1e-12)
+    assert got.converged_fraction == want.converged_fraction
+    assert got.mean_iterations == want.mean_iterations

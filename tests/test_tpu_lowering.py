@@ -180,3 +180,43 @@ def test_grouped_device_evaluators_lower_for_tpu():
         exp = export.export(jax.jit(fn), platforms=["tpu"])(
             s((N,), jnp.float32), s((N,), jnp.float32), s((N,), jnp.float32))
         assert "stablehlo" in exp.mlir_module(), name
+
+
+def test_vector_gather_fit_lowers_for_tpu():
+    """The r05 vectorized table gather ('auto' on hardware). jax.export
+    runs from a CPU host, where 'auto' traces the SCALAR branch — so the
+    chip's actual path must be pinned to 'vector' explicitly here or the
+    certification would silently cover the wrong program."""
+    from photon_ml_tpu import types as T
+
+    prev = T.gather_mode()
+    T.set_gather_mode("vector")
+    try:
+        for kw in (dict(optimizer="lbfgs"),
+                   dict(optimizer="lbfgs", sparse_grad="csc"),
+                   dict(optimizer="lbfgs", sparse_grad="csc_pallas")):
+            exp = _fit_exporter(**kw)
+            assert exp.nr_devices == 8
+    finally:
+        T.set_gather_mode(prev)
+
+
+def test_vector_gather_chunked_lowers_for_tpu():
+    """The lax.map-chunked large-nnz form (bench shape takes it)."""
+    from photon_ml_tpu import types as T
+
+    prev = T.gather_mode()
+    T.set_gather_mode("vector")
+    old = T._GATHER_CHUNK
+    T._GATHER_CHUNK = 1 << 12  # force chunking at test size
+    try:
+        def f(w, idx):
+            return T.table_gather(w, idx).sum()
+
+        exp = export.export(jax.jit(f), platforms=["tpu"])(
+            jax.ShapeDtypeStruct((1 << 14,), jnp.float32),
+            jax.ShapeDtypeStruct((1 << 14, 8), jnp.int32))
+        assert exp.platforms == ("tpu",)
+    finally:
+        T._GATHER_CHUNK = old
+        T.set_gather_mode(prev)
