@@ -86,6 +86,8 @@ else
 fi
 # 2. Attribute the utilization gap per op (413-safe since r03)
 run profile 2400 64 python scripts/profile_hot_loop.py
+# 2b. Gather-mode A/B (r05: the issue-rate finding; re-measure per round)
+run bench_gather 1800 64 python scripts/bench_gather.py
 # 3. f32-vs-f64 parity (tiny data, subprocess per dtype)
 run f32_parity 1500 64 python scripts/f32_parity.py compare
 # 4. GAME / random-effect path (device-synthesized, watchdogged)
