@@ -359,6 +359,18 @@ def main(argv: Sequence[str] | None = None) -> int:
     w = jnp.zeros((dim,), dtype)
     from photon_ml_tpu.utils import profile_trace
 
+    # the per-dataset column sort behind the csc gradient paths is paid
+    # once for the whole lambda grid, not per fit
+    grid_csc = None
+    if not streaming:
+        from photon_ml_tpu.parallel.data_parallel import (
+            build_csc, resolve_sparse_grad,
+        )
+
+        if resolve_sparse_grad("auto",
+                               batch.features).startswith("csc"):
+            grid_csc = build_csc(objective, batch, mesh)
+
     with Timed(logger, "training"), profile_trace(args.profile_dir):
         for lam in args.reg_weights:
             if streaming:
@@ -378,6 +390,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                     objective, batch, mesh, w,
                     l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
                     optimizer=optimizer, config=opt_config,
+                    precomputed_csc=grid_csc,
                 )
             w = res.w  # warm start the next lambda
             diag = {

@@ -87,9 +87,11 @@ class CoordinateConfig:
     reg_weight: float = 0.0
     elastic_net_alpha: float = 0.5
     down_sampling_rate: float = 1.0  # fixed-effect only
-    # fixed-effect sparse gradient strategy: "scatter" (XLA scatter-add),
-    # "csc" or "csc_pallas" (scatter-free column-sorted — types.CSCTranspose)
-    sparse_grad: str = "scatter"
+    # fixed-effect sparse gradient strategy: "auto" (measured per-platform
+    # default — parallel.data_parallel.resolve_sparse_grad), "scatter"
+    # (XLA scatter-add), "csc" or "csc_pallas" (scatter-free column-sorted
+    # — types.CSCTranspose)
+    sparse_grad: str = "auto"
     # fixed-effect larger-than-HBM mode: features stay in host RAM, every
     # optimizer pass streams fixed-shape chunks through the device
     # (parallel/streaming.py); sparse_grad is ignored (per-chunk autodiff)
@@ -340,9 +342,12 @@ class _FixedState:
         if cfg.intercept_index >= 0:
             l1_mask = jnp.ones((d,), dtype).at[cfg.intercept_index].set(0.0)
 
-        use_csc = cfg.sparse_grad in ("csc", "csc_pallas")
+        from photon_ml_tpu.parallel.data_parallel import resolve_sparse_grad
+
+        sparse_grad = resolve_sparse_grad(cfg.sparse_grad, feats)
+        use_csc = sparse_grad in ("csc", "csc_pallas")
         if use_csc and not isinstance(feats, SparseFeatures):
-            raise ValueError(f"sparse_grad='{cfg.sparse_grad}' needs sparse "
+            raise ValueError(f"sparse_grad='{sparse_grad}' needs sparse "
                              "features")
         if use_mesh or use_csc:
             work_mesh = mesh if use_mesh else make_mesh({"data": 1})
@@ -359,7 +364,7 @@ class _FixedState:
 
                 build, fg_csc, hvp_csc = make_csc_path(
                     self.obj, work_mesh,
-                    use_pallas=(cfg.sparse_grad == "csc_pallas"),
+                    use_pallas=(sparse_grad == "csc_pallas"),
                 )
                 # sorted once here; offsets change per CD iteration, the
                 # sparsity pattern never does

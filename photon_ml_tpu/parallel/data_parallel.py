@@ -324,6 +324,40 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     return build, fg, hvp
 
 
+# Measured per-platform sparse-gradient defaults for "auto" (both
+# platforms calibrated — docs/PERF.md): the v5e r05 calibration at the
+# bench shape ran {scatter 17.9s, csc 12.6s, csc_segment 27.2s,
+# csc_pallas 12.5s}/20 iters — the fused Mosaic kernel wins on TPU,
+# while on CPU the XLA scatter-add is ~10x faster than the csc paths.
+_SPARSE_GRAD_DEFAULT = {"cpu": "scatter", "tpu": "csc_pallas"}
+_SPARSE_GRAD_MEASURED = {"cpu", "tpu"}
+_sparse_grad_warned: set = set()
+
+
+def resolve_sparse_grad(sparse_grad: str, features=None) -> str:
+    """Resolve ``"auto"`` to the measured per-platform default. Dense
+    features always resolve to "scatter" (the csc paths are sparse-only;
+    dense X^T d is a plain MXU matmul). Unmeasured platforms fall back
+    to "scatter" with a one-line log, mirroring
+    ``game.random_effect.resolve_re_optimizer`` — no silent
+    cross-platform fallback."""
+    if sparse_grad != "auto":
+        return sparse_grad
+    if features is not None and not isinstance(features, SparseFeatures):
+        return "scatter"
+    platform = jax.devices()[0].platform
+    choice = _SPARSE_GRAD_DEFAULT.get(platform, "scatter")
+    if platform not in _SPARSE_GRAD_MEASURED and platform not in _sparse_grad_warned:
+        _sparse_grad_warned.add(platform)
+        import logging
+
+        logging.getLogger("photon_ml_tpu").info(
+            "sparse_grad='auto' on platform %r -> %r (unmeasured default; "
+            "run python bench.py on this platform to calibrate)",
+            platform, choice)
+    return choice
+
+
 def build_csc(objective: GLMObjective, batch: LabeledBatch, mesh: Mesh,
               axis: str = "data", with_cols: bool = True):
     """Precompute the column-sorted (CSC) view of a sharded batch ONCE for
@@ -536,14 +570,15 @@ def fit_distributed(
     optimizer: str = "lbfgs",
     config: OptimizerConfig = OptimizerConfig(),
     axis: str = "data",
-    sparse_grad: str = "scatter",
+    sparse_grad: str = "auto",
     line_search: str = "margin",
     precomputed_csc=None,
 ) -> OptimizationResult:
     """Shard the batch over the mesh and run a full jitted fit — the
     ``DistributedOptimizationProblem.run`` equivalent (SURVEY.md §3.2).
 
-    ``sparse_grad``: "scatter" (XLA scatter-add via autodiff transpose),
+    ``sparse_grad``: "auto" (default: the measured per-platform choice —
+    ``resolve_sparse_grad``), "scatter" (XLA scatter-add via autodiff transpose),
     "csc" (scatter-free column-sorted gradients — see ``make_csc_path``;
     sorts once per fit on device, best for many-iteration sparse fits on
     TPU), "csc_pallas" (fused Pallas kernel), "csc_precise" (CSC with
@@ -560,6 +595,7 @@ def fit_distributed(
     ``precomputed_csc``: reuse a ``build_csc(batch, mesh)`` result across
     fits on the same dataset (regularization grids, calibration) so the
     per-dataset column sort is paid once, not per fit."""
+    sparse_grad = resolve_sparse_grad(sparse_grad, batch.features)
     if optimizer == "lbfgs" and line_search == "margin":
         return _fit_distributed_margin(
             objective, batch, mesh, w0, l2, config, axis,

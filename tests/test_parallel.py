@@ -284,3 +284,17 @@ def test_fit_runner_compilation_reused(rng, mesh):
     fit_distributed(obj, batch_s, mesh, jnp.zeros(d), l2=1.0, config=cfg,
                     sparse_grad="csc")
     assert len(entries[0][1]) == 2
+
+
+def test_resolve_sparse_grad_auto():
+    """'auto' resolves per measured platform table (scatter on CPU),
+    explicit names pass through, dense features force scatter."""
+    from photon_ml_tpu.parallel.data_parallel import resolve_sparse_grad
+    from photon_ml_tpu.types import SparseFeatures
+    import jax.numpy as jnp
+
+    sp = SparseFeatures(jnp.zeros((4, 2), jnp.int32), None, dim=8)
+    assert resolve_sparse_grad("auto", sp) == "scatter"  # tests run on CPU
+    assert resolve_sparse_grad("auto", jnp.zeros((4, 8))) == "scatter"
+    assert resolve_sparse_grad("csc_pallas", sp) == "csc_pallas"
+    assert resolve_sparse_grad("auto") == "scatter"
