@@ -26,6 +26,7 @@ import argparse
 import itertools
 import json
 import os
+import sys
 from typing import Dict, List, Sequence
 
 import jax
@@ -83,6 +84,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="coordinates kept fixed (partial retrain)")
     p.add_argument("--checkpoint", action="store_true",
                    help="save the model after each outer CD iteration")
+    p.add_argument("--auto-resume", action="store_true",
+                   help="with --checkpoint: adopt the latest checkpoint as "
+                        "the warm start when a prior run died on device "
+                        "loss (see the RESUME marker / exit code 75)")
     p.add_argument("--save-all-models", action="store_true")
     p.add_argument("--summarize-features", action="store_true",
                    help="write FeatureSummarizationResultAvro output")
@@ -307,8 +312,6 @@ def main(argv: Sequence[str] | None = None) -> int:
         if ooc_shards:
             from photon_ml_tpu.io.stream_source import AvroChunkSource
 
-            import jax
-
             n_local = max(len(jax.local_devices()), 1)
 
             def _cr(shard):
@@ -386,6 +389,23 @@ def main(argv: Sequence[str] | None = None) -> int:
             ]
 
     warm = load_game_model(args.warm_start_model) if args.warm_start_model else None
+    resume_marker = os.path.join(args.output_dir, "RESUME.json")
+    if args.auto_resume and os.path.exists(resume_marker):
+        # marker-gated ONLY: without it --auto-resume is a no-op, so a
+        # supervisor can pass the flag unconditionally without a cleanly
+        # finished run's leftover checkpoints hijacking later reruns
+        with open(resume_marker) as f:
+            resume_from = json.load(f).get("checkpoint")
+        if resume_from:
+            warm = load_game_model(resume_from)
+            logger.log("auto_resume", checkpoint=resume_from)
+        if is_lead:
+            # consumed only AFTER the checkpoint loaded; lead-only (all
+            # processes share output_dir) with suppress for FS races
+            import contextlib
+
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(resume_marker)
 
     evaluators = args.evaluators
     if evaluators is None:
@@ -409,12 +429,36 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     from photon_ml_tpu.utils import profile_trace
 
-    with Timed(logger, "training"), profile_trace(args.profile_dir):
-        results = estimator.fit(
-            train, validation, config_grid=grid, warm_start=warm,
-            locked=args.locked_coordinates, checkpoint_callback=ckpt,
-            fit_callback=log_fit,
-        )
+    # Device-loss recovery (SURVEY §5.3): a TPU worker crash surfaces as
+    # JaxRuntimeError("UNAVAILABLE ...") and the dead backend cannot be
+    # reinitialized IN-PROCESS (measured: the r05 axon worker crash—
+    # docs/tpu_r05_logs/bench_game.log—required a fresh process even
+    # though the worker itself recovered in ~90 s). So recovery is a
+    # process boundary: persist a RESUME marker pointing at the newest
+    # checkpoint and exit 75 (EX_TEMPFAIL); a supervisor reruns the same
+    # command with --auto-resume, which adopts that checkpoint as the
+    # warm start. --auto-resume consumed the marker above.
+    try:
+        with Timed(logger, "training"), profile_trace(args.profile_dir):
+            results = estimator.fit(
+                train, validation, config_grid=grid, warm_start=warm,
+                locked=args.locked_coordinates, checkpoint_callback=ckpt,
+                fit_callback=log_fit,
+            )
+    except jax.errors.JaxRuntimeError as e:
+        if "UNAVAILABLE" not in str(e) or not args.checkpoint:
+            raise
+        latest = _latest_checkpoint(args.output_dir)
+        if is_lead:
+            with open(resume_marker, "w") as f:
+                json.dump({"error": str(e).split("\n")[0],
+                           "checkpoint": latest}, f)
+        logger.log("device_lost", error=str(e).split("\n")[0],
+                   resume_checkpoint=latest)
+        logger.close()
+        print(f"device lost; resume marker written to {resume_marker} "
+              "(rerun with --auto-resume)", file=sys.stderr)
+        return 75
 
     if args.tuning_mode != "none":
         from photon_ml_tpu.tuning import tune_game
@@ -454,6 +498,29 @@ def main(argv: Sequence[str] | None = None) -> int:
                best_metrics=None if best.evaluation is None else best.evaluation.metrics)
     logger.close()
     return 0
+
+
+def _latest_checkpoint(output_dir: str):
+    """Newest checkpoint dir, or None. mtime first; ties (coarse-mtime
+    filesystems) break on the PARSED config/iteration numbers — a
+    lexicographic tiebreak would order iter-9 above iter-10."""
+    import re
+
+    root = os.path.join(output_dir, "checkpoints")
+    if not os.path.isdir(root):
+        return None
+
+    def nums(name):
+        return tuple(int(x) for x in re.findall(r"\d+", name)) or (-1,)
+
+    paths = [os.path.join(root, d) for d in os.listdir(root)
+             if os.path.isdir(os.path.join(root, d))
+             and not d.endswith(".old") and ".tmp-" not in d
+             and ".old-" not in d]
+    if not paths:
+        return None
+    return max(paths,
+               key=lambda p: (os.path.getmtime(p), nums(os.path.basename(p))))
 
 
 def _to_sparse_features(sp):

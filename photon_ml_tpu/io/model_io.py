@@ -67,6 +67,32 @@ def save_game_model(
     directory: str,
     index_maps: IndexMap | Dict[str, IndexMap],
 ) -> None:
+    """Atomic: the tree is written into a sibling tmp dir and renamed
+    into place, so a crash mid-save (device loss during the d2h reads,
+    SIGKILL) can never leave a half-written model where resume/scoring
+    would find it."""
+    tmp = f"{directory}.tmp-{os.getpid()}"
+    if os.path.isdir(tmp):
+        import shutil
+
+        shutil.rmtree(tmp)
+    _save_game_model_tree(model, tmp, index_maps)
+    if os.path.isdir(directory):  # overwrite: swap out the old tree
+        import shutil
+
+        old = f"{directory}.old-{os.getpid()}"
+        os.rename(directory, old)
+        os.rename(tmp, directory)
+        shutil.rmtree(old)
+    else:
+        os.rename(tmp, directory)
+
+
+def _save_game_model_tree(
+    model: GameModel,
+    directory: str,
+    index_maps: IndexMap | Dict[str, IndexMap],
+) -> None:
     if not isinstance(index_maps, dict):  # any IndexMap-like backend
         index_maps = {"global": index_maps}
     os.makedirs(directory, exist_ok=True)

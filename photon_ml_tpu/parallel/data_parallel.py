@@ -330,7 +330,6 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
 # csc_pallas 12.5s}/20 iters — the fused Mosaic kernel wins on TPU,
 # while on CPU the XLA scatter-add is ~10x faster than the csc paths.
 _SPARSE_GRAD_DEFAULT = {"cpu": "scatter", "tpu": "csc_pallas"}
-_SPARSE_GRAD_MEASURED = {"cpu", "tpu"}
 _sparse_grad_warned: set = set()
 
 
@@ -347,7 +346,7 @@ def resolve_sparse_grad(sparse_grad: str, features=None) -> str:
         return "scatter"
     platform = jax.devices()[0].platform
     choice = _SPARSE_GRAD_DEFAULT.get(platform, "scatter")
-    if platform not in _SPARSE_GRAD_MEASURED and platform not in _sparse_grad_warned:
+    if platform not in _SPARSE_GRAD_DEFAULT and platform not in _sparse_grad_warned:
         _sparse_grad_warned.add(platform)
         import logging
 

@@ -421,3 +421,53 @@ def test_chunked_reader_matches_bulk(game_fixture, rng):
                       hs.values.reshape(-1))
             at += m
         np.testing.assert_allclose(dense_parts, dense_bulk, rtol=1e-12)
+
+
+def test_device_loss_resume_marker_and_auto_resume(game_fixture, monkeypatch):
+    """Device loss mid-fit (TPU worker crash) exits 75 with a RESUME
+    marker pointing at the newest checkpoint; the rerun with
+    --auto-resume consumes the marker, warm-starts from that checkpoint,
+    and finishes (SURVEY §5.3 failure recovery; in-process backend
+    reinit is impossible, so recovery is a process boundary)."""
+    import jax
+    from photon_ml_tpu.estimators import GameEstimator
+
+    out = game_fixture / "out_resume"
+    argv = [
+        "--train-data", str(game_fixture / "train.avro"),
+        "--output-dir", str(out),
+        "--task", "logistic_regression",
+        "--coordinates", str(game_fixture / "coords.json"),
+        "--feature-shards", str(game_fixture / "shards.json"),
+        "--n-iterations", "2", "--checkpoint", "--dtype", "float64",
+    ]
+
+    real_fit = GameEstimator.fit
+    calls = {"n": 0}
+
+    def crashing_fit(self, *a, **kw):
+        calls["n"] += 1
+        ckpt = kw.get("checkpoint_callback")
+        res = real_fit(self, *a, **kw)
+        if calls["n"] == 1:
+            # simulate the worker dying AFTER checkpoints were written
+            raise jax.errors.JaxRuntimeError(
+                "UNAVAILABLE: TPU worker process crashed or restarted.")
+        return res
+
+    monkeypatch.setattr(GameEstimator, "fit", crashing_fit)
+    rc = train_main(argv)
+    assert rc == 75
+    marker = out / "RESUME.json"
+    assert marker.exists()
+    assert json.loads(marker.read_text())["checkpoint"]
+    assert not (out / "best" / "metadata.json").exists()
+
+    rc = train_main(argv + ["--auto-resume"])
+    assert rc == 0
+    assert not marker.exists()  # consumed
+    assert (out / "best" / "metadata.json").exists()
+    log = [json.loads(l)
+           for l in (out / "photon.log.jsonl").read_text().splitlines()]
+    events = [r["event"] for r in log]
+    assert "device_lost" in events and "auto_resume" in events
