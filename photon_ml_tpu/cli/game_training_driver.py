@@ -399,9 +399,16 @@ def main(argv: Sequence[str] | None = None) -> int:
         if resume_from:
             warm = load_game_model(resume_from)
             logger.log("auto_resume", checkpoint=resume_from)
+        if distributed:
+            # every process must have READ the marker before the lead
+            # removes it — without this barrier a slower process misses
+            # the marker, warm-starts differently, and the SPMD states
+            # silently diverge
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("photon_auto_resume_loaded")
         if is_lead:
-            # consumed only AFTER the checkpoint loaded; lead-only (all
-            # processes share output_dir) with suppress for FS races
+            # consumed only AFTER every process loaded the checkpoint
             import contextlib
 
             with contextlib.suppress(FileNotFoundError):
@@ -416,7 +423,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         dtype=dtype,
     )
     ckpt = None
-    if args.checkpoint:
+    if args.checkpoint and is_lead:
+        # lead-only: every process reaches the same model and output_dir
+        # is shared, so concurrent saves to one checkpoint path would
+        # race the atomic rename-into-place
         def ckpt(gi, it, model):
             path = os.path.join(args.output_dir, "checkpoints",
                                 f"config-{gi}-iter-{it}")
@@ -513,14 +523,22 @@ def _latest_checkpoint(output_dir: str):
     def nums(name):
         return tuple(int(x) for x in re.findall(r"\d+", name)) or (-1,)
 
-    paths = [os.path.join(root, d) for d in os.listdir(root)
-             if os.path.isdir(os.path.join(root, d))
-             and not d.endswith(".old") and ".tmp-" not in d
-             and ".old-" not in d]
-    if not paths:
+    entries = [d for d in os.listdir(root)
+               if os.path.isdir(os.path.join(root, d))]
+    live = [d for d in entries if ".tmp-" not in d and ".old-" not in d]
+    # crash-window recovery: save_game_model's overwrite swap can die
+    # between its two renames, leaving only a complete '{name}.old-{pid}'
+    # copy; count it as its base name when the base is missing
+    for d in entries:
+        if ".old-" in d:
+            base = d.split(".old-")[0]
+            if base not in live:
+                live.append(d)
+    if not live:
         return None
-    return max(paths,
-               key=lambda p: (os.path.getmtime(p), nums(os.path.basename(p))))
+    best = max(live, key=lambda d: (os.path.getmtime(os.path.join(root, d)),
+                                    nums(d)))
+    return os.path.join(root, best)
 
 
 def _to_sparse_features(sp):

@@ -358,13 +358,27 @@ def _pad_entities(a: jax.Array, width: int) -> jax.Array:
     return jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
 
 
-def resolve_re_optimizer(optimizer: str) -> str:
+# "auto" only picks the dense-Newton solver up to this per-entity dim:
+# its [block, d, d] Hessians are 16k x d^2 x 4 B per block (1 GB at
+# d=128, 8 GB at the d=351 CD bucket that crashed the Mosaic batched-
+# Cholesky compile on the v5e — docs/tpu_r05_logs/bench_game_auto.log);
+# the vmapped L-BFGS memory is O(d) per entity and handles wide
+# subspaces fine.
+_RE_NEWTON_MAX_DIM = 128
+
+
+def resolve_re_optimizer(optimizer: str, local_dim: int = None) -> str:
     """Resolve ``"auto"`` to the per-platform default solver (measured
-    where a measurement exists; design-predicted and logged otherwise)."""
+    where a measurement exists; design-predicted and logged otherwise).
+    ``local_dim`` (the bucket's per-entity dimension, when known) gates
+    the dense-Newton choice — see ``_RE_NEWTON_MAX_DIM``."""
     if optimizer != "auto":
         return optimizer
     platform = jax.devices()[0].platform
     choice = _RE_SOLVER_DEFAULT.get(platform, "lbfgs")
+    if (choice == "newton" and local_dim is not None
+            and local_dim > _RE_NEWTON_MAX_DIM):
+        choice = "lbfgs"
     if platform not in _RE_SOLVER_MEASURED and platform not in _warned_unmeasured:
         _warned_unmeasured.add(platform)
         import logging
@@ -400,9 +414,10 @@ def train_random_effect(
     per-entity objective via gathered local factor/shift vectors; incoming
     ``w0`` and returned coefficients stay in raw feature space (conversion
     happens here), so scoring/saving/warm-start paths are unchanged."""
-    optimizer = resolve_re_optimizer(optimizer)
     if np.asarray(l1).item() > 0 and optimizer != "owlqn":
         optimizer = "owlqn"
+    # "auto" stays unresolved here: the per-bucket local_dim feeds the
+    # dense-Newton dimension gate inside the loop
     offsets = jnp.asarray(offsets, dtype)
     local_norm = (None if normalization is None
                   else _local_normalization(data.buckets, normalization))
@@ -413,6 +428,7 @@ def train_random_effect(
     conv_sum, iter_sum, total = 0.0, 0.0, 0
     for b, bucket in enumerate(data.buckets):
         E, D = bucket.num_entities, bucket.local_dim
+        opt_b = resolve_re_optimizer(optimizer, D)
         sidx = jnp.asarray(bucket.sample_idx)
         # padding rows (sidx == -1) carry weight 0, offset value irrelevant
         off = jnp.take(offsets, jnp.maximum(sidx, 0), axis=0) * (sidx >= 0)
@@ -444,12 +460,12 @@ def train_random_effect(
         )
         if mesh is not None:
             n_dev = mesh.shape[axis]
-            run = _jitted_sharded_solver(D, task, optimizer, config,
+            run = _jitted_sharded_solver(D, task, opt_b, config,
                                          compute_variance, mesh, axis,
                                          norm_mode)
         else:
             n_dev = 1
-            run = _jitted_solver(D, task, optimizer, config, compute_variance,
+            run = _jitted_solver(D, task, opt_b, config, compute_variance,
                                  norm_mode)
         # Bound the vmapped width: one program over ~100k entities
         # exhausted HBM on the v5e and hard-crashed the TPU worker

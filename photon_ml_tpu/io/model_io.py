@@ -67,10 +67,13 @@ def save_game_model(
     directory: str,
     index_maps: IndexMap | Dict[str, IndexMap],
 ) -> None:
-    """Atomic: the tree is written into a sibling tmp dir and renamed
-    into place, so a crash mid-save (device loss during the d2h reads,
-    SIGKILL) can never leave a half-written model where resume/scoring
-    would find it."""
+    """Atomic for fresh paths: the tree is written into a sibling tmp dir
+    and renamed into place, so a crash mid-save (device loss during the
+    d2h reads, SIGKILL) can never leave a half-written model where
+    resume/scoring would find it. Overwrites swap via two renames; a
+    crash in that window leaves the previous COMPLETE tree at
+    '{path}.old-{pid}', which checkpoint discovery counts as its base
+    name (game_training_driver._latest_checkpoint)."""
     tmp = f"{directory}.tmp-{os.getpid()}"
     if os.path.isdir(tmp):
         import shutil

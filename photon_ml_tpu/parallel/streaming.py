@@ -492,6 +492,10 @@ def fit_streaming(
     positive definite without paying extra full passes for the Wolfe
     curvature condition (a weaker (s,y) filter than the in-memory
     strong-Wolfe optimizer — convergence contract in docs/PERF.md)."""
+    if optimizer == "auto":
+        # measured default: the margin L-BFGS streams 2 sparse passes per
+        # iteration — the fewest of any streamed optimizer
+        optimizer = "lbfgs"
     if np.asarray(l1).item() > 0 and optimizer != "owlqn":
         optimizer = "owlqn"
     if optimizer == "tron":

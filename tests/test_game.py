@@ -589,3 +589,21 @@ def test_train_random_effect_blocked_matches_unblocked(rng, monkeypatch,
         np.testing.assert_allclose(a, b, rtol=1e-12, atol=1e-12)
     assert got.converged_fraction == want.converged_fraction
     assert got.mean_iterations == want.mean_iterations
+
+
+def test_re_auto_solver_dimension_gate(monkeypatch):
+    """'auto' only picks dense-Newton up to _RE_NEWTON_MAX_DIM: its
+    [block, d, d] Hessians exhaust HBM (and crashed the Mosaic batched-
+    Cholesky compile at the d=351 CD bucket on the v5e); wide subspaces
+    route to the O(d)-memory vmapped L-BFGS."""
+    from photon_ml_tpu.game import random_effect as re_mod
+
+    monkeypatch.setattr(re_mod, "_RE_SOLVER_DEFAULT",
+                        {"cpu": "newton", "tpu": "newton"})
+    assert re_mod.resolve_re_optimizer("auto", 32) == "newton"
+    assert re_mod.resolve_re_optimizer("auto",
+                                       re_mod._RE_NEWTON_MAX_DIM) == "newton"
+    assert re_mod.resolve_re_optimizer("auto",
+                                       re_mod._RE_NEWTON_MAX_DIM + 1) == "lbfgs"
+    assert re_mod.resolve_re_optimizer("auto", None) == "newton"
+    assert re_mod.resolve_re_optimizer("newton", 351) == "newton"  # explicit
