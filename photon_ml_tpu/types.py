@@ -68,7 +68,11 @@ def gather_mode() -> str:
 
 
 def _vector_gather_rows(table2d: jax.Array, idx: jax.Array) -> jax.Array:
-    rows = jnp.take(table2d, jnp.right_shift(idx, 7), axis=0)
+    # mode="clip": the default 'fill' pays an out-of-bounds select per
+    # element (~12% of the pass on the v5e); table_gather's indices are
+    # in-bounds by construction (idx < d => idx>>7 < rows), so clamping
+    # is semantically a no-op and results stay bit-identical
+    rows = jnp.take(table2d, jnp.right_shift(idx, 7), axis=0, mode="clip")
     lane = jnp.bitwise_and(idx, 127)
     onehot = lane[:, None] == jnp.arange(_LANES, dtype=idx.dtype)[None, :]
     return jnp.sum(jnp.where(onehot, rows, 0), axis=-1)

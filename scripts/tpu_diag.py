@@ -17,6 +17,7 @@ Shapes shrink on CPU so the script doubles as a smoke test.
 
 from __future__ import annotations
 
+import functools
 import os
 import sys
 import time
@@ -123,8 +124,25 @@ def main():
     csc = build_csc(obj, batch, mesh)
     leaf = jax.tree_util.tree_leaves(csc)[0]
     float(jnp.sum(leaf.reshape(-1)[:1]))  # fetch-sync
-    print(f"csc build (hoisted, once/dataset): "
-          f"{(time.perf_counter()-t0)*1e3:.1f} ms", flush=True)
+    cold = (time.perf_counter() - t0) * 1e3
+    # warm run: the r05 session's 21s "build" was ~19s COMPILE; the
+    # device sort+gathers are ~1.8s at this shape. Warm timing needs ONE
+    # reused jitted callable (build_csc jits a fresh closure per call)
+    # and a salted input (rolled indices: same shape/distribution,
+    # different computation — the axon backend memoizes identical
+    # executions).
+    from photon_ml_tpu.types import build_csc_transpose
+
+    build_one = jax.jit(functools.partial(build_csc_transpose, values=None,
+                                          dim=d))
+    float(jnp.sum(jax.tree_util.tree_leaves(
+        build_one(indices))[0].reshape(-1)[:1]))
+    t0 = time.perf_counter()
+    csc2 = build_one(jnp.roll(indices, 1, axis=0))
+    float(jnp.sum(jax.tree_util.tree_leaves(csc2)[0].reshape(-1)[:1]))
+    print(f"csc build (hoisted, once/dataset): cold {cold:.1f} ms "
+          f"(incl compile), warm {(time.perf_counter()-t0)*1e3:.1f} ms",
+          flush=True)
 
     # scatter vs hoisted-CSC fits: the decisive single-chip comparison.
     # salt w0 per run (the axon backend memoizes identical executions);

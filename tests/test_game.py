@@ -468,6 +468,17 @@ def test_re_optimizer_auto_resolves_per_platform(rng):
                                       f_lb.coefficients[b])
 
 
+def _timed_fill(W, bucket, prev_bucket, prs):
+    import time
+
+    from photon_ml_tpu.game.descent import _warm_fill_bucket
+
+    t0 = time.perf_counter()
+    _warm_fill_bucket(W, bucket, np.arange(bucket.num_entities),
+                      prev_bucket, prs)
+    return time.perf_counter() - t0
+
+
 def test_warm_fill_bucket_vectorized_matches_loop_and_scales(rng):
     """The warm-start slot remap is a numpy composite-key join, not a
     per-entity/per-slot Python loop (VERDICT r4 #7): it must match the
@@ -530,9 +541,11 @@ def test_warm_fill_bucket_vectorized_matches_loop_and_scales(rng):
     bucket.local_maps = [None]  # only [0] is touched, for the sketch check
     bucket.projection = cur_proj
     W = np.zeros((E, Dc))
-    t0 = time.perf_counter()
-    _warm_fill_bucket(W, bucket, np.arange(E), prev_bucket, prs)
-    dt = time.perf_counter() - t0
+    # min-of-3: the bound is about algorithmic complexity, and a single
+    # wall-clock sample on a 1-core box loses to unrelated process
+    # contention (observed flaking in full-suite runs)
+    dt = min(_timed_fill(W, bucket, prev_bucket, prs)
+             for _ in range(3))
     assert dt < 2.0, f"warm-fill at 100k entities took {dt:.2f}s"
     assert np.count_nonzero(W) > 0.99 * E * Dp
 
