@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 from typing import Optional, Sequence
 
 import jax
@@ -94,6 +95,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="run DataValidators-style checks before training")
     p.add_argument("--no-validate-data", dest="validate_data",
                    action="store_false")
+    p.add_argument("--auto-resume", action="store_true",
+                   help="resume a lambda grid that died on device loss "
+                        "(RESUME_GLM.npz marker / exit code 75)")
     p.add_argument("--compute-variances", action="store_true",
                    help="diagonal-inverse-Hessian coefficient variances")
     p.add_argument("--summarize-features", action="store_true")
@@ -359,6 +363,59 @@ def main(argv: Sequence[str] | None = None) -> int:
     w = jnp.zeros((dim,), dtype)
     from photon_ml_tpu.utils import profile_trace
 
+    # Device-loss recovery over the lambda grid (same contract as the
+    # GAME driver's RESUME marker, but lambda-granular: every finished
+    # lambda's host-side result is persisted, so the rerun replays them
+    # and resumes the warm-start chain at the first unfinished lambda).
+    resume_path = os.path.join(args.output_dir, "RESUME_GLM.npz")
+    is_lead = (not distributed) or jax.process_index() == 0
+    if args.auto_resume and os.path.exists(resume_path):
+        from types import SimpleNamespace
+
+        saved = np.load(resume_path, allow_pickle=True)["payload"].item()
+        saved_lams = [e["lam"] for e in saved["entries"]]
+        if saved_lams != list(args.reg_weights[: len(saved_lams)]):
+            raise ValueError(
+                f"RESUME_GLM.npz holds lambdas {saved_lams} which are not a "
+                f"prefix of --reg-weights {list(args.reg_weights)}; refusing "
+                "to mix grids — rerun with the original grid or delete the "
+                "marker")
+        for e in saved["entries"]:
+            res_like = SimpleNamespace(**e["res"])
+            res_like.w = jnp.asarray(res_like.w, dtype)
+            results.append((e["lam"], res_like, e["metrics"], e["variances"]))
+        w = jnp.asarray(saved["last_w"], dtype)
+        # the marker is consumed only after the grid COMPLETES (below): a
+        # second failure of any kind must not discard the progress
+        logger.log("auto_resume", completed_lambdas=len(results))
+
+    def _persist_resume(err):
+        if not is_lead:
+            return
+        entries = [{
+            "lam": lam,
+            "res": {"w": np.asarray(res.w),  # native dtype: a resumed
+                    # f64 run must reproduce the uninterrupted one
+                    "value": float(res.value),
+                    "grad_norm": float(res.grad_norm),
+                    "iterations": int(res.iterations),
+                    "converged": bool(res.converged),
+                    "loss_history": np.asarray(res.loss_history)},
+            "metrics": metrics_,
+            "variances": (None if variances_ is None
+                          else np.asarray(variances_)),
+        } for lam, res, metrics_, variances_ in results]
+        tmp = f"{resume_path}.tmp-{os.getpid()}"
+        np.savez(tmp, payload={
+            "entries": entries,
+            "last_w": (np.asarray(results[-1][1].w)
+                       if results else np.zeros((dim,))),
+            "error": str(err).split("\n")[0],
+        })
+        # np.savez appends .npz to names without it
+        os.replace(tmp if tmp.endswith(".npz") else tmp + ".npz",
+                   resume_path)
+
     # the per-dataset column sort behind the csc gradient paths is paid
     # once for the whole lambda grid, not per fit
     grid_csc = None
@@ -371,64 +428,82 @@ def main(argv: Sequence[str] | None = None) -> int:
                                batch.features).startswith("csc"):
             grid_csc = build_csc(objective, batch, mesh)
 
-    with Timed(logger, "training"), profile_trace(args.profile_dir):
-        for lam in args.reg_weights:
-            if streaming:
-                from photon_ml_tpu.parallel.streaming import fit_streaming
-
-                # distributed: chunks hold this process's span only and the
-                # partials allgather-reduce across processes; chunk sharding
-                # uses the process-LOCAL mesh so per-process partials stay
-                # local sums while all local chips work each pass
-                res = fit_streaming(
-                    objective, chunks, dim, w0=w, l2=reg.l2_weight(lam),
-                    l1=reg.l1_weight(lam), optimizer=optimizer,
-                    config=opt_config, dtype=dtype, mesh=stream_mesh,
-                )
-            else:
-                res = fit_distributed(
-                    objective, batch, mesh, w,
-                    l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
-                    optimizer=optimizer, config=opt_config,
-                    precomputed_csc=grid_csc,
-                )
-            w = res.w  # warm start the next lambda
-            diag = {
-                "reg_weight": lam,
-                "loss": float(res.value),
-                "grad_norm": float(res.grad_norm),
-                "iterations": int(res.iterations),
-                "converged": bool(res.converged),
-                "loss_history": [
-                    float(v) for v in np.asarray(res.loss_history)
-                    if np.isfinite(v)
-                ],
-            }
-            metrics = {}
-            if validation_batch is not None and evaluators:
-                scores = np.asarray(objective.margins(res.w, validation_batch))
-                for name in evaluators:
-                    metrics[name] = get_evaluator(name).evaluate(
-                        scores, vlabels, vweights
-                    )
-                diag["metrics"] = metrics
-            variances = None
-            if args.compute_variances:
+    try:
+        with Timed(logger, "training"), profile_trace(args.profile_dir):
+            for lam in args.reg_weights[len(results):]:
                 if streaming:
-                    from photon_ml_tpu.parallel.streaming import (
-                        streaming_coefficient_variances,
-                    )
+                    from photon_ml_tpu.parallel.streaming import fit_streaming
 
-                    variances = streaming_coefficient_variances(
-                        objective, chunks, dim, res.w,
-                        l2=reg.l2_weight(lam), dtype=dtype, mesh=stream_mesh,
+                    # distributed: chunks hold this process's span only and the
+                    # partials allgather-reduce across processes; chunk sharding
+                    # uses the process-LOCAL mesh so per-process partials stay
+                    # local sums while all local chips work each pass
+                    res = fit_streaming(
+                        objective, chunks, dim, w0=w, l2=reg.l2_weight(lam),
+                        l1=reg.l1_weight(lam), optimizer=optimizer,
+                        config=opt_config, dtype=dtype, mesh=stream_mesh,
                     )
                 else:
-                    variances = objective.coefficient_variances(
-                        res.w, batch, reg.l2_weight(lam)
+                    res = fit_distributed(
+                        objective, batch, mesh, w,
+                        l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
+                        optimizer=optimizer, config=opt_config,
+                        precomputed_csc=grid_csc,
                     )
-            results.append((lam, res, metrics, variances))
-            logger.log("lambda_trained", **diag)
+                w = res.w  # warm start the next lambda
+                diag = {
+                    "reg_weight": lam,
+                    "loss": float(res.value),
+                    "grad_norm": float(res.grad_norm),
+                    "iterations": int(res.iterations),
+                    "converged": bool(res.converged),
+                    "loss_history": [
+                        float(v) for v in np.asarray(res.loss_history)
+                        if np.isfinite(v)
+                    ],
+                }
+                metrics = {}
+                if validation_batch is not None and evaluators:
+                    scores = np.asarray(objective.margins(res.w, validation_batch))
+                    for name in evaluators:
+                        metrics[name] = get_evaluator(name).evaluate(
+                            scores, vlabels, vweights
+                        )
+                    diag["metrics"] = metrics
+                variances = None
+                if args.compute_variances:
+                    if streaming:
+                        from photon_ml_tpu.parallel.streaming import (
+                            streaming_coefficient_variances,
+                        )
+
+                        variances = streaming_coefficient_variances(
+                            objective, chunks, dim, res.w,
+                            l2=reg.l2_weight(lam), dtype=dtype, mesh=stream_mesh,
+                        )
+                    else:
+                        variances = objective.coefficient_variances(
+                            res.w, batch, reg.l2_weight(lam)
+                        )
+                results.append((lam, res, metrics, variances))
+                logger.log("lambda_trained", **diag)
+
+    except jax.errors.JaxRuntimeError as e:
+        if "UNAVAILABLE" not in str(e):
+            raise
+        _persist_resume(e)
+        logger.log("device_lost", error=str(e).split("\n")[0],
+                   completed_lambdas=len(results))
+        logger.close()
+        print(f"device lost; {len(results)} finished lambdas persisted to "
+              f"{resume_path} (rerun with --auto-resume)", file=sys.stderr)
+        return 75
+
+    if args.auto_resume and is_lead:
+        import contextlib
+
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(resume_path)  # grid complete: consume the marker
 
     # -- stage: validate + select best ---------------------------------------
     best_i = 0

@@ -286,3 +286,45 @@ def test_read_training_examples_scalars_only(tmp_path, rng):
     np.testing.assert_allclose(out_py[1], out[1])
     assert list(out_py[4]["u"]) == list(out[4]["u"])
     assert out_py[5] == out[5]
+
+
+def test_save_game_model_overwrite_and_crash_window_recovery(tmp_path):
+    """Atomic model saves: overwriting a checkpoint swaps complete trees,
+    and if the swap dies between its two renames the complete '.old-pid'
+    copy is discovered by _latest_checkpoint and loads."""
+    import os
+    import shutil
+
+    import numpy as np
+
+    from photon_ml_tpu.cli.game_training_driver import _latest_checkpoint
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+    from photon_ml_tpu.models import (Coefficients, FixedEffectModel,
+                                      GameModel, GeneralizedLinearModel)
+
+    imap = IndexMap({f"f{i}": i for i in range(4)}, add_intercept=False)
+
+    def model(scale):
+        lm = GeneralizedLinearModel(Coefficients(np.arange(4.0) * scale))
+        return GameModel({"fixed": FixedEffectModel(lm, "global")},
+                         task="logistic")
+
+    root = tmp_path / "out" / "checkpoints"
+    path = str(root / "config-0-iter-0")
+    save_game_model(model(1.0), path, {"global": imap})
+    save_game_model(model(2.0), path, {"global": imap})  # overwrite swap
+    got = load_game_model(path)
+    np.testing.assert_allclose(
+        np.asarray(got.coordinates["fixed"].model.coefficients.means),
+        np.arange(4.0) * 2.0)
+    assert not [d for d in os.listdir(root) if ".old-" in d or ".tmp-" in d]
+
+    # crash window: base vanished mid-swap, only the .old survives
+    shutil.move(path, path + ".old-12345")
+    found = _latest_checkpoint(str(tmp_path / "out"))
+    assert found is not None and found.endswith(".old-12345")
+    got = load_game_model(found)
+    np.testing.assert_allclose(
+        np.asarray(got.coordinates["fixed"].model.coefficients.means),
+        np.arange(4.0) * 2.0)
