@@ -70,6 +70,26 @@ def _slice_host_sparse(sp, row_slice):
 
 
 def main(argv: Sequence[str] | None = None) -> int:
+    try:
+        return _main(argv)
+    except Exception as e:
+        # scoring is stateless and its output write is atomic, so device
+        # loss needs no marker: exit 75 (EX_TEMPFAIL) and a supervisor
+        # rerun is a clean, idempotent retry (same contract as the
+        # training drivers)
+        from photon_ml_tpu.utils import is_device_loss
+
+        if is_device_loss(e):
+            import sys
+
+            print("device lost; rerun this command (scoring is "
+                  "idempotent, no partial output was published)",
+                  file=sys.stderr)
+            return 75
+        raise
+
+
+def _main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
     dtype = resolve_dtype(args.dtype)
     os.makedirs(args.output_dir, exist_ok=True)
@@ -134,8 +154,7 @@ def main(argv: Sequence[str] | None = None) -> int:
             for i, uid in enumerate(uids):
                 yield _scoring_record(uid, scores[i], labels[i], parts, i)
 
-        write_avro_file(os.path.join(args.output_dir, "scores.avro"),
-                        records(), SCORING_RESULT_SCHEMA)
+        _write_scores_atomic(args.output_dir, records())
 
     labeled = ~np.isnan(labels)
     metrics = {}
@@ -154,6 +173,24 @@ def main(argv: Sequence[str] | None = None) -> int:
     logger.close()
     return 0
 
+
+
+def _write_scores_atomic(output_dir: str, records) -> None:
+    """scores.avro appears only when COMPLETE: the writer streams into a
+    sibling tmp file that is renamed into place at the end, so a crash
+    mid-scoring (device loss) can never leave a partial output a consumer
+    would mistake for the full scoring set."""
+    final = os.path.join(output_dir, "scores.avro")
+    tmp = f"{final}.tmp-{os.getpid()}"
+    try:
+        write_avro_file(tmp, records, SCORING_RESULT_SCHEMA)
+    except BaseException:
+        import contextlib
+
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
+    os.replace(tmp, final)
 
 def _score_out_of_core(args, model, index_maps, entity_columns, logger,
                        dtype) -> int:
@@ -198,8 +235,7 @@ def _score_out_of_core(args, model, index_maps, entity_columns, logger,
                 yield _scoring_record(uid, scores[i], labels[i], parts, i)
 
     with Timed(logger, "score_and_write"):
-        write_avro_file(os.path.join(args.output_dir, "scores.avro"),
-                        scored_records(), SCORING_RESULT_SCHEMA)
+        _write_scores_atomic(args.output_dir, scored_records())
 
     metrics = {}
     if args.evaluators:

@@ -455,8 +455,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                 locked=args.locked_coordinates, checkpoint_callback=ckpt,
                 fit_callback=log_fit,
             )
-    except jax.errors.JaxRuntimeError as e:
-        if "UNAVAILABLE" not in str(e) or not args.checkpoint:
+    except Exception as e:
+        from photon_ml_tpu.utils import is_device_loss
+
+        if not is_device_loss(e) or not args.checkpoint:
             raise
         latest = _latest_checkpoint(args.output_dir)
         if is_lead:

@@ -59,7 +59,8 @@ from photon_ml_tpu.optimize import OptimizerConfig
 from photon_ml_tpu.parallel.data_parallel import fit_distributed
 from photon_ml_tpu.parallel.mesh import make_mesh
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures, make_batch
-from photon_ml_tpu.utils import PhotonLogger, Timed, resolve_dtype
+from photon_ml_tpu.utils import (PhotonLogger, Timed, is_device_loss,
+                                 resolve_dtype)
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -380,6 +381,14 @@ def main(argv: Sequence[str] | None = None) -> int:
                 f"prefix of --reg-weights {list(args.reg_weights)}; refusing "
                 "to mix grids — rerun with the original grid or delete the "
                 "marker")
+        if validation is not None and evaluators and any(
+                evaluators[0] not in (e["metrics"] or {})
+                for e in saved["entries"]):
+            raise ValueError(
+                "RESUME_GLM.npz entries lack the current evaluator "
+                f"{evaluators[0]!r} (the crashed run had different "
+                "validation settings); rerun with the original settings or "
+                "delete the marker")
         for e in saved["entries"]:
             res_like = SimpleNamespace(**e["res"])
             res_like.w = jnp.asarray(res_like.w, dtype)
@@ -488,8 +497,8 @@ def main(argv: Sequence[str] | None = None) -> int:
                 results.append((lam, res, metrics, variances))
                 logger.log("lambda_trained", **diag)
 
-    except jax.errors.JaxRuntimeError as e:
-        if "UNAVAILABLE" not in str(e):
+    except Exception as e:
+        if not is_device_loss(e):
             raise
         _persist_resume(e)
         logger.log("device_lost", error=str(e).split("\n")[0],
@@ -499,85 +508,98 @@ def main(argv: Sequence[str] | None = None) -> int:
               f"{resume_path} (rerun with --auto-resume)", file=sys.stderr)
         return 75
 
-    if args.auto_resume and is_lead:
+    try:
+        # -- stage: validate + select best ---------------------------------------
+        best_i = 0
+        if validation is not None and evaluators:
+            ev = get_evaluator(evaluators[0])
+            for i in range(1, len(results)):
+                if ev.better(results[i][2][evaluators[0]],
+                             results[best_i][2][evaluators[0]]):
+                    best_i = i
+
+        if args.diagnostics:
+            from photon_ml_tpu import diagnostics as diag
+
+            lam_best, res_best, _, _ = results[best_i]
+            report = {"reg_weight": lam_best}
+            inverse = index_map.inverse()
+            summary_std = None
+            if norm_type != NormalizationType.NONE or args.summarize_features:
+                summary_std = np.zeros(dim)
+                summary_std[:summary.dim] = summary.std
+            imp = diag.feature_importance(np.asarray(res_best.w), summary_std,
+                                          top_k=50)
+            report["feature_importance"] = [
+                {"feature": inverse.get(int(i), str(int(i))),
+                 "score": float(s)}
+                for i, s in zip(imp["index"], imp["score"])
+            ]
+            if validation_batch is not None and task in ("logistic",
+                                                         "smoothed_hinge"):
+                probs = np.asarray(
+                    objective.loss.mean(
+                        objective.margins(res_best.w, validation_batch)
+                    )
+                )
+                report["hosmer_lemeshow"] = diag.hosmer_lemeshow(probs, vlabels)
+            if args.bootstrap_replicates > 0 and not streaming:
+                with Timed(logger, "bootstrap"):
+                    boot = diag.bootstrap_coefficients(
+                        objective, batch, res_best.w,
+                        l2=reg.l2_weight(lam_best),
+                        n_replicates=args.bootstrap_replicates,
+                    )
+                report["bootstrap"] = {
+                    "replicates": args.bootstrap_replicates,
+                    "std": boot["std"].tolist(),
+                    "lower": boot["lower"].tolist(),
+                    "upper": boot["upper"].tolist(),
+                }
+            with open(os.path.join(args.output_dir, "diagnostics.json"), "w") as f:
+                json.dump(report, f, indent=2)
+            logger.log("diagnostics_written",
+                       hosmer_lemeshow=report.get("hosmer_lemeshow"))
+
+        # -- stage: diagnostics + model output ------------------------------------
+        with Timed(logger, "save_models"):
+            for i, (lam, res, metrics, variances) in enumerate(results):
+                model = GameModel(
+                    {"global": FixedEffectModel(
+                        GeneralizedLinearModel(
+                            Coefficients(res.w, variances), task=task
+                        )
+                    )},
+                    task=task,
+                )
+                out = os.path.join(
+                    args.output_dir,
+                    "best" if i == best_i else os.path.join("all", f"lambda-{lam:g}"),
+                )
+                save_game_model(model, out, index_map)
+                if i == best_i and len(results) > 1:
+                    save_game_model(
+                        model, os.path.join(args.output_dir, "all", f"lambda-{lam:g}"),
+                        index_map,
+                    )
+    except Exception as e:
+        if not is_device_loss(e):
+            raise
+        _persist_resume(e)
+        logger.log("device_lost", error=str(e).split("\n")[0],
+                   completed_lambdas=len(results), stage="post_grid")
+        logger.close()
+        print(f"device lost after the grid; progress persisted to "
+              f"{resume_path} (rerun with --auto-resume)", file=sys.stderr)
+        return 75
+
+    # outputs are published: ANY completed grid consumes a marker so a
+    # later --auto-resume cannot replay stale results
+    if is_lead:
         import contextlib
 
         with contextlib.suppress(FileNotFoundError):
-            os.remove(resume_path)  # grid complete: consume the marker
-
-    # -- stage: validate + select best ---------------------------------------
-    best_i = 0
-    if validation is not None and evaluators:
-        ev = get_evaluator(evaluators[0])
-        for i in range(1, len(results)):
-            if ev.better(results[i][2][evaluators[0]],
-                         results[best_i][2][evaluators[0]]):
-                best_i = i
-
-    if args.diagnostics:
-        from photon_ml_tpu import diagnostics as diag
-
-        lam_best, res_best, _, _ = results[best_i]
-        report = {"reg_weight": lam_best}
-        inverse = index_map.inverse()
-        summary_std = None
-        if norm_type != NormalizationType.NONE or args.summarize_features:
-            summary_std = np.zeros(dim)
-            summary_std[:summary.dim] = summary.std
-        imp = diag.feature_importance(np.asarray(res_best.w), summary_std,
-                                      top_k=50)
-        report["feature_importance"] = [
-            {"feature": inverse.get(int(i), str(int(i))),
-             "score": float(s)}
-            for i, s in zip(imp["index"], imp["score"])
-        ]
-        if validation_batch is not None and task in ("logistic",
-                                                     "smoothed_hinge"):
-            probs = np.asarray(
-                objective.loss.mean(
-                    objective.margins(res_best.w, validation_batch)
-                )
-            )
-            report["hosmer_lemeshow"] = diag.hosmer_lemeshow(probs, vlabels)
-        if args.bootstrap_replicates > 0 and not streaming:
-            with Timed(logger, "bootstrap"):
-                boot = diag.bootstrap_coefficients(
-                    objective, batch, res_best.w,
-                    l2=reg.l2_weight(lam_best),
-                    n_replicates=args.bootstrap_replicates,
-                )
-            report["bootstrap"] = {
-                "replicates": args.bootstrap_replicates,
-                "std": boot["std"].tolist(),
-                "lower": boot["lower"].tolist(),
-                "upper": boot["upper"].tolist(),
-            }
-        with open(os.path.join(args.output_dir, "diagnostics.json"), "w") as f:
-            json.dump(report, f, indent=2)
-        logger.log("diagnostics_written",
-                   hosmer_lemeshow=report.get("hosmer_lemeshow"))
-
-    # -- stage: diagnostics + model output ------------------------------------
-    with Timed(logger, "save_models"):
-        for i, (lam, res, metrics, variances) in enumerate(results):
-            model = GameModel(
-                {"global": FixedEffectModel(
-                    GeneralizedLinearModel(
-                        Coefficients(res.w, variances), task=task
-                    )
-                )},
-                task=task,
-            )
-            out = os.path.join(
-                args.output_dir,
-                "best" if i == best_i else os.path.join("all", f"lambda-{lam:g}"),
-            )
-            save_game_model(model, out, index_map)
-            if i == best_i and len(results) > 1:
-                save_game_model(
-                    model, os.path.join(args.output_dir, "all", f"lambda-{lam:g}"),
-                    index_map,
-                )
+            os.remove(resume_path)
     logger.log("driver_done", best_reg_weight=results[best_i][0],
                best_metrics=results[best_i][2] or None)
     logger.close()

@@ -1,3 +1,4 @@
-from photon_ml_tpu.utils.config import apply_env_platforms, resolve_dtype
+from photon_ml_tpu.utils.config import (apply_env_platforms, is_device_loss,
+                                         resolve_dtype)
 from photon_ml_tpu.utils.logging import PhotonLogger, Timed
 from photon_ml_tpu.utils.tracing import annotate, profile_trace

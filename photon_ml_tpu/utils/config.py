@@ -35,3 +35,15 @@ def resolve_dtype(name: str):
         jax.config.update("jax_enable_x64", True)
         return jnp.float64
     return jnp.float32
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """True when an exception means the accelerator backend died under us
+    (TPU worker crash / tunnel loss). The dead backend cannot be
+    reinitialized in-process (measured, docs/RUNBOOK.md §5), so every
+    driver converts this into an exit-75 process-boundary retry. One
+    predicate, shared by all drivers — refine detection here only."""
+    import jax
+
+    return (isinstance(exc, jax.errors.JaxRuntimeError)
+            and "UNAVAILABLE" in str(exc))

@@ -471,3 +471,38 @@ def test_device_loss_resume_marker_and_auto_resume(game_fixture, monkeypatch):
            for l in (out / "photon.log.jsonl").read_text().splitlines()]
     events = [r["event"] for r in log]
     assert "device_lost" in events and "auto_resume" in events
+
+
+def test_scoring_device_loss_exits_75_no_partial_output(game_fixture,
+                                                        monkeypatch):
+    """Device loss mid-scoring: exit 75 and NO scores.avro appears (the
+    atomic write publishes only complete outputs; rerun is idempotent)."""
+    import jax
+
+    out = game_fixture / "out_score_resume"
+    rc = train_main([
+        "--train-data", str(game_fixture / "train.avro"),
+        "--output-dir", str(out),
+        "--task", "logistic_regression",
+        "--coordinates", str(game_fixture / "coords.json"),
+        "--feature-shards", str(game_fixture / "shards.json"),
+        "--n-iterations", "1", "--dtype", "float64",
+    ])
+    assert rc == 0
+
+    from photon_ml_tpu.cli import game_scoring_driver as sdrv
+
+    def crash(*a, **kw):
+        raise jax.errors.JaxRuntimeError(
+            "UNAVAILABLE: TPU worker process crashed or restarted.")
+
+    monkeypatch.setattr(sdrv, "score_game_model", crash)
+    sout = game_fixture / "scores_crash"
+    rc = score_main([
+        "--data", str(game_fixture / "val.avro"),
+        "--model-dir", str(out / "best"),
+        "--output-dir", str(sout),
+    ])
+    assert rc == 75
+    assert not (sout / "scores.avro").exists()
+    assert not [f for f in os.listdir(sout) if ".tmp-" in f]

@@ -302,3 +302,45 @@ def _best_means(out):
 
     m = load_game_model(str(out / "best"))
     return np.asarray(m.coordinates["global"].model.coefficients.means)
+
+
+def test_glm_resume_refuses_changed_grid_or_evaluators(tmp_path,
+                                                       logistic_data,
+                                                       monkeypatch):
+    """The resume marker must be a prefix of the SAME grid and cover the
+    current evaluator — mixed settings are refused loudly, not merged."""
+    import jax
+    import pytest
+
+    from photon_ml_tpu.cli import glm_driver as drv
+
+    X, y = logistic_data
+    _write_libsvm(tmp_path / "train.svm", X[:300], y[:300])
+    _write_libsvm(tmp_path / "val.svm", X[300:], y[300:])
+    out = tmp_path / "out"
+    base = ["--train-data", str(tmp_path / "train.svm"),
+            "--input-format", "libsvm", "--output-dir", str(out),
+            "--dtype", "float64"]
+
+    real_fit = drv.fit_distributed
+    calls = {"n": 0}
+
+    def crashing_fit(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise jax.errors.JaxRuntimeError("UNAVAILABLE: worker crashed")
+        return real_fit(*a, **kw)
+
+    monkeypatch.setattr(drv, "fit_distributed", crashing_fit)
+    assert glm_main(base + ["--reg-weights", "10.0", "1.0"]) == 75
+    monkeypatch.setattr(drv, "fit_distributed", real_fit)
+
+    with pytest.raises(ValueError, match="not a\n?.*prefix|prefix"):
+        glm_main(base + ["--reg-weights", "5.0", "1.0", "--auto-resume"])
+    with pytest.raises(ValueError, match="evaluator"):
+        glm_main(base + ["--reg-weights", "10.0", "1.0", "--auto-resume",
+                         "--validation-data", str(tmp_path / "val.svm")])
+    # unchanged settings resume fine, and the marker is consumed
+    assert glm_main(base + ["--reg-weights", "10.0", "1.0",
+                            "--auto-resume"]) == 0
+    assert not (out / "RESUME_GLM.npz").exists()
