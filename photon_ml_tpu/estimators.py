@@ -12,7 +12,7 @@ evaluator. ``GameTransformer.transform`` scores a dataset with a model.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np

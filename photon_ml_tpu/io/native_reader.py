@@ -31,7 +31,6 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu.io.avro import _read_header, _read_long_or_eof, _expand
-from photon_ml_tpu.io.schemas import NAME_TERM_SEPARATOR
 
 # capture opcodes (must match avro_decoder.cpp)
 _CAP_LABEL_D, _CAP_LABEL_ND = 0x01, 0x02

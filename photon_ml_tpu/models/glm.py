@@ -20,12 +20,11 @@ import dataclasses
 from typing import Dict, Mapping, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
 from photon_ml_tpu.ops.losses import get_loss
-from photon_ml_tpu.types import Features, LabeledBatch, margins as _margins
+from photon_ml_tpu.types import Features, margins as _margins
 
 
 @struct.dataclass
