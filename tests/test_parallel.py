@@ -298,3 +298,39 @@ def test_resolve_sparse_grad_auto():
     assert resolve_sparse_grad("auto", jnp.zeros((4, 8))) == "scatter"
     assert resolve_sparse_grad("csc_pallas", sp) == "csc_pallas"
     assert resolve_sparse_grad("auto") == "scatter"
+
+
+@pytest.mark.parametrize("mode", ["scatter", "csc", "csc_pallas"])
+def test_vector_gather_single_vs_eight_device_equivalence(rng, mesh, mode):
+    """The TPU vector-gather path under shard_map: an 8-device mesh fit
+    with gather_mode='vector' must reproduce the 1-device scalar-mode
+    fit (bit-identical gather arithmetic composed with per-shard psum) —
+    the multichip x vector-gather seam the dryrun exercises on hardware."""
+    from photon_ml_tpu import types as T
+    from photon_ml_tpu.parallel.data_parallel import build_csc
+
+    batch, X, y = _problem(rng, sparse=True)
+    d = X.shape[1]
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-10)
+    # force the vector path despite CPU (and below-threshold sizes)
+    monkey_min = T._GATHER_MIN_SIZE
+    T._GATHER_MIN_SIZE = 0
+    T.set_gather_mode("scalar")
+    try:
+        csc = build_csc(obj, batch, make_mesh({"data": 1}))
+        ref = fit_distributed(obj, batch, make_mesh({"data": 1}),
+                              jnp.zeros(d), l2=0.5, config=cfg,
+                              sparse_grad=mode,
+                              precomputed_csc=csc if mode != "scatter" else None)
+        T.set_gather_mode("vector")
+        csc8 = build_csc(obj, batch, mesh)
+        got = fit_distributed(obj, batch, mesh, jnp.zeros(d), l2=0.5,
+                              config=cfg, sparse_grad=mode,
+                              precomputed_csc=csc8 if mode != "scatter" else None)
+    finally:
+        T._GATHER_MIN_SIZE = monkey_min
+        T.set_gather_mode("auto")
+    np.testing.assert_allclose(got.w, ref.w, rtol=1e-6, atol=1e-9,
+                               err_msg=mode)
+    np.testing.assert_allclose(got.value, ref.value, rtol=1e-9, err_msg=mode)
