@@ -81,8 +81,13 @@ def _vector_gather_rows(table2d: jax.Array, idx: jax.Array) -> jax.Array:
 def table_gather(table: jax.Array, idx: jax.Array) -> jax.Array:
     """``table[idx]`` for a 1-D table, vectorized for TPU when profitable.
 
-    Bit-identical to the serial gather on every path (the lane select adds
-    one real value and 127 zeros). 'auto' resolves per trace-time backend:
+    Bit-identical to the serial gather on every path for normal floats
+    (the lane select adds one real value and 127 zeros). The one
+    exception, found by the property fuzz: SUBNORMAL table values
+    (|x| < 1.2e-38 f32) flush to zero through the select-sum on
+    flush-to-zero backends — the same flush every arithmetic op on TPU
+    applies to them anyway, whereas the serial gather is a pure memory
+    move and preserves the bits. 'auto' resolves per trace-time backend:
     the vector form pays an extra [m, 128] stream, which wins ~15x on TPU
     where the serial gather is the bottleneck but loses on CPU where the
     serial gather is already fast.

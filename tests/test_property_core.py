@@ -1,0 +1,115 @@
+"""Property tests for the sparse numerics core.
+
+Deterministic tests pin known cases; these sweep randomized shapes,
+index distributions (duplicates, empty columns, single-column pileups),
+and value signs for the three load-bearing identities:
+
+  1. ``table_gather`` (vector form, incl. chunking) == plain indexing,
+     bitwise;
+  2. CSC build + blocked apply == dense ``X.T @ d``;
+  3. sparse ``margins`` == dense ``X @ w``.
+
+Sizes stay small (1-core CI box); the point is adversarial structure,
+not scale.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from photon_ml_tpu import types as T
+
+
+@st.composite
+def table_and_idx(draw):
+    d = draw(st.integers(1, 400))
+    n = draw(st.integers(1, 300))
+    k = draw(st.integers(1, 6))
+    # normal floats only: subnormals legitimately flush through the
+    # select-sum on FTZ backends (documented in table_gather)
+    nrm = st.one_of(st.just(0.0),
+                    st.floats(1.500000042698307e-38, 1e6, width=32),
+                    st.floats(-1e6, -1.500000042698307e-38, width=32))
+    table = draw(st.lists(nrm, min_size=d, max_size=d))
+    # adversarial index structure: uniform, constant, or boundary-heavy
+    mode = draw(st.sampled_from(["uniform", "constant", "edges"]))
+    if mode == "uniform":
+        idx = draw(st.lists(st.integers(0, d - 1), min_size=n * k,
+                            max_size=n * k))
+    elif mode == "constant":
+        idx = [draw(st.integers(0, d - 1))] * (n * k)
+    else:
+        idx = draw(st.lists(st.sampled_from([0, d - 1]), min_size=n * k,
+                            max_size=n * k))
+    return (np.asarray(table, np.float32),
+            np.asarray(idx, np.int32).reshape(n, k))
+
+
+@settings(max_examples=30, deadline=None)
+@given(table_and_idx())
+def test_vector_gather_bitwise_property(ti):
+    table, idx = ti
+    T.set_gather_mode("vector")
+    old_min, old_chunk = T._GATHER_MIN_SIZE, T._GATHER_CHUNK
+    T._GATHER_MIN_SIZE = 0
+    T._GATHER_CHUNK = 64  # force chunking on most examples
+    try:
+        out = np.asarray(T.table_gather(jnp.asarray(table), jnp.asarray(idx)))
+    finally:
+        T._GATHER_MIN_SIZE, T._GATHER_CHUNK = old_min, old_chunk
+        T.set_gather_mode("auto")
+    np.testing.assert_array_equal(out, table[idx])
+
+
+@st.composite
+def sparse_problem(draw):
+    n = draw(st.integers(1, 120))
+    d = draw(st.integers(1, 150))
+    k = draw(st.integers(1, 5))
+    idx = np.asarray(draw(st.lists(st.integers(0, d - 1), min_size=n * k,
+                                   max_size=n * k)), np.int32).reshape(n, k)
+    implicit = draw(st.booleans())
+    if implicit:
+        vals = None
+    else:
+        vals = np.asarray(draw(st.lists(
+            st.floats(-100, 100, allow_nan=False, width=32),
+            min_size=n * k, max_size=n * k)), np.float64).reshape(n, k)
+    vec = np.asarray(draw(st.lists(
+        st.floats(-100, 100, allow_nan=False, width=32),
+        min_size=n, max_size=n)), np.float64)
+    return idx, vals, d, vec
+
+
+def _dense(idx, vals, d):
+    n, k = idx.shape
+    X = np.zeros((n, d))
+    for i in range(n):
+        for j in range(k):
+            X[i, idx[i, j]] += 1.0 if vals is None else vals[i, j]
+    return X
+
+
+@settings(max_examples=30, deadline=None)
+@given(sparse_problem())
+def test_csc_apply_matches_dense_transpose_property(p):
+    idx, vals, d, vec = p
+    jv = None if vals is None else jnp.asarray(vals, jnp.float64)
+    csc = T.build_csc_transpose(jnp.asarray(idx), jv, d)
+    got = np.asarray(T.csc_transpose_apply(csc, jnp.asarray(vec, jnp.float64)))
+    want = _dense(idx, vals, d).T @ vec
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+@settings(max_examples=30, deadline=None)
+@given(sparse_problem())
+def test_margins_match_dense_product_property(p):
+    idx, vals, d, _ = p
+    rng = np.random.default_rng(idx.sum() % (2**31))
+    w = rng.normal(size=d)
+    jv = None if vals is None else jnp.asarray(vals, jnp.float64)
+    feats = T.SparseFeatures(jnp.asarray(idx), jv, dim=d)
+    got = np.asarray(T.margins(feats, jnp.asarray(w, jnp.float64)))
+    want = _dense(idx, vals, d) @ w
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
