@@ -394,3 +394,63 @@ def test_empty_process_part_raises_actionable_error(tmp_path, rng):
     with pytest.raises(ValueError, match="smaller block_size"):
         AvroChunkSource(path, imap, chunk_rows=32, pad_nnz=full.pad_nnz,
                         process_part=(1, 2))
+
+
+def test_native_python_decode_parity_fuzz(tmp_path):
+    """Property sweep of the python-vs-native decoder parity: randomized
+    record shapes (empty rows, duplicate features, extreme values, odd
+    block sizes, varying chunk_rows) must decode identically through both
+    paths. Complements the single-dataset parity test above."""
+    import os
+
+    from hypothesis import given, settings, strategies as st
+
+    @st.composite
+    def dataset(draw):
+        n = draw(st.integers(1, 80))
+        vocab = draw(st.integers(1, 25))
+        block = draw(st.sampled_from([1, 3, 16, 4096]))
+        chunk = draw(st.sampled_from([1, 7, 32]))
+        rows, labels, weights, offsets = [], [], [], []
+        for _ in range(n):
+            k = draw(st.integers(0, 5))
+            feats = [(f"f{draw(st.integers(0, vocab - 1))}", "",
+                      draw(st.floats(-1e6, 1e6, width=32)))
+                     for _ in range(k)]
+            rows.append(feats)
+            labels.append(float(draw(st.integers(0, 1))))
+            weights.append(draw(st.floats(0.125, 10.0, width=32)))
+            offsets.append(draw(st.floats(-10.0, 10.0, width=32)))
+        return n, vocab, block, chunk, rows, labels, weights, offsets
+
+    @settings(max_examples=12, deadline=None)
+    @given(dataset())
+    def check(ds):
+        n, vocab, block, chunk, rows, labels, weights, offsets = ds
+        sub = tmp_path / f"fz{abs(hash(str(ds))) % (1 << 30)}"
+        sub.mkdir(exist_ok=True)
+        path = str(sub / "d.avro")
+        write_training_examples(path, rows, np.asarray(labels),
+                                offsets=np.asarray(offsets),
+                                weights=np.asarray(weights),
+                                block_size=block)
+        imap = IndexMap({f"f{c}": c for c in range(vocab)},
+                        add_intercept=True)
+        src_n = AvroChunkSource(path, imap, chunk_rows=chunk)
+        os.environ["PHOTON_ML_TPU_NO_NATIVE"] = "1"
+        try:
+            src_p = AvroChunkSource(path, imap, chunk_rows=chunk,
+                                    pad_nnz=src_n.pad_nnz)
+        finally:
+            del os.environ["PHOTON_ML_TPU_NO_NATIVE"]
+        assert not src_p._use_native
+        chunks_n, chunks_p = list(src_n), list(src_p)
+        assert len(chunks_n) == len(chunks_p)
+        for a, b in zip(chunks_n, chunks_p):
+            np.testing.assert_array_equal(a.indices, b.indices)
+            np.testing.assert_allclose(a.values, b.values, rtol=1e-6)
+            np.testing.assert_allclose(a.labels, b.labels)
+            np.testing.assert_allclose(a.offsets, b.offsets, atol=1e-6)
+            np.testing.assert_allclose(a.weights, b.weights, rtol=1e-6)
+
+    check()
