@@ -1,0 +1,67 @@
+"""Reference supervisor for the drivers' exit-75 device-loss contract.
+
+    python scripts/supervise.py [--max-retries N] [--probe-timeout S] -- \
+        python -m photon_ml_tpu.cli.game_training_driver ... --checkpoint --auto-resume
+
+Runs the command; on exit 75 (EX_TEMPFAIL: device lost, resume state
+persisted) it waits for the accelerator to answer a subprocess probe,
+then reruns the SAME command — the drivers' markers make the rerun a
+resume, not a restart. Any other exit code passes through. This is the
+whole recovery loop; production schedulers (k8s restartPolicy +
+exit-code checks, slurm --requeue hooks) express the same contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+
+PROBE = ("import jax, jax.numpy as jnp\n"
+         "assert jax.devices()[0].platform != 'cpu'\n"
+         "x = jnp.ones((64, 64)); float((x @ x)[0, 0])\n")
+
+
+def device_alive(timeout_s: float) -> bool:
+    try:
+        return subprocess.run([sys.executable, "-c", PROBE],
+                              timeout=timeout_s,
+                              capture_output=True).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-retries", type=int, default=5)
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--probe-interval", type=float, default=240.0)
+    ap.add_argument("--skip-probe", action="store_true",
+                    help="rerun immediately on 75 (CPU runs, tests)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- followed by the command to supervise")
+    args = ap.parse_args()
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        ap.error("no command given (use: supervise.py [opts] -- cmd ...)")
+
+    for attempt in range(args.max_retries + 1):
+        rc = subprocess.run(cmd).returncode
+        if rc != 75:
+            return rc
+        if attempt == args.max_retries:
+            print(f"supervise: giving up after {attempt + 1} device losses",
+                  file=sys.stderr)
+            return 75
+        print(f"supervise: device lost (attempt {attempt + 1}); waiting for "
+              "the accelerator", file=sys.stderr, flush=True)
+        while not args.skip_probe and not device_alive(args.probe_timeout):
+            time.sleep(args.probe_interval)
+        print("supervise: rerunning (resume markers make this a resume)",
+              file=sys.stderr, flush=True)
+    return 75
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -506,3 +506,36 @@ def test_scoring_device_loss_exits_75_no_partial_output(game_fixture,
     assert rc == 75
     assert not (sout / "scores.avro").exists()
     assert not [f for f in os.listdir(sout) if ".tmp-" in f]
+
+
+def test_supervise_reruns_on_75_and_passes_through_other_codes(tmp_path):
+    """scripts/supervise.py: exit 75 -> rerun (a resume via the drivers'
+    markers); any other code passes through; retries bounded."""
+    import subprocess
+    import sys
+
+    job = tmp_path / "job.py"
+    job.write_text(
+        "import os, sys\n"
+        "m = sys.argv[1]\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('x'); sys.exit(75)\n"
+        "sys.exit(0)\n")
+    sup = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "supervise.py")
+    marker = tmp_path / "m1"
+    rc = subprocess.run([sys.executable, sup, "--skip-probe", "--",
+                         sys.executable, str(job), str(marker)]).returncode
+    assert rc == 0 and marker.exists()
+
+    fail = tmp_path / "fail.py"
+    fail.write_text("import sys; sys.exit(3)\n")
+    rc = subprocess.run([sys.executable, sup, "--skip-probe", "--",
+                         sys.executable, str(fail)]).returncode
+    assert rc == 3
+
+    rc = subprocess.run([sys.executable, sup, "--skip-probe",
+                         "--max-retries", "0", "--",
+                         sys.executable, str(job),
+                         str(tmp_path / "m2")]).returncode
+    assert rc == 75
