@@ -94,8 +94,9 @@ def main() -> None:
     if pinned != "cpu" and not require_tpu and not _tpu_reachable():
         os.environ["JAX_PLATFORMS"] = "cpu"
         fallback = ("; TPU-unreachable CPU FALLBACK, not comparable to TPU "
-                    "rounds — last banked TPU measurement: 3.35M passes/s, "
-                    "vs_baseline 2.20 (2026-07-31, "
+                    "rounds — last banked TPU measurement: 3.35M passes/s "
+                    "(the pinned comparator itself; 2.20x the r03 lower "
+                    "bound) (2026-07-31, "
                     "docs/tpu_r05_logs/bench_postgather.log)")
         print("TPU tunnel unreachable -> CPU fallback measurement",
               file=sys.stderr)
