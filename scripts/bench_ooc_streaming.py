@@ -106,8 +106,12 @@ def main():
                     "metadataMap": {},
                 }
 
-        write_avro_file(path, records(), TRAINING_EXAMPLE_SCHEMA,
+        # tmp+rename: a killed multi-minute write must never leave a
+        # truncated file that a later --reuse silently benches against
+        tmp = f"{path}.tmp-{os.getpid()}"
+        write_avro_file(tmp, records(), TRAINING_EXAMPLE_SCHEMA,
                         codec="null")
+        os.replace(tmp, path)
         print(f"wrote {path} ({os.path.getsize(path)/1e6:.1f} MB) "
               f"in {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
 
@@ -138,6 +142,11 @@ def main():
 
     src = AvroChunkSource(path, imap, chunk_rows=args.chunk_rows,
                           pad_nnz=k + 1, prefetch=args.prefetch)
+    if src.total_rows != n:
+        print(f"error: {path} holds {src.total_rows} rows, expected {n} "
+              "(stale/partial --reuse dataset?); delete it and rerun",
+              file=sys.stderr, flush=True)
+        sys.exit(2)
     chunk_mb = args.chunk_rows * (k + 1) * 8 / 1e6  # idx i32 + val f32
     print(f"source: {len(src)} chunks x {args.chunk_rows} rows "
           f"({chunk_mb:.1f} MB/chunk, residency bound "
