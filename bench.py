@@ -93,11 +93,15 @@ def main() -> None:
     require_tpu = os.environ.get("BENCH_REQUIRE_TPU") == "1"
     if pinned != "cpu" and not require_tpu and not _tpu_reachable():
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # built from the SAME comparator record the ratio uses
+        # (BENCH_BASELINE.json via _baseline) so the banner can never
+        # drift from a re-banked baseline
+        base = _baseline()
+        banked = (f"last banked TPU measurement: {base[0]/1e6:.2f}M "
+                  f"passes/s ({base[1]})" if base
+                  else "no banked TPU comparator")
         fallback = ("; TPU-unreachable CPU FALLBACK, not comparable to TPU "
-                    "rounds — last banked TPU measurement: 3.35M passes/s "
-                    "(the pinned comparator itself; 2.20x the r03 lower "
-                    "bound) (2026-07-31, "
-                    "docs/tpu_r05_logs/bench_postgather.log)")
+                    f"rounds — {banked}")
         print("TPU tunnel unreachable -> CPU fallback measurement",
               file=sys.stderr)
     import jax

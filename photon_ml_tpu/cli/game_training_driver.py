@@ -187,6 +187,7 @@ def _read_dataset(paths, index_maps, entity_columns, columns=None) -> GameDatase
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    from photon_ml_tpu.parallel import resilience
     from photon_ml_tpu.parallel.multihost import initialize_multihost, runtime_info
 
     distributed = initialize_multihost(args.coordinator_address,
@@ -344,7 +345,12 @@ def main(argv: Sequence[str] | None = None) -> int:
     norm_type = NormalizationType(args.normalization)
     if norm_type != NormalizationType.NONE or args.summarize_features:
         contexts = {}
-        with Timed(logger, "feature_summarization"):
+        # feature summarization is the first collective phase of a
+        # multi-controller run (the streamed-moment all-reduce): run it
+        # under the health guard so one process's read/decode failure
+        # aborts every process instead of wedging the reduce
+        with Timed(logger, "feature_summarization"), \
+                resilience.CollectiveGuard("feature_summarization"):
             for shard in shards:
                 if shard in ooc_shards:
                     # one extra streamed pass over the disk-backed shard:
@@ -389,30 +395,37 @@ def main(argv: Sequence[str] | None = None) -> int:
             ]
 
     warm = load_game_model(args.warm_start_model) if args.warm_start_model else None
-    resume_marker = os.path.join(args.output_dir, "RESUME.json")
-    if args.auto_resume and os.path.exists(resume_marker):
+    # Unified resume-marker lifecycle (parallel/resilience.ResumeManager):
+    # written atomically on device loss, KEPT until this run completes (a
+    # second failure of any kind — OOM, SIGKILL, another device loss —
+    # must not discard resume state; same semantics as the GLM driver's
+    # RESUME_GLM.npz), and fingerprinted against the inputs so a rerun
+    # pointed at different data refuses to resume instead of silently
+    # mixing datasets.
+    resume = resilience.ResumeManager(
+        os.path.join(args.output_dir, "RESUME.json"),
+        fingerprint={
+            "train_data": sorted(args.train_data),
+            "validation_data": (sorted(args.validation_data)
+                                if args.validation_data else None),
+            "validation_rows": (None if validation is None
+                                else int(validation.num_samples)),
+        },
+        is_lead=is_lead)
+    if args.auto_resume and resume.exists():
         # marker-gated ONLY: without it --auto-resume is a no-op, so a
         # supervisor can pass the flag unconditionally without a cleanly
         # finished run's leftover checkpoints hijacking later reruns
-        with open(resume_marker) as f:
-            resume_from = json.load(f).get("checkpoint")
+        resume_from = resume.load().get("checkpoint")
         if resume_from:
             warm = load_game_model(resume_from)
             logger.log("auto_resume", checkpoint=resume_from)
         if distributed:
-            # every process must have READ the marker before the lead
-            # removes it — without this barrier a slower process misses
-            # the marker, warm-starts differently, and the SPMD states
-            # silently diverge
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices("photon_auto_resume_loaded")
-        if is_lead:
-            # consumed only AFTER every process loaded the checkpoint
-            import contextlib
-
-            with contextlib.suppress(FileNotFoundError):
-                os.remove(resume_marker)
+            # every process must have adopted the checkpoint before any
+            # enters training's first collective; the health barrier
+            # doubles as the ordering sync and surfaces a peer whose
+            # marker load failed
+            resilience.health_barrier("auto_resume_loaded")
 
     evaluators = args.evaluators
     if evaluators is None:
@@ -461,14 +474,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         if not is_device_loss(e) or not args.checkpoint:
             raise
         latest = _latest_checkpoint(args.output_dir)
-        if is_lead:
-            with open(resume_marker, "w") as f:
-                json.dump({"error": str(e).split("\n")[0],
-                           "checkpoint": latest}, f)
+        resume.save({"error": str(e).split("\n")[0], "checkpoint": latest})
         logger.log("device_lost", error=str(e).split("\n")[0],
                    resume_checkpoint=latest)
         logger.close()
-        print(f"device lost; resume marker written to {resume_marker} "
+        print(f"device lost; resume marker written to {resume.path} "
               "(rerun with --auto-resume)", file=sys.stderr)
         return 75
 
@@ -505,6 +515,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                         r.model,
                         os.path.join(args.output_dir, "all", f"config-{gi}"),
                         index_maps)
+    # outputs are published: ANY completed run consumes the marker (not
+    # only --auto-resume ones) so a later auto-resume cannot warm-start
+    # from a checkpoint that predates these outputs
+    resume.consume()
     logger.log("driver_done",
                best_config=[dataclasses_asdict(c) for c in best.configs],
                best_metrics=None if best.evaluation is None else best.evaluation.metrics)

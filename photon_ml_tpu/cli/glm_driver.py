@@ -193,6 +193,7 @@ def _read(paths, fmt, index_map: Optional[IndexMap], add_intercept):
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    from photon_ml_tpu.parallel import fault_injection, resilience
     from photon_ml_tpu.parallel.multihost import initialize_multihost, runtime_info
 
     distributed = initialize_multihost(args.coordinator_address,
@@ -368,12 +369,31 @@ def main(argv: Sequence[str] | None = None) -> int:
     # GAME driver's RESUME marker, but lambda-granular: every finished
     # lambda's host-side result is persisted, so the rerun replays them
     # and resumes the warm-start chain at the first unfinished lambda).
-    resume_path = os.path.join(args.output_dir, "RESUME_GLM.npz")
     is_lead = (not distributed) or jax.process_index() == 0
-    if args.auto_resume and os.path.exists(resume_path):
+    # Unified marker lifecycle (parallel/resilience.ResumeManager): atomic
+    # writes, kept until the grid completes, and a validation-input
+    # fingerprint — restored per-lambda metrics were computed on the
+    # crashed run's validation dataset, so a rerun pointed at different
+    # --validation-data must refuse resume instead of mixing metrics from
+    # two datasets when selecting the best lambda.
+    resume = resilience.ResumeManager(
+        os.path.join(args.output_dir, "RESUME_GLM.npz"),
+        fingerprint={
+            "train_data": sorted(args.train_data),
+            "validation_data": (sorted(args.validation_data)
+                                if args.validation_data else None),
+            "validation_rows": (None if validation is None
+                                else int(vlabels.shape[0])),
+        },
+        is_lead=is_lead)
+    resume_path = resume.path
+    if args.auto_resume and resume.exists():
         from types import SimpleNamespace
 
-        saved = np.load(resume_path, allow_pickle=True)["payload"].item()
+        # driver-specific compatibility checks run FIRST (their error
+        # messages name the actual mismatch); the input fingerprint is
+        # verified after, below
+        saved = resume.load(verify=False)
         saved_lams = [e["lam"] for e in saved["entries"]]
         if saved_lams != list(args.reg_weights[: len(saved_lams)]):
             raise ValueError(
@@ -389,6 +409,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                 f"{evaluators[0]!r} (the crashed run had different "
                 "validation settings); rerun with the original settings or "
                 "delete the marker")
+        resume.verify(saved)  # refuse changed train/validation inputs
         for e in saved["entries"]:
             res_like = SimpleNamespace(**e["res"])
             res_like.w = jnp.asarray(res_like.w, dtype)
@@ -399,8 +420,6 @@ def main(argv: Sequence[str] | None = None) -> int:
         logger.log("auto_resume", completed_lambdas=len(results))
 
     def _persist_resume(err):
-        if not is_lead:
-            return
         entries = [{
             "lam": lam,
             "res": {"w": np.asarray(res.w),  # native dtype: a resumed
@@ -414,16 +433,12 @@ def main(argv: Sequence[str] | None = None) -> int:
             "variances": (None if variances_ is None
                           else np.asarray(variances_)),
         } for lam, res, metrics_, variances_ in results]
-        tmp = f"{resume_path}.tmp-{os.getpid()}"
-        np.savez(tmp, payload={
+        resume.save({
             "entries": entries,
             "last_w": (np.asarray(results[-1][1].w)
                        if results else np.zeros((dim,))),
             "error": str(err).split("\n")[0],
         })
-        # np.savez appends .npz to names without it
-        os.replace(tmp if tmp.endswith(".npz") else tmp + ".npz",
-                   resume_path)
 
     # the per-dataset column sort behind the csc gradient paths is paid
     # once for the whole lambda grid, not per fit
@@ -440,6 +455,10 @@ def main(argv: Sequence[str] | None = None) -> int:
     try:
         with Timed(logger, "training"), profile_trace(args.profile_dir):
             for lam in args.reg_weights[len(results):]:
+                # per-lambda injection point: kill-and-rerun tests drive
+                # the device-loss resume path through here without
+                # monkeypatching the fit internals
+                fault_injection.check("glm.lambda")
                 if streaming:
                     from photon_ml_tpu.parallel.streaming import fit_streaming
 
@@ -595,11 +614,7 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     # outputs are published: ANY completed grid consumes a marker so a
     # later --auto-resume cannot replay stale results
-    if is_lead:
-        import contextlib
-
-        with contextlib.suppress(FileNotFoundError):
-            os.remove(resume_path)
+    resume.consume()
     logger.log("driver_done", best_reg_weight=results[best_i][0],
                best_metrics=results[best_i][2] or None)
     logger.close()

@@ -1,0 +1,72 @@
+"""Version compatibility shims for the jax API surface this repo targets.
+
+The code is written against the current jax API (``jax.shard_map`` with
+``check_vma``, ``jax.typeof``); older runtimes (0.4.x, where ``shard_map``
+still lives in ``jax.experimental`` and replication checking is spelled
+``check_rep``) are common in pinned TPU images, and every entry point in
+this package must keep working there. One shim module, imported as
+``from photon_ml_tpu.compat import shard_map, typeof``, so the
+per-call-site hasattr probing never spreads through the codebase.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "typeof", "random_multinomial", "VMA_TRANSPOSE"]
+
+# True on the jax.shard_map era: varying-manual-axes (vma) tracking makes
+# the AD transpose of "replicated operand touches sharded data" insert the
+# gradient's psum automatically inside a shard_map body. The legacy
+# check_rep shard_map leaves inside-body AD collective-free, so call sites
+# that rely on the auto-inserted all-reduce must psum their partial
+# gradients explicitly when this is False (a static trace-time branch).
+VMA_TRANSPOSE = hasattr(jax, "shard_map")
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        """Legacy spelling: ``check_vma`` was ``check_rep`` before shard_map
+        graduated out of jax.experimental; semantics (skip the replication/
+        varying-axes type check and its AD-transpose collective insertion)
+        are the same for every use in this repo."""
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+
+
+if hasattr(jax.random, "multinomial"):
+    random_multinomial = jax.random.multinomial
+else:
+    def random_multinomial(key, n, p, *, shape):
+        """Legacy fallback: ``n`` iid categorical draws per output row,
+        histogrammed — exactly a Multinomial(n, p) sample. ``n`` and
+        ``shape`` must be static (they are, at the bootstrap call site)."""
+        import jax.numpy as jnp
+
+        k = p.shape[-1]
+        assert shape[-1] == k, (shape, k)
+        rows = 1
+        for s in shape[:-1]:
+            rows *= s
+        draws = jax.random.categorical(key, jnp.log(p), axis=-1,
+                                       shape=(rows, int(n)))
+        counts = jax.vmap(
+            lambda d: jnp.zeros((k,), jnp.int32).at[d].add(1))(draws)
+        return counts.reshape(shape)
+
+
+if hasattr(jax, "typeof"):
+    typeof = jax.typeof
+else:
+    def typeof(x):
+        """Pre-``jax.typeof`` fallback: the abstract value. Callers in this
+        repo only read optional attributes off the result (``.vma`` with a
+        frozenset default), and legacy avals simply don't carry them."""
+        aval = getattr(x, "aval", None)
+        if aval is not None:
+            return aval
+        return jax.core.get_aval(x)

@@ -82,7 +82,9 @@ def bootstrap_coefficients(
 
     @jax.jit
     def run_all(key):
-        counts = jax.random.multinomial(
+        from photon_ml_tpu.compat import random_multinomial
+
+        counts = random_multinomial(
             key, n, jnp.full((n,), 1.0 / n), shape=(n_replicates, n)
         ).astype(batch.weights.dtype)
 

@@ -59,11 +59,13 @@ from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.ops.regularization import RegularizationContext, RegularizationType
 from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
+from photon_ml_tpu.parallel import fault_injection
 from photon_ml_tpu.parallel.data_parallel import (
     distributed_hvp,
     distributed_value_and_grad,
 )
 from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.parallel.resilience import CollectiveGuard
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures, margins as _margins
 
 
@@ -757,60 +759,69 @@ class CoordinateDescent:
                 total = base + sum(scores.values())
                 offs = total - scores[cfg.name]
                 record = {"iteration": it, "coordinate": cfg.name}
-                if cfg.name not in locked:
-                    if cfg.coordinate_type == "fixed":
-                        res = st.fit(offs)
-                        record.update(
-                            loss=float(res.value), converged=bool(res.converged),
-                            optimizer_iterations=int(res.iterations),
-                        )
-                        w_model = st.model_space_w()
-                        scores[cfg.name] = st.train_scores(w_model)
-                        if validation is not None:
-                            val_scores[cfg.name] = _margins(
-                                val_feats[cfg.name], w_model
+                # A CD sweep boundary is a collective phase boundary in
+                # multi-controller runs (streamed-pass reductions, score
+                # allgathers, device-eval psums): the guard converts any
+                # process's local failure inside this step into PeerFailure
+                # on every process at the step boundary, instead of letting
+                # the survivors deadlock in the next coordinate's
+                # collectives (parallel/resilience.py).
+                with CollectiveGuard(f"cd:{it}:{cfg.name}"):
+                    fault_injection.check("cd.step")
+                    if cfg.name not in locked:
+                        if cfg.coordinate_type == "fixed":
+                            res = st.fit(offs)
+                            record.update(
+                                loss=float(res.value), converged=bool(res.converged),
+                                optimizer_iterations=int(res.iterations),
                             )
-                    else:
-                        reg = cfg.reg_context()
-                        fit = train_random_effect(
-                            st.train_data, offs, task=self.task,
-                            l2=reg.l2_weight(cfg.reg_weight),
-                            l1=reg.l1_weight(cfg.reg_weight),
-                            optimizer=cfg.optimizer, config=cfg.opt_config(),
-                            w0=st.coeffs, mesh=entity_mesh,
-                            compute_variance=cfg.compute_variance, dtype=dtype,
-                            normalization=cfg.normalization,
-                        )
-                        st.coeffs = fit.coefficients
-                        st.variances = fit.variances
-                        record.update(
-                            converged_fraction=fit.converged_fraction,
-                            mean_optimizer_iterations=fit.mean_iterations,
-                        )
-                        scores[cfg.name] = score_random_effect(
-                            st.train_view, st.coeffs, n, dtype
-                        )
-                        if validation is not None:
-                            val_scores[cfg.name] = score_random_effect(
-                                val_states[cfg.name], st.coeffs, val_n, dtype
+                            w_model = st.model_space_w()
+                            scores[cfg.name] = st.train_scores(w_model)
+                            if validation is not None:
+                                val_scores[cfg.name] = _margins(
+                                    val_feats[cfg.name], w_model
+                                )
+                        else:
+                            reg = cfg.reg_context()
+                            fit = train_random_effect(
+                                st.train_data, offs, task=self.task,
+                                l2=reg.l2_weight(cfg.reg_weight),
+                                l1=reg.l1_weight(cfg.reg_weight),
+                                optimizer=cfg.optimizer, config=cfg.opt_config(),
+                                w0=st.coeffs, mesh=entity_mesh,
+                                compute_variance=cfg.compute_variance, dtype=dtype,
+                                normalization=cfg.normalization,
                             )
-                record["seconds"] = time.time() - t0
-                if validation is not None and evaluators:
-                    v_total_dev = val_offsets_dev + sum(val_scores.values())
-                    v_total_host = None
-                    for ev in evaluators:
-                        fn = device_evals.get(ev.name)
-                        if fn is not None:
-                            record[ev.name] = float(
-                                fn(v_total_dev, val_labels_dev,
-                                   val_weights_dev))
-                        else:  # grouped / precision@k: host path
-                            if v_total_host is None:
-                                v_total_host = np.asarray(v_total_dev)
-                            record[ev.name] = ev.evaluate(
-                                v_total_host, validation.labels,
-                                validation.weights, validation.group_ids,
+                            st.coeffs = fit.coefficients
+                            st.variances = fit.variances
+                            record.update(
+                                converged_fraction=fit.converged_fraction,
+                                mean_optimizer_iterations=fit.mean_iterations,
                             )
+                            scores[cfg.name] = score_random_effect(
+                                st.train_view, st.coeffs, n, dtype
+                            )
+                            if validation is not None:
+                                val_scores[cfg.name] = score_random_effect(
+                                    val_states[cfg.name], st.coeffs, val_n, dtype
+                                )
+                    record["seconds"] = time.time() - t0
+                    if validation is not None and evaluators:
+                        v_total_dev = val_offsets_dev + sum(val_scores.values())
+                        v_total_host = None
+                        for ev in evaluators:
+                            fn = device_evals.get(ev.name)
+                            if fn is not None:
+                                record[ev.name] = float(
+                                    fn(v_total_dev, val_labels_dev,
+                                       val_weights_dev))
+                            else:  # grouped / precision@k: host path
+                                if v_total_host is None:
+                                    v_total_host = np.asarray(v_total_dev)
+                                record[ev.name] = ev.evaluate(
+                                    v_total_host, validation.labels,
+                                    validation.weights, validation.group_ids,
+                                )
                 if self.verbose:
                     print(f"[CD] {record}")
                 history.append(record)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from photon_ml_tpu.compat import shard_map
 from photon_ml_tpu.game.data import RandomEffectTrainData, REScoreBucket
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.ops.normalization import NormalizationContext
@@ -256,9 +257,13 @@ def _jitted_sharded_solver(local_dim, task, optimizer, config, compute_variance,
     solver = _solver_for_bucket(local_dim, task, optimizer, config,
                                 compute_variance, norm_mode)
     spec = (P(axis),) * 8 + (P(), P())
-    sharded = jax.shard_map(
+    # check_vma=False: the batched solver is per-entity independent — no
+    # collective, nothing relies on vma-driven transposes — and legacy
+    # check_rep has no replication rule for the optimizer's while_loop
+    sharded = shard_map(
         solver, mesh=mesh, in_specs=spec,
         out_specs=(P(axis), P(axis), P(axis), P(axis)),
+        check_vma=False,
     )
     return jax.jit(sharded)
 
@@ -428,6 +433,16 @@ def train_random_effect(
     conv_sum, iter_sum, total = 0.0, 0.0, 0
     for b, bucket in enumerate(data.buckets):
         E, D = bucket.num_entities, bucket.local_dim
+        if E == 0:
+            # degenerate bucket (no entities): nothing to solve — emit the
+            # empty [0, D] shapes downstream consumers expect (scoring,
+            # model building, warm start) and keep the convergence
+            # accounting untouched rather than tripping range(step=0) /
+            # W_parts[0] in the blocked loop below
+            coeffs.append(np.zeros((0, D), np.dtype(dtype)))
+            variances.append(np.zeros((0, D), np.dtype(dtype))
+                             if compute_variance else None)
+            continue
         opt_b = resolve_re_optimizer(optimizer, D)
         sidx = jnp.asarray(bucket.sample_idx)
         # padding rows (sidx == -1) carry weight 0, offset value irrelevant

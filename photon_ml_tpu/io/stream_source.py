@@ -32,6 +32,7 @@ never a new failure mode (same policy as ``io/data_reader.py``).
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import dataclasses
 import os
@@ -46,6 +47,7 @@ from photon_ml_tpu.io.avro import (
     _read_header,
     _read_long_or_eof,
 )
+from photon_ml_tpu.parallel import fault_injection
 from photon_ml_tpu.parallel.streaming import HostChunk
 
 __all__ = ["AvroChunkSource", "ScalarOverlaySource", "scan_blocks",
@@ -112,7 +114,8 @@ def iter_block_records(blocks: Sequence[BlockRef]) -> Iterator[dict]:
                 schema, _, _ = _read_header(f, blk.path)
                 open_path = blk.path
             f.seek(blk.payload_offset)
-            payload = f.read(blk.payload_size)
+            payload = fault_injection.mangle_payload(
+                "stream.block_payload", f.read(blk.payload_size))
             if len(payload) != blk.payload_size:
                 raise ValueError(f"{blk.path}: truncated block")
             if blk.codec == "deflate":
@@ -311,15 +314,25 @@ class AvroChunkSource:
                 else:
                     s0 = s1 = 0
                 self.part_spans.append((s0, s1))
+            # Coordinated abort without communication: the spans are
+            # computed from the GLOBAL block layout, identically on every
+            # process, so a starved part is detected — and raised — on ALL
+            # processes, not only the one that owns it. (Raising on one
+            # process alone would leave its peers deadlocked inside the
+            # next collective until the watchdog; see
+            # parallel/resilience.py for the runtime-failure analogue.)
+            starved = [i for i, (s0, s1) in enumerate(self.part_spans)
+                       if s0 == s1]
+            if starved:
+                raise ValueError(
+                    f"process_part {starved[0]}/{n_parts} owns no container "
+                    f"blocks ({len(counts)} blocks for {n_parts} parts; "
+                    f"starved parts {starved}, detected on every process): "
+                    "rewrite the dataset with a smaller block_size so "
+                    "every process gets >= one block")
             e0, e1 = int(edges[part]), int(edges[part + 1])
             self._blocks = self._blocks[e0:e1]
             self.row_span = self.part_spans[part]
-            if not self._blocks:
-                raise ValueError(
-                    f"process_part {part}/{n_parts} owns no container "
-                    f"blocks ({len(counts)} blocks for {n_parts} parts): "
-                    "rewrite the dataset with a smaller block_size so "
-                    "every process gets >= one block")
         self.rows = sum(b.count for b in self._blocks)
         if self.rows == 0:
             raise ValueError(f"no records under {paths!r}")
@@ -479,7 +492,8 @@ class AvroChunkSource:
                     f = open(blk.path, "rb")
                     open_path = blk.path
                 f.seek(blk.payload_offset)
-                payload = f.read(blk.payload_size)
+                payload = fault_injection.mangle_payload(
+                    "stream.block_payload", f.read(blk.payload_size))
                 if len(payload) != blk.payload_size:
                     raise ValueError(f"{blk.path}: truncated block")
                 wave.append((payload, blk))
@@ -585,7 +599,18 @@ class AvroChunkSource:
                 continue
         return False
 
-    def _produce(self, q: queue.Queue, stop: threading.Event):
+    def _produce(self, q: queue.Queue, stop: threading.Event,
+                 fault_proc: Optional[int] = None):
+        # the producer thread acts on behalf of the CONSUMER's process:
+        # propagate its process identity so per-process fault plans (and
+        # the simulated multi-controller harness) address decode faults
+        # deterministically
+        ctx = (fault_injection.process_context(fault_proc)
+               if fault_proc is not None else contextlib.nullcontext())
+        with ctx:
+            self._produce_inner(q, stop)
+
+    def _produce_inner(self, q: queue.Queue, stop: threading.Event):
         try:
             pending = _Ragged()
             for wave in self._ragged_waves():
@@ -611,12 +636,24 @@ class AvroChunkSource:
         self.passes += 1
         q: queue.Queue = queue.Queue(maxsize=max(self._prefetch, 1))
         stop = threading.Event()
-        t = threading.Thread(target=self._produce, args=(q, stop),
+        try:
+            from photon_ml_tpu.parallel.resilience import (
+                current_process_index,
+            )
+
+            fault_proc = current_process_index()
+        except Exception:
+            fault_proc = None
+        t = threading.Thread(target=self._produce,
+                             args=(q, stop, fault_proc),
                              daemon=True, name="avro-chunk-producer")
         t.start()
         emitted = 0
         try:
             while True:
+                # consumer-side injection point: raise-at-chunk-N faults
+                # fire in the consuming (process-context-bearing) thread
+                fault_injection.check("stream.chunk")
                 item = q.get()
                 if item is None:
                     break

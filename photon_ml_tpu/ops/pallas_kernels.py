@@ -24,6 +24,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from photon_ml_tpu.compat import VMA_TRANSPOSE, typeof
 from jax.experimental import pallas as pl
 
 _LANES = 128
@@ -69,7 +71,7 @@ def _mps_kernel(v_ref, d_ref, out_ref):
 def _mps_call(v, d, n_tiles, block_rows, interpret):
     # under shard_map (manual mode) the output varies over the same mesh
     # axes as the inputs; plumb the vma through or check_vma rejects the call
-    vma = frozenset(getattr(jax.typeof(v), "vma", frozenset()))
+    vma = frozenset(getattr(typeof(v), "vma", frozenset()))
     def _shape(sh):
         return (jax.ShapeDtypeStruct(sh, v.dtype, vma=vma) if vma
                 else jax.ShapeDtypeStruct(sh, v.dtype))
@@ -115,13 +117,22 @@ def multiply_prefix_sum(
     d = jnp.pad(d_sorted, (0, pad)).reshape(-1, _LANES)
 
     if interpret is None:
-        local = jax.lax.platform_dependent(
-            v, d,
-            tpu=functools.partial(_mps_call, n_tiles=n_tiles,
-                                  block_rows=block_rows, interpret=False),
-            default=functools.partial(_mps_call, n_tiles=n_tiles,
-                                      block_rows=block_rows, interpret=True),
-        )
+        if not VMA_TRANSPOSE:
+            # legacy jax lowers BOTH platform_dependent branches for the
+            # current platform, and the compiled-kernel branch hard-fails
+            # CPU lowering; fall back to the trace-time backend probe there
+            # (losing only the lower-for-TPU-from-CPU-host export case)
+            local = _mps_call(v, d, n_tiles, block_rows,
+                              interpret=jax.default_backend() != "tpu")
+        else:
+            local = jax.lax.platform_dependent(
+                v, d,
+                tpu=functools.partial(_mps_call, n_tiles=n_tiles,
+                                      block_rows=block_rows, interpret=False),
+                default=functools.partial(_mps_call, n_tiles=n_tiles,
+                                          block_rows=block_rows,
+                                          interpret=True),
+            )
     else:
         local = _mps_call(v, d, n_tiles, block_rows, interpret)
     totals = local.reshape(n_tiles, -1)[:, -1]

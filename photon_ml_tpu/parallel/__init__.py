@@ -1,6 +1,17 @@
+from photon_ml_tpu.parallel import fault_injection, resilience
 from photon_ml_tpu.parallel.mesh import make_mesh, pad_batch, shard_batch
 from photon_ml_tpu.parallel.data_parallel import (
     distributed_value_and_grad,
     distributed_hvp,
     fit_distributed,
+)
+from photon_ml_tpu.parallel.resilience import (
+    CollectiveGuard,
+    PeerFailure,
+    ResumeManager,
+    ResumeMismatch,
+    WatchdogTimeout,
+    guarded,
+    health_barrier,
+    retry_transient,
 )

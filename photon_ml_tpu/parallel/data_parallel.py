@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from photon_ml_tpu.compat import VMA_TRANSPOSE, shard_map
 from photon_ml_tpu.ops.losses import apply_weights, mask_margins
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
@@ -44,7 +45,7 @@ def distributed_value_and_grad(
     the single-device objective exactly."""
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(axis), P()),
         out_specs=(P(), P()),
@@ -54,8 +55,11 @@ def distributed_value_and_grad(
         # value needs an explicit psum: under shard_map's varying-axis
         # tracking (check_vma), the AD transpose of "replicated w touches
         # sharded batch" inserts the gradient's all-reduce automatically —
-        # psumming g again would multiply it by the axis size.
+        # psumming g again would multiply it by the axis size. Legacy
+        # check_rep shard_map inserts nothing, so psum explicitly there.
         f, g = objective.value_and_grad(w, batch, 0.0)
+        if not VMA_TRANSPOSE:
+            g = lax.psum(g, axis)
         return lax.psum(f, axis), g
 
     def fg(w, batch, l2=0.0):
@@ -73,16 +77,18 @@ def distributed_hvp(objective: GLMObjective, mesh: Mesh, axis: str = "data") -> 
     per CG step (SURVEY.md §4.2), as one on-device collective."""
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(axis)),
         out_specs=P(),
     )
     def shard_hvp(w, v, batch):
         # Like the gradient, the HVP's all-reduce is inserted by the AD
-        # transpose (w and v are replicated, batch varies over `axis`).
+        # transpose (w and v are replicated, batch varies over `axis`) —
+        # except on legacy check_rep shard_map, where it must be explicit.
         grad_data = lambda x: objective.grad(x, batch, 0.0)
-        return jax.jvp(grad_data, (w,), (v,))[1]
+        hv = jax.jvp(grad_data, (w,), (v,))[1]
+        return hv if VMA_TRANSPOSE else lax.psum(hv, axis)
 
     def hvp(w, v, batch, l2=0.0):
         l2 = jnp.asarray(l2, w.dtype)
@@ -99,7 +105,7 @@ def distributed_diagonal_hessian(objective: GLMObjective, mesh: Mesh,
     over ``axis`` — one data pass; feeds TRON's Jacobi preconditioner."""
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(axis)),
         out_specs=P(),
     )
@@ -246,7 +252,7 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         dim = feats.dim
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
+            shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
             out_specs=P(axis),
         )
         def _build(indices, values):
@@ -278,7 +284,7 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     # body can't thread varying-axis types through pallas_call (reductions
     # here are explicit psums, so nothing relies on vma-driven transposes)
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(axis), P(axis)),
         out_specs=(P(), P()),
         check_vma=not use_pallas,
@@ -290,7 +296,7 @@ def make_csc_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         return lax.psum(f, axis), lax.psum(g, axis)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis)),
         out_specs=P(),
         check_vma=not use_pallas,
@@ -409,7 +415,7 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
     check_vma = transpose != "csc_pallas"
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis),
+        shard_map, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis),
     )
     def s_margin(v_eff, feats):
         return ell_margins(feats, v_eff)
@@ -426,7 +432,7 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         return f
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(), P()),
     )
@@ -440,7 +446,7 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         return lambda m, mp: s_loss_and_dir(m, mp, batch.labels, batch.weights)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(axis), P(axis)),
         out_specs=(P(), P()),
     )
@@ -469,7 +475,7 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
             m, mp, alpha, batch.labels, batch.weights)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(),
     )
@@ -481,7 +487,7 @@ def make_margin_path(objective: GLMObjective, mesh: Mesh, axis: str = "data",
         return lax.psum(g, axis)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(),
         check_vma=check_vma,

@@ -1,0 +1,195 @@
+"""Deterministic fault injection for the multi-controller runtime.
+
+Every failure path the resilience layer promises to handle must be
+EXERCISABLE in tier-1 tests — otherwise the coordinated-abort machinery is
+dead code until the first real pod outage. This module plants named
+injection sites in the hot paths (stream-source block decode, streamed
+pass boundaries, CD steps, multihost init) and lets a test arm a
+:class:`FaultPlan` that fires per-process, per-occurrence faults:
+
+* ``kind="raise"`` — a local exception (:class:`InjectedFault`) at the
+  site, exactly like a data/compute error in that process;
+* ``kind="device_loss"`` — an exception ``utils.is_device_loss``
+  recognizes, driving the drivers' resume-marker/exit-75 path without a
+  real TPU crash;
+* ``kind="truncate"`` — corrupt the bytes at a decode site
+  (:func:`mangle_payload`), driving the REAL truncated-block error path;
+* ``kind="drop"`` — simulated fail-stop-silent: raises
+  :class:`DroppedProcess` (a ``BaseException``), which the simulated
+  runner (``testing.run_simulated_processes``) treats as the process
+  going dark — it never reaches another health barrier, so peers must
+  surface :class:`~.resilience.WatchdogTimeout` within the watchdog.
+
+Determinism: faults address a (site, process, occurrence) triple.
+Occurrence counters are per-thread (each simulated process counts its own
+visits) and reset when a new plan is installed. Real multi-process runs
+can arm a plan through the ``PHOTON_ML_TPU_FAULTS`` env var (JSON list of
+fault dicts) so spawned worker processes inject without code changes.
+
+Zero overhead when disarmed: every site is a single truthiness check of a
+module global.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import List, Optional, Sequence
+
+__all__ = ["Fault", "InjectedFault", "DroppedProcess", "install", "clear",
+           "installed", "check", "mangle_payload", "process_context"]
+
+
+class InjectedFault(RuntimeError):
+    """The generic injected local failure."""
+
+
+class DroppedProcess(BaseException):
+    """Simulated silent process death (fail-stop without a report). A
+    ``BaseException`` so generic ``except Exception`` recovery — including
+    :class:`~.resilience.CollectiveGuard` — cannot convert it into a
+    reported failure: the whole point is that this process never reports."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One armed fault: fire at the ``at``-th visit (0-based, per process)
+    of ``site`` by process ``process`` (None = every process)."""
+
+    site: str
+    kind: str = "raise"  # raise | device_loss | truncate | drop
+    process: Optional[int] = None
+    at: int = 0
+    message: str = "injected fault"
+
+    def __post_init__(self):
+        if self.kind not in ("raise", "device_loss", "truncate", "drop"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+_lock = threading.Lock()
+_plan: List[Fault] = []
+_armed = False  # fast-path gate: sites check this single global
+_tls = threading.local()
+
+
+def _counters() -> dict:
+    c = getattr(_tls, "counters", None)
+    if c is None or getattr(_tls, "generation", -1) != _generation:
+        c = {}
+        _tls.counters = c
+        _tls.generation = _generation
+    return c
+
+
+_generation = 0
+
+
+def install(faults: Sequence[Fault]) -> None:
+    """Arm a plan (replacing any previous one; all occurrence counters
+    reset). Tests normally use this through a fixture/finally with
+    :func:`clear`."""
+    global _plan, _armed, _generation
+    with _lock:
+        _plan = [f if isinstance(f, Fault) else Fault(**f) for f in faults]
+        _generation += 1
+        _armed = bool(_plan)
+
+
+def clear() -> None:
+    install(())
+
+
+def installed() -> List[Fault]:
+    return list(_plan)
+
+
+def _env_plan_loaded() -> None:
+    """One-shot: arm from PHOTON_ML_TPU_FAULTS (JSON list of fault dicts)
+    so real spawned worker processes can inject."""
+    global _env_checked
+    if _env_checked:
+        return
+    _env_checked = True
+    raw = os.environ.get("PHOTON_ML_TPU_FAULTS")
+    if raw:
+        install([Fault(**d) for d in json.loads(raw)])
+
+
+_env_checked = False
+
+
+def process_context(index: int):
+    """Thread-local process-index override for fault matching — simulated
+    processes (threads) and worker threads acting on behalf of a process
+    (the stream source's producer) set this; real runs resolve the index
+    through the resilience transport."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        prev = getattr(_tls, "process_index", None)
+        _tls.process_index = index
+        try:
+            yield
+        finally:
+            _tls.process_index = prev
+
+    return cm()
+
+
+def _current_process() -> int:
+    idx = getattr(_tls, "process_index", None)
+    if idx is not None:
+        return idx
+    from photon_ml_tpu.parallel.resilience import current_process_index
+
+    try:
+        return current_process_index()
+    except Exception:
+        return 0
+
+
+def _match(site: str, kinds: Sequence[str]) -> Optional[Fault]:
+    n = _counters().setdefault(site, 0)
+    _counters()[site] = n + 1
+    proc = _current_process()
+    for f in _plan:
+        if (f.site == site and f.kind in kinds and f.at == n
+                and (f.process is None or f.process == proc)):
+            return f
+    return None
+
+
+def check(site: str) -> None:
+    """Injection point for control-flow faults. No-op unless a plan is
+    armed; otherwise fires any (site, process, occurrence)-matching fault."""
+    _env_plan_loaded()
+    if not _armed:
+        return
+    f = _match(site, ("raise", "device_loss", "drop"))
+    if f is None:
+        return
+    if f.kind == "drop":
+        raise DroppedProcess(f"{site}: {f.message}")
+    if f.kind == "device_loss":
+        import jax
+
+        raise jax.errors.JaxRuntimeError(
+            f"UNAVAILABLE: {f.message} (injected device loss at {site})")
+    raise InjectedFault(f"{site}: {f.message}")
+
+
+def mangle_payload(site: str, payload: bytes) -> bytes:
+    """Injection point for data-corruption faults: a matching
+    ``kind="truncate"`` fault halves the payload, driving the caller's
+    genuine truncated-read error path. Identity unless armed."""
+    _env_plan_loaded()
+    if not _armed:
+        return payload
+    f = _match(site, ("truncate",))
+    if f is None:
+        return payload
+    return payload[: len(payload) // 2]

@@ -25,23 +25,44 @@ def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    max_attempts: Optional[int] = None,
+    backoff_s: float = 1.0,
 ) -> bool:
     """Rendezvous this process into the global runtime. Returns True if
     distributed mode was initialized, False for the single-process no-op
     (no coordinator given and no TPU pod environment to infer one from).
 
+    Coordinator rendezvous is the flakiest moment of a pod job (the
+    coordinator may not be listening yet, a peer may be slow to restart
+    after preemption), so transient failures retry with exponential
+    backoff — bounded by ``max_attempts`` (default 3; env override
+    PHOTON_ML_TPU_INIT_ATTEMPTS) so a genuinely wrong address still fails
+    fast with the real error.
+
     Must run before the first use of the jax backend."""
+    import os
+
     import jax
+
+    from photon_ml_tpu.parallel import fault_injection, resilience
 
     if coordinator_address is None and num_processes is None:
         return False
     if (num_processes is None) != (process_id is None):
         raise ValueError("--num-processes and --process-id go together")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    if max_attempts is None:
+        max_attempts = int(os.environ.get("PHOTON_ML_TPU_INIT_ATTEMPTS", 3))
+
+    def _rendezvous():
+        fault_injection.check("multihost.init")
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    resilience.retry_transient(_rendezvous, attempts=max_attempts,
+                               backoff_s=backoff_s)
     return True
 
 

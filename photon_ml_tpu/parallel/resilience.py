@@ -1,0 +1,399 @@
+"""Coordinated failure propagation for the multi-controller runtime.
+
+The reference inherits fault tolerance from Spark (task retry, lineage
+recompute — SURVEY/PAPER.md §5.8); the JAX multi-controller runtime has
+none of that, and its failure mode is worse than a crash: a process that
+raises locally (a bad input block, an OOM, an assertion) simply stops
+calling collectives, and every OTHER process blocks inside its next
+``psum``/``allgather`` until the transport times out — minutes to forever,
+with no indication of which peer died or why. The distributed-training
+literature treats hierarchical execution as viable only with explicit
+failure handling at the communication boundary (Snap ML, arXiv:1803.06333;
+distributed CD, arXiv:1611.02101); this module is that boundary.
+
+Fault model: **fail-stop** — a process either follows the SPMD program or
+stops participating (crash, hang, injected fault). No Byzantine behavior:
+a live process's status report is trusted. Three mechanisms:
+
+1. **Health barrier** (:func:`health_barrier`): a cheap status-code
+   allgather run at phase boundaries (feature summarization, CD sweep
+   boundaries, streamed-pass boundaries). Every process reports OK or a
+   coarse failure class; any non-OK status converts into a
+   :class:`PeerFailure` raised on *every* process, so the job dies
+   together — loudly, promptly, resumably — instead of deadlocking.
+2. **Guarded phases** (:class:`CollectiveGuard` / :func:`guarded`): the
+   with-block form — a local exception inside the guard is reported
+   through the barrier (then re-raised wrapped, preserving the cause);
+   a peer's failure raises :class:`PeerFailure` before this process can
+   enter the next collective. The barrier itself runs under a watchdog:
+   a peer that stopped responding entirely (fail-stop without a report)
+   surfaces as :class:`WatchdogTimeout` within ``timeout`` seconds.
+3. **Bounded retry** (:func:`retry_transient`): coordinator/rendezvous
+   setup in ``initialize_multihost`` retries transient failures with
+   exponential backoff instead of failing a pod job on one slow peer.
+
+Single-process runs pay nothing: every barrier is a no-op passthrough and
+local exceptions propagate unchanged.
+
+The transport is pluggable (thread-local override) so the deterministic
+fault-injection harness (``parallel/fault_injection.py`` +
+``testing.run_simulated_processes``) can exercise every path above with
+simulated processes on one CPU host; production uses the jax
+multihost runtime transport.
+
+This module also hosts the unified :class:`ResumeManager` — the
+resume-marker lifecycle (atomic write, kept until success, fingerprinted
+against inputs) shared by the CLI drivers' device-loss recovery.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "PeerFailure", "WatchdogTimeout", "ResumeMismatch",
+    "health_barrier", "CollectiveGuard", "guarded", "retry_transient",
+    "ResumeManager", "current_transport", "use_transport",
+    "current_process_index", "default_timeout",
+    "CODE_OK", "CODE_ERROR", "CODE_DEVICE_LOSS", "CODE_DATA",
+]
+
+# -- status codes ----------------------------------------------------------
+# Coarse failure classes exchanged through the barrier (one int32 per
+# process). Classes, not messages: the payload must stay O(bytes) so the
+# barrier is cheap enough to run at every phase boundary; the failing
+# process logs its own full traceback locally.
+CODE_OK = 0
+CODE_ERROR = 1        # any local exception
+CODE_DEVICE_LOSS = 2  # accelerator backend died (utils.is_device_loss)
+CODE_DATA = 3         # data/input error (ValueError family)
+
+_CODE_NAMES = {CODE_OK: "ok", CODE_ERROR: "error",
+               CODE_DEVICE_LOSS: "device_loss", CODE_DATA: "data_error"}
+
+
+def code_for(exc: BaseException) -> int:
+    """Map a local exception onto its barrier status class."""
+    from photon_ml_tpu.utils import is_device_loss
+
+    if is_device_loss(exc):
+        return CODE_DEVICE_LOSS
+    if isinstance(exc, ValueError):
+        return CODE_DATA
+    return CODE_ERROR
+
+
+class PeerFailure(RuntimeError):
+    """Raised on EVERY process when any process of the multi-controller
+    job reports failure at a health barrier (or, for the reporting process
+    itself, wraps its local exception as ``__cause__``). ``failed`` maps
+    process index -> status code of each non-OK peer."""
+
+    def __init__(self, message: str, *, tag: str = "",
+                 failed: Optional[Dict[int, int]] = None):
+        super().__init__(message)
+        self.tag = tag
+        self.failed = dict(failed or {})
+
+    @property
+    def device_loss(self) -> bool:
+        """True when the coordinated abort was caused by an accelerator
+        loss somewhere in the job — every process should take the
+        resume-marker exit path, not just the one whose device died."""
+        return CODE_DEVICE_LOSS in self.failed.values()
+
+
+class WatchdogTimeout(PeerFailure):
+    """A health barrier did not complete within the watchdog timeout: some
+    peer stopped participating entirely (fail-stop without a report)."""
+
+
+class ResumeMismatch(ValueError):
+    """A resume marker's input fingerprint does not match the current run's
+    inputs; resuming would silently mix datasets/settings."""
+
+
+def default_timeout() -> float:
+    """Watchdog timeout (seconds) for health barriers; generous by default
+    (it only bounds how long peers wait on a DEAD process — live peers
+    answer in milliseconds). Override with PHOTON_ML_TPU_BARRIER_TIMEOUT_S."""
+    return float(os.environ.get("PHOTON_ML_TPU_BARRIER_TIMEOUT_S", 600.0))
+
+
+# -- transport -------------------------------------------------------------
+class JaxTransport:
+    """Production transport: the jax multi-controller runtime. The status
+    allgather runs in a worker thread so the caller can enforce the
+    watchdog timeout even when a dead peer would block the collective
+    forever (the thread is abandoned on timeout — under fail-stop the
+    whole process exits right after, which is the point)."""
+
+    def process_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def process_count(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    def allgather_status(self, code: int, timeout: float) -> List[int]:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        box: dict = {}
+
+        def run():
+            try:
+                got = multihost_utils.process_allgather(
+                    np.asarray([code], np.int32))
+                box["codes"] = [int(c) for c in np.asarray(got).reshape(-1)]
+            except BaseException as e:  # surfaced to the caller below
+                box["error"] = e
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="photon-health-barrier")
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            raise WatchdogTimeout(
+                f"health barrier timed out after {timeout:.0f}s: a peer "
+                "process stopped participating (fail-stop without a "
+                "report); aborting so this process does not hang in the "
+                "next collective")
+        if "error" in box:
+            raise box["error"]
+        return box["codes"]
+
+
+_default_transport = JaxTransport()
+_tls = threading.local()
+
+
+def current_transport():
+    return getattr(_tls, "transport", None) or _default_transport
+
+
+def current_process_index() -> int:
+    """Process index through the ambient transport WITHOUT forcing jax
+    backend initialization when no distributed runtime is configured."""
+    tp = getattr(_tls, "transport", None)
+    if tp is not None:
+        return tp.process_index()
+    import jax
+
+    return jax.process_index()
+
+
+@contextlib.contextmanager
+def use_transport(transport):
+    """Thread-locally override the transport (simulated processes install
+    their per-thread endpoint here; production never calls this)."""
+    prev = getattr(_tls, "transport", None)
+    _tls.transport = transport
+    try:
+        yield transport
+    finally:
+        _tls.transport = prev
+
+
+# -- health barrier / guarded phases ---------------------------------------
+def health_barrier(tag: str, failure: Optional[BaseException] = None,
+                   *, timeout: Optional[float] = None) -> None:
+    """Exchange health status with every peer before the next collective
+    phase. Raises :class:`PeerFailure` on every process when any process
+    reports non-OK (the local reporter gets its exception chained as
+    ``__cause__``); no-op passthrough in single-process mode (a local
+    ``failure`` is re-raised unchanged there)."""
+    tp = current_transport()
+    if tp.process_count() == 1:
+        if failure is not None:
+            raise failure
+        return
+    code = CODE_OK if failure is None else code_for(failure)
+    codes = tp.allgather_status(code, timeout or default_timeout())
+    failed = {i: c for i, c in enumerate(codes) if c != CODE_OK}
+    if not failed:
+        return
+    who = ", ".join(f"process {i} ({_CODE_NAMES.get(c, c)})"
+                    for i, c in sorted(failed.items()))
+    msg = (f"coordinated abort at '{tag}': {who} failed; every process "
+           "raises instead of deadlocking in the next collective")
+    if failure is not None:
+        raise PeerFailure(msg, tag=tag, failed=failed) from failure
+    raise PeerFailure(msg, tag=tag, failed=failed)
+
+
+class CollectiveGuard:
+    """Guard one phase that ends at a collective: convert any process's
+    local exception into a :class:`PeerFailure` on every process.
+
+    ::
+
+        with CollectiveGuard("stream.fg"):
+            ...local per-process work...
+        # all processes healthy here -> safe to enter the collective
+
+    Single-process: zero-cost passthrough (local exceptions propagate
+    unchanged). ``PeerFailure`` raised inside the block (a nested guard
+    already coordinated) passes through without a second barrier."""
+
+    def __init__(self, tag: str, *, timeout: Optional[float] = None):
+        self.tag = tag
+        self.timeout = timeout
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        from photon_ml_tpu.parallel.fault_injection import DroppedProcess
+
+        if exc is not None and isinstance(exc, (PeerFailure, DroppedProcess)):
+            return False  # already coordinated / simulated silent death
+        tp = current_transport()
+        if tp.process_count() == 1:
+            return False
+        health_barrier(self.tag, failure=exc, timeout=self.timeout)
+        return False
+
+
+def guarded(fn: Callable, tag: Optional[str] = None,
+            *, timeout: Optional[float] = None) -> Callable:
+    """Wrap ``fn`` so every call runs inside a :class:`CollectiveGuard`."""
+    import functools
+
+    label = tag or getattr(fn, "__name__", "guarded")
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with CollectiveGuard(label, timeout=timeout):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+# -- bounded retry ---------------------------------------------------------
+def retry_transient(fn: Callable, *, attempts: int = 3,
+                    backoff_s: float = 0.5, backoff_factor: float = 2.0,
+                    retriable=(RuntimeError, ConnectionError, OSError),
+                    on_retry: Optional[Callable] = None,
+                    sleep: Callable = time.sleep):
+    """Call ``fn`` with bounded retry-with-backoff on transient failures
+    (coordinator rendezvous races, slow peers). Non-``retriable``
+    exceptions propagate immediately; the last attempt's exception
+    propagates unchanged so callers see the real error."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    delay = backoff_s
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retriable as e:
+            if attempt + 1 >= attempts:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(delay)
+            delay *= backoff_factor
+
+
+# -- unified resume/checkpoint marker lifecycle ----------------------------
+class ResumeManager:
+    """One resume-marker contract for every driver (subsumes the GAME
+    driver's ``RESUME.json`` and the GLM driver's ``RESUME_GLM.npz``):
+
+    * **written atomically** — temp file + ``os.replace``, so a crash
+      mid-write can never leave a half-marker that hijacks a rerun;
+    * **kept until success** — the marker is consumed only when the
+      protected work COMPLETES (``consume()``), so a second failure of
+      any kind (OOM, SIGKILL, another device loss) does not silently
+      discard resume state;
+    * **fingerprinted against inputs** — ``save`` embeds the constructor's
+      fingerprint (e.g. training/validation paths + row counts) and
+      ``load`` refuses with :class:`ResumeMismatch` when the rerun's
+      inputs differ, so restored state never silently mixes datasets.
+
+    Codec by extension: ``.json`` for string payloads, ``.npz`` (numpy,
+    pickled payload dict) when the payload carries arrays. Multi-process:
+    construct with ``is_lead=False`` on non-lead processes — their
+    ``save``/``consume`` become no-ops (every process may ``load``)."""
+
+    _FP_KEY = "__fingerprint__"
+
+    def __init__(self, path: str, fingerprint: Optional[dict] = None,
+                 *, is_lead: bool = True):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.is_lead = bool(is_lead)
+        self._npz = path.endswith(".npz")
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def save(self, payload: dict) -> None:
+        if not self.is_lead:
+            return
+        record = dict(payload)
+        if self.fingerprint is not None:
+            record[self._FP_KEY] = self.fingerprint
+        tmp = f"{self.path}.tmp-{os.getpid()}"
+        if self._npz:
+            import numpy as np
+
+            np.savez(tmp, payload=record)
+            # np.savez appends .npz to names without it
+            tmp = tmp if tmp.endswith(".npz") else tmp + ".npz"
+        else:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+        os.replace(tmp, self.path)
+
+    def load(self, verify: bool = True) -> Optional[dict]:
+        """Marker payload, or None when absent. ``verify=False`` skips the
+        fingerprint check (callers that run their own ordering of
+        driver-specific checks first call :meth:`verify` afterwards)."""
+        if not self.exists():
+            return None
+        if self._npz:
+            import numpy as np
+
+            record = np.load(self.path,
+                             allow_pickle=True)["payload"].item()
+        else:
+            with open(self.path) as f:
+                record = json.load(f)
+        if verify:
+            self.verify(record)
+        return record
+
+    def verify(self, record: dict) -> None:
+        """Refuse resume when the marker was written against different
+        inputs. Markers from before fingerprinting (no embedded
+        fingerprint) are accepted."""
+        stored = record.get(self._FP_KEY)
+        if stored is None or self.fingerprint is None:
+            return
+        if stored != self.fingerprint:
+            diffs = sorted(set(stored) | set(self.fingerprint))
+            detail = "; ".join(
+                f"{k}: marker={stored.get(k)!r} run={self.fingerprint.get(k)!r}"
+                for k in diffs if stored.get(k) != self.fingerprint.get(k))
+            raise ResumeMismatch(
+                f"{os.path.basename(self.path)} was written for different "
+                f"inputs ({detail}); refusing to resume — restored state "
+                "would mix datasets. Rerun with the original inputs or "
+                f"delete the marker ({self.path})")
+
+    def consume(self) -> None:
+        """Remove the marker — call ONLY after the protected work
+        completed and its outputs are published."""
+        if not self.is_lead:
+            return
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(self.path)

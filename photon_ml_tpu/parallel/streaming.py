@@ -30,7 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu.compat import shard_map
 from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.parallel.resilience import CollectiveGuard
 from photon_ml_tpu.parallel.data_parallel import cached_jit
 from photon_ml_tpu.optimize.common import OptimizationResult, OptimizerConfig
 from photon_ml_tpu.optimize.lbfgs import two_loop_direction
@@ -222,7 +224,7 @@ def _shard_map_chunk(fn, mesh, axis, n_batch_args, acc_ndims):
                 + tuple(P(axis, *([None] * (nd - 1)))
                         for nd in acc_ndims))
     out_specs = tuple(P(axis, *([None] * (nd - 1))) for nd in acc_ndims)
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=False)
 
 
@@ -318,18 +320,23 @@ def streaming_value_and_grad(
                _sharded_zeros((S,), dtype, mesh, axis),
                _sharded_zeros((S, dim), dtype, mesh, axis),
                _sharded_zeros((S, dim), dtype, mesh, axis))
-        # one-chunk lookahead: transfer chunk i+1 while chunk i computes
-        pending = None
-        for chunk in chunks:
-            dev = _chunk_to_device(chunk, dim, dtype, sharding)
+        # the whole local pass runs under the health guard: a process that
+        # fails mid-stream (bad block, decode error, injected fault) is
+        # converted into PeerFailure on EVERY process at the pass boundary
+        # instead of wedging its peers inside _cross_process_sum
+        with CollectiveGuard("stream.fg"):
+            # one-chunk lookahead: transfer chunk i+1 while chunk i computes
+            pending = None
+            for chunk in chunks:
+                dev = _chunk_to_device(chunk, dim, dtype, sharding)
+                if pending is not None:
+                    acc = chunk_fg_k(w, *_batch_args(pending), *acc)
+                pending = dev
             if pending is not None:
                 acc = chunk_fg_k(w, *_batch_args(pending), *acc)
-            pending = dev
-        if pending is not None:
-            acc = chunk_fg_k(w, *_batch_args(pending), *acc)
-        # ONE cross-shard reduction per pass; its output is consumed by
-        # the host right away, so at most one collective is ever in flight
-        f_acc, g_acc = reduce_k(*acc)
+            # ONE cross-shard reduction per pass; its output is consumed by
+            # the host right away, so at most one collective is in flight
+            f_acc, g_acc = reduce_k(*acc)
         f_acc, g_acc = _cross_process_sum((f_acc, g_acc))
         wr = objective._reg_mask(w)
         l2 = jnp.asarray(l2, dtype)
@@ -378,10 +385,12 @@ def streaming_hvp(
         v = jnp.asarray(v, dtype)
         acc = _sharded_zeros((S, dim), dtype, mesh, axis)
         comp = _sharded_zeros((S, dim), dtype, mesh, axis)
-        for chunk in chunks:
-            dev = _chunk_to_device(chunk, dim, dtype, sharding)
-            acc, comp = chunk_hvp_k((w, v), *_batch_args(dev), acc, comp)
-        total = reduce_k(acc, comp)
+        with CollectiveGuard("stream.hvp"):  # see streaming_value_and_grad
+            for chunk in chunks:
+                dev = _chunk_to_device(chunk, dim, dtype, sharding)
+                acc, comp = chunk_hvp_k((w, v), *_batch_args(dev), acc,
+                                        comp)
+            total = reduce_k(acc, comp)
         total = _cross_process_sum(total)
         return total + jnp.asarray(l2, dtype) * objective._reg_mask(v)
 
@@ -444,10 +453,12 @@ def streaming_hessian_diagonal(
     w = jnp.asarray(w, dtype)
     acc = _sharded_zeros((S, dim), dtype, mesh, axis)
     comp = _sharded_zeros((S, dim), dtype, mesh, axis)
-    for chunk in chunks:
-        dev = _chunk_to_device(chunk, dim, dtype, sharding)
-        acc, comp = chunk_diag_k(w, *_batch_args(dev), acc, comp)
-    total = _cross_process_sum(reduce_k(acc, comp))
+    with CollectiveGuard("stream.diag"):  # see streaming_value_and_grad
+        for chunk in chunks:
+            dev = _chunk_to_device(chunk, dim, dtype, sharding)
+            acc, comp = chunk_diag_k(w, *_batch_args(dev), acc, comp)
+        total = reduce_k(acc, comp)
+    total = _cross_process_sum(total)
     reg = jnp.full((dim,), jnp.asarray(l2, dtype))
     if not objective.regularize_intercept and objective.intercept_index >= 0:
         reg = reg.at[objective.intercept_index].set(0.0)
@@ -752,19 +763,24 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         included), stored to host numpy in ``out``. One-chunk lookahead:
         chunk i+1's transfer+compute dispatch before chunk i's
         device->host fetch blocks, mirroring fg's overlap."""
-        pending = None
-        for i, chunk in enumerate(chunks):
-            if labels_h[i] is None:
-                labels_h[i] = chunk.labels
-                weights_h[i] = chunk.weights
-                offsets_h[i] = chunk.offsets
-            dev = _chunk_to_device(chunk, dim, dtype, sharding)
-            res = margin_k(vec, dev)
+        # guarded even though this pass itself has no collective: in SPMD
+        # lockstep the peers run this same pass, and a process failing
+        # here would otherwise strand them at the NEXT phase's barrier
+        # until the watchdog instead of aborting promptly
+        with CollectiveGuard("stream.margins"):
+            pending = None
+            for i, chunk in enumerate(chunks):
+                if labels_h[i] is None:
+                    labels_h[i] = chunk.labels
+                    weights_h[i] = chunk.weights
+                    offsets_h[i] = chunk.offsets
+                dev = _chunk_to_device(chunk, dim, dtype, sharding)
+                res = margin_k(vec, dev)
+                if pending is not None:
+                    out[pending[0]] = np.asarray(pending[1])
+                pending = (i, res)
             if pending is not None:
                 out[pending[0]] = np.asarray(pending[1])
-            pending = (i, res)
-        if pending is not None:
-            out[pending[0]] = np.asarray(pending[1])
         return out
 
     def phi_delta_ladder(mw_h, mp_h, alphas):
@@ -776,12 +792,14 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         f_acc = _sharded_zeros((S, L), dtype, mesh, axis)
         f_comp = _sharded_zeros((S, L), dtype, mesh, axis)
         a = jnp.asarray(alphas, dtype)
-        for i in range(n_chunks):
-            f_acc, f_comp = trial_k(
-                a, _put(mw_h[i]), _put(mp_h[i]),
-                _put(labels_h[i]), _put(weights_h[i]),
-                f_acc, f_comp)
-        (d,) = _cross_process_sum((trial_reduce_k(f_acc, f_comp),))
+        with CollectiveGuard("stream.ladder"):  # see streaming_value_and_grad
+            for i in range(n_chunks):
+                f_acc, f_comp = trial_k(
+                    a, _put(mw_h[i]), _put(mp_h[i]),
+                    _put(labels_h[i]), _put(weights_h[i]),
+                    f_acc, f_comp)
+            total = trial_reduce_k(f_acc, f_comp)
+        (d,) = _cross_process_sum((total,))
         return np.asarray(d, np.float64)
 
     direction, store_pair = _lbfgs_stream_kernels(objective, mesh, axis, m)

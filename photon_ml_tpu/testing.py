@@ -13,7 +13,9 @@ writers for driver-level integration tests.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -161,3 +163,110 @@ def write_game_avro_fixture(
         entity_ids={c: v[rows] for c, v in data.entity_ids.items()},
         uids=[str(i) for i in rows],
     )
+
+
+# -- simulated multi-controller runtime ------------------------------------
+# The moral equivalent of local-mode Spark for FAILURE paths: N "processes"
+# are N threads sharing one interpreter, each with its own resilience
+# transport endpoint, so every coordinated-abort path (health barriers,
+# guards, watchdog) runs the production code against deterministic injected
+# faults (parallel/fault_injection.py) without real OS processes or a real
+# coordinator. jax itself stays single-process (collectives reduce over the
+# virtual CPU device mesh), which is exactly what makes the harness cheap
+# enough for tier-1.
+
+class Dropped:
+    """Outcome sentinel: the simulated process died silently (fail-stop
+    without a report — fault kind 'drop') or never finished in time."""
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return "<Dropped>"
+
+
+class _SimGroup:
+    """Shared N-way status-exchange rendezvous (generation-counted so
+    consecutive barriers don't mix). A participant that never arrives
+    starves the round; waiters raise WatchdogTimeout — the simulated
+    equivalent of a dead peer wedging a real allgather."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.cond = threading.Condition()
+        self.gen = 0
+        self.slots: Dict[int, Dict[int, int]] = {}
+        self.results: Dict[int, List[int]] = {}
+
+    def exchange(self, rank: int, code: int, timeout: float) -> List[int]:
+        from photon_ml_tpu.parallel.resilience import WatchdogTimeout
+
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            gen = self.gen
+            slot = self.slots.setdefault(gen, {})
+            slot[rank] = code
+            if len(slot) == self.n:
+                self.results[gen] = [slot[i] for i in range(self.n)]
+                self.gen += 1
+                self.cond.notify_all()
+                return list(self.results[gen])
+            while gen not in self.results:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    missing = sorted(set(range(self.n)) - set(slot))
+                    raise WatchdogTimeout(
+                        f"simulated health barrier timed out after "
+                        f"{timeout:.1f}s: processes {missing} never "
+                        "reported (fail-stop)")
+                self.cond.wait(remaining)
+            return list(self.results[gen])
+
+
+class ThreadTransport:
+    """One simulated process's endpoint onto a :class:`_SimGroup`."""
+
+    def __init__(self, group: _SimGroup, rank: int):
+        self._group = group
+        self._rank = rank
+
+    def process_index(self) -> int:
+        return self._rank
+
+    def process_count(self) -> int:
+        return self._group.n
+
+    def allgather_status(self, code: int, timeout: float) -> List[int]:
+        return self._group.exchange(self._rank, code, timeout)
+
+
+def run_simulated_processes(n: int, fn: Callable, *,
+                            join_timeout: float = 120.0) -> list:
+    """Run ``fn(process_index)`` on ``n`` simulated processes (threads,
+    each under its own resilience transport + fault-injection process
+    context) and return the per-process OUTCOMES: the return value,
+    the raised exception object, or :class:`Dropped` for a process that
+    died silently / never finished. Exceptions are captured, not raised —
+    fault tests assert on the whole outcome vector."""
+    from photon_ml_tpu.parallel import fault_injection, resilience
+
+    group = _SimGroup(n)
+    outcomes: list = [Dropped() for _ in range(n)]
+
+    def run(rank: int):
+        transport = ThreadTransport(group, rank)
+        try:
+            with resilience.use_transport(transport), \
+                    fault_injection.process_context(rank):
+                outcomes[rank] = fn(rank)
+        except fault_injection.DroppedProcess:
+            pass  # stays Dropped: this process reports nothing to anyone
+        except BaseException as e:
+            outcomes[rank] = e
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True,
+                                name=f"sim-process-{i}") for i in range(n)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + join_timeout
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()))
+    return outcomes

@@ -42,8 +42,17 @@ def is_device_loss(exc: BaseException) -> bool:
     (TPU worker crash / tunnel loss). The dead backend cannot be
     reinitialized in-process (measured, docs/RUNBOOK.md §5), so every
     driver converts this into an exit-75 process-boundary retry. One
-    predicate, shared by all drivers — refine detection here only."""
+    predicate, shared by all drivers — refine detection here only.
+
+    A coordinated abort (``resilience.PeerFailure``) counts when ANY
+    process of the job reported device loss: every process must take the
+    resume-marker exit path together, not only the one whose device died."""
     import jax
 
+    from photon_ml_tpu.parallel.resilience import PeerFailure
+
+    if isinstance(exc, PeerFailure):
+        return exc.device_loss or (exc.__cause__ is not None
+                                   and is_device_loss(exc.__cause__))
     return (isinstance(exc, jax.errors.JaxRuntimeError)
             and "UNAVAILABLE" in str(exc))

@@ -198,6 +198,28 @@ def run_game_ooc_step(data_dir):
     return {"w_fixed": w.tolist(), "data_path": path}
 
 
+def run_resilience_barrier():
+    """Real-runtime leg of the coordinated-abort contract: a healthy
+    health barrier across two OS processes, then a guarded phase where
+    process 1 raises locally — BOTH processes must raise PeerFailure
+    (process 0 having learned of it only through the status allgather)."""
+    import jax
+
+    from photon_ml_tpu.parallel import resilience
+
+    resilience.health_barrier("mp-healthy", timeout=120)
+    try:
+        with resilience.CollectiveGuard("mp-abort", timeout=120):
+            if jax.process_index() == 1:
+                raise ValueError("injected local failure on process 1")
+    except resilience.PeerFailure as e:
+        return {"peer_failure": True,
+                "failed_ranks": sorted(e.failed),
+                "codes": sorted(e.failed.values()),
+                "device_loss": e.device_loss}
+    return {"peer_failure": False}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True)
@@ -210,6 +232,13 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+    try:
+        # legacy jax: CPU cross-process collectives need gloo selected
+        # explicitly or every multiprocess computation fails to compile;
+        # newer jax auto-selects and has dropped the config knob
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=args.coordinator,
         num_processes=args.num_processes,
@@ -219,6 +248,7 @@ def main():
 
     results = {
         "process_count": jax.process_count(),
+        "resilience": run_resilience_barrier(),
         "fit_distributed": run_fit_distributed(),
         "game_streaming": run_game_streaming_step(),
         "ooc_streaming": run_ooc_streamed_fit(os.path.dirname(args.out)),

@@ -54,6 +54,17 @@ def test_two_processes_rendezvous(two_process_results):
     assert two_process_results["process_count"] == 2
 
 
+def test_coordinated_abort_across_real_processes(two_process_results):
+    """A local exception on process 1 inside a CollectiveGuard becomes a
+    PeerFailure on BOTH processes (the healthy process learns through the
+    status allgather, not a hang) — the real-runtime leg of the
+    fault-injection suite's simulated coordinated-abort tests."""
+    got = two_process_results["resilience"]
+    assert got["peer_failure"]
+    assert got["failed_ranks"] == [1]
+    assert not got["device_loss"]
+
+
 def test_fit_distributed_across_processes(two_process_results):
     """2-process psum fit == single-process fit on the same data."""
     import jax.numpy as jnp

@@ -1,0 +1,377 @@
+"""Coordinated failure propagation + deterministic fault injection.
+
+Contract under test (docs/resilience.md): with an injected per-process
+failure in a simulated multi-controller run, EVERY process raises
+PeerFailure within the watchdog timeout — no process hangs in a
+collective. The simulated runtime (testing.run_simulated_processes) runs
+the production barrier/guard code under per-thread transports; jax itself
+stays single-process, which is what keeps this tier-1-cheap.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from photon_ml_tpu.parallel import fault_injection as fi
+from photon_ml_tpu.parallel import resilience
+from photon_ml_tpu.parallel.resilience import (
+    CollectiveGuard,
+    PeerFailure,
+    ResumeManager,
+    ResumeMismatch,
+    WatchdogTimeout,
+    retry_transient,
+)
+from photon_ml_tpu.testing import Dropped, run_simulated_processes
+from photon_ml_tpu.utils import is_device_loss
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# -- single-process passthrough --------------------------------------------
+def test_single_process_guard_is_passthrough():
+    with CollectiveGuard("noop"):
+        pass
+    with pytest.raises(KeyError):  # local exception type is preserved
+        with CollectiveGuard("noop"):
+            raise KeyError("local")
+    resilience.health_barrier("noop")  # no-op, no collective
+
+
+def test_health_barrier_single_process_reraises_local():
+    err = ValueError("boom")
+    with pytest.raises(ValueError) as ei:
+        resilience.health_barrier("t", failure=err)
+    assert ei.value is err
+
+
+# -- simulated multi-process coordinated abort -----------------------------
+def _phased(n_phases=3, site="work.step"):
+    def work(rank):
+        for phase in range(n_phases):
+            with CollectiveGuard(f"phase{phase}", timeout=10):
+                fi.check(site)
+        return "ok"
+
+    return work
+
+
+def test_all_processes_raise_peer_failure_on_one_local_raise():
+    fi.install([fi.Fault(site="work.step", process=2, at=1)])
+    t0 = time.monotonic()
+    out = run_simulated_processes(4, _phased())
+    assert time.monotonic() - t0 < 10  # nobody waited out a watchdog
+    assert all(isinstance(o, PeerFailure) for o in out)
+    # the failing process keeps its local exception as the cause
+    assert isinstance(out[2].__cause__, fi.InjectedFault)
+    # peers learn WHO failed and HOW
+    assert out[0].failed == {2: resilience.CODE_ERROR}
+    assert not out[0].device_loss
+
+
+def test_dropped_process_surfaces_as_watchdog_timeout():
+    """A process that goes silent (fail-stop without a report) cannot hang
+    its peers: they raise WatchdogTimeout (a PeerFailure) at the barrier."""
+    fi.install([fi.Fault(site="work.step", process=1, at=1, kind="drop")])
+    t0 = time.monotonic()
+    out = run_simulated_processes(3, _phased(), join_timeout=30)
+    elapsed = time.monotonic() - t0
+    assert isinstance(out[1], Dropped)
+    for rank in (0, 2):
+        assert isinstance(out[rank], WatchdogTimeout)
+        assert isinstance(out[rank], PeerFailure)
+    assert elapsed < 30  # bounded by the barrier timeout, not the join
+
+
+def test_injected_device_loss_propagates_class_to_every_process():
+    """A device loss on ONE process must drive the resume path on ALL of
+    them: PeerFailure carries the device-loss class and is_device_loss
+    recognizes it."""
+    fi.install([fi.Fault(site="work.step", process=0, at=0,
+                         kind="device_loss")])
+    out = run_simulated_processes(3, _phased())
+    assert all(isinstance(o, PeerFailure) for o in out)
+    assert all(is_device_loss(o) for o in out)
+    assert out[1].failed == {0: resilience.CODE_DEVICE_LOSS}
+
+
+def test_healthy_simulated_run_returns_results():
+    out = run_simulated_processes(4, _phased())
+    assert out == ["ok"] * 4
+
+
+def test_value_error_maps_to_data_code():
+    def work(rank):
+        with CollectiveGuard("p", timeout=10):
+            if rank == 1:
+                raise ValueError("bad input block")
+        return "ok"
+
+    out = run_simulated_processes(2, work)
+    assert out[0].failed == {1: resilience.CODE_DATA}
+    assert isinstance(out[1].__cause__, ValueError)
+
+
+# -- streamed fit under injected faults ------------------------------------
+def _tiny_chunks(seed=0):
+    from photon_ml_tpu.parallel.streaming import make_host_chunks
+    from photon_ml_tpu.testing import synthetic_glm_data
+
+    data = synthetic_glm_data(n=96, d=5, seed=seed)
+    return make_host_chunks(data.X, data.y, chunk_rows=32)
+
+
+def test_streamed_fit_coordinated_abort_on_chunk_fault():
+    """fit_streaming under the simulated runtime: a raise-at-chunk-N fault
+    in ONE process aborts every process at the pass boundary (the guard
+    before _cross_process_sum), none hang."""
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.streaming import fit_streaming
+
+    chunks, dim = _tiny_chunks()
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=3, tolerance=0.0)
+
+    class FaultyChunks:
+        """Chunk list with a consumer-side injection point, mirroring
+        AvroChunkSource's 'stream.chunk' site for in-RAM chunks."""
+
+        def __len__(self):
+            return len(chunks)
+
+        def __iter__(self):
+            for c in chunks:
+                fi.check("stream.chunk")
+                yield c
+
+    def work(rank):
+        r = fit_streaming(obj, FaultyChunks(), dim, l2=0.5, config=cfg)
+        return float(r.value)
+
+    # healthy: identical results on every "process"
+    out = run_simulated_processes(3, work)
+    assert all(isinstance(v, float) for v in out)
+    assert len(set(out)) == 1
+
+    fi.install([fi.Fault(site="stream.chunk", process=1, at=2)])
+    t0 = time.monotonic()
+    out = run_simulated_processes(3, work, join_timeout=60)
+    assert time.monotonic() - t0 < 60
+    assert all(isinstance(o, PeerFailure) for o in out)
+    assert isinstance(out[1].__cause__, fi.InjectedFault)
+
+
+def test_stream_source_chunk_fault_fires_in_consumer(tmp_path):
+    """The real AvroChunkSource honors per-process raise-at-chunk-N plans."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(64, 4))
+    rows = [[(f"f{j}", "", float(v)) for j, v in enumerate(r)] for r in X]
+    path = str(tmp_path / "t.avro")
+    write_training_examples(path, rows, rng.integers(0, 2, 64).astype(float),
+                            block_size=512)
+    imap = IndexMap({f"f{j}": j for j in range(4)}, add_intercept=False)
+    src = AvroChunkSource(path, imap, chunk_rows=16)
+    assert len(list(src)) == len(src)  # healthy pass
+
+    fi.install([fi.Fault(site="stream.chunk", at=1)])
+    with pytest.raises(fi.InjectedFault):
+        list(src)
+
+
+def test_stream_source_truncated_decode_fault(tmp_path):
+    """kind='truncate' corrupts the block payload read, driving the REAL
+    truncated-block error path of both decode backends."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(48, 4))
+    rows = [[(f"f{j}", "", float(v)) for j, v in enumerate(r)] for r in X]
+    path = str(tmp_path / "t.avro")
+    write_training_examples(path, rows, rng.integers(0, 2, 48).astype(float),
+                            block_size=256)
+    imap = IndexMap({f"f{j}": j for j in range(4)}, add_intercept=False)
+    src = AvroChunkSource(path, imap, chunk_rows=16)
+
+    fi.install([fi.Fault(site="stream.block_payload", at=0,
+                         kind="truncate")])
+    with pytest.raises(ValueError, match="truncated block"):
+        list(src)
+
+
+def test_stream_source_empty_part_raises_on_every_process(tmp_path):
+    """Satellite: the starved-part error is detected from the globally
+    known part_spans on EVERY process — coordinated abort by determinism,
+    no process proceeds into a collective that would hang."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(40, 4))
+    rows = [[(f"f{j}", "", float(v)) for j, v in enumerate(r)] for r in X]
+    path = str(tmp_path / "t.avro")
+    # ONE container block, 4 parts -> 3 starved parts
+    write_training_examples(path, rows, rng.integers(0, 2, 40).astype(float),
+                            block_size=1 << 20)
+    imap = IndexMap({f"f{j}": j for j in range(4)}, add_intercept=False)
+
+    def work(rank):
+        AvroChunkSource(path, imap, chunk_rows=16,
+                        process_part=(rank, 4))
+        return "built"
+
+    t0 = time.monotonic()
+    out = run_simulated_processes(4, work, join_timeout=30)
+    assert time.monotonic() - t0 < 30
+    # every process raises — including process 0, which OWNS the one block
+    assert all(isinstance(o, ValueError) for o in out)
+    assert all("owns no container blocks" in str(o) for o in out)
+
+
+# -- initialize_multihost retry --------------------------------------------
+def test_retry_transient_bounded_backoff():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient rendezvous")
+        return "up"
+
+    assert retry_transient(flaky, attempts=3, backoff_s=0.5,
+                           backoff_factor=2.0,
+                           sleep=sleeps.append) == "up"
+    assert calls["n"] == 3
+    assert sleeps == [0.5, 1.0]
+
+    calls["n"] = 0
+    with pytest.raises(RuntimeError, match="transient"):
+        retry_transient(flaky, attempts=2, backoff_s=0.0,
+                        sleep=lambda s: None)
+    with pytest.raises(KeyError):  # non-retriable propagates immediately
+        retry_transient(lambda: (_ for _ in ()).throw(KeyError("x")),
+                        attempts=5, sleep=lambda s: None)
+
+
+def test_initialize_multihost_retries_transient_rendezvous(monkeypatch):
+    from photon_ml_tpu.parallel import multihost
+
+    attempts = []
+
+    def fake_init(coordinator_address, num_processes, process_id):
+        attempts.append(coordinator_address)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    # two transient injected failures, then the real call proceeds
+    fi.install([
+        fi.Fault(site="multihost.init", at=0, message="coordinator not up"),
+        fi.Fault(site="multihost.init", at=1, message="coordinator not up"),
+    ])
+    assert multihost.initialize_multihost("127.0.0.1:1", 1, 0,
+                                          backoff_s=0.0) is True
+    assert attempts == ["127.0.0.1:1"]
+
+    # exhausted attempts surface the real error
+    fi.install([fi.Fault(site="multihost.init", at=i) for i in range(5)])
+    with pytest.raises(fi.InjectedFault):
+        multihost.initialize_multihost("127.0.0.1:1", 1, 0, max_attempts=2,
+                                       backoff_s=0.0)
+
+
+# -- ResumeManager ---------------------------------------------------------
+def test_resume_manager_json_lifecycle(tmp_path):
+    path = str(tmp_path / "RESUME.json")
+    fp = {"train": ["a.avro"], "rows": 100}
+    rm = ResumeManager(path, fingerprint=fp)
+    assert not rm.exists() and rm.load() is None
+    rm.save({"checkpoint": "ckpt-3"})
+    assert rm.exists()
+    # no half-written temp files left behind (atomic replace)
+    assert os.listdir(tmp_path) == ["RESUME.json"]
+    assert ResumeManager(path, fingerprint=fp).load()["checkpoint"] == "ckpt-3"
+    rm.consume()
+    assert not rm.exists()
+    rm.consume()  # idempotent
+
+
+def test_resume_manager_refuses_fingerprint_mismatch(tmp_path):
+    path = str(tmp_path / "RESUME.json")
+    ResumeManager(path, fingerprint={"val": "a.avro", "rows": 10}).save(
+        {"checkpoint": "c"})
+    with pytest.raises(ResumeMismatch, match="rows"):
+        ResumeManager(path, fingerprint={"val": "a.avro", "rows": 11}).load()
+    # markers predating fingerprinting are accepted
+    ResumeManager(path).save({"checkpoint": "c"})
+    assert ResumeManager(path, fingerprint={"rows": 1}).load() is not None
+
+
+def test_resume_manager_npz_roundtrip_with_arrays(tmp_path):
+    path = str(tmp_path / "RESUME_GLM.npz")
+    rm = ResumeManager(path, fingerprint={"rows": 7})
+    w = np.arange(5.0)
+    rm.save({"entries": [{"lam": 0.5, "w": w}], "last_w": w})
+    back = ResumeManager(path, fingerprint={"rows": 7}).load()
+    np.testing.assert_array_equal(back["last_w"], w)
+    assert back["entries"][0]["lam"] == 0.5
+    with pytest.raises(ResumeMismatch):
+        ResumeManager(path, fingerprint={"rows": 8}).load()
+
+
+def test_resume_manager_non_lead_never_writes(tmp_path):
+    path = str(tmp_path / "RESUME.json")
+    rm = ResumeManager(path, is_lead=False)
+    rm.save({"checkpoint": "c"})
+    assert not rm.exists()
+    ResumeManager(path).save({"checkpoint": "c"})
+    rm.consume()
+    assert os.path.exists(path)  # non-lead consume is a no-op too
+
+
+# -- E == 0 random-effect bucket (satellite) -------------------------------
+def test_train_random_effect_handles_empty_bucket():
+    """A bucket with zero entities must contribute an empty [0, D]
+    coefficient array, not crash on range(step=0)/W_parts[0]."""
+    import dataclasses
+
+    from photon_ml_tpu.game.data import build_random_effect_data
+    from photon_ml_tpu.game.random_effect import train_random_effect
+
+    rng = np.random.default_rng(0)
+    n, d = 60, 3
+    X = rng.normal(size=(n, d))
+    y = rng.integers(0, 2, n).astype(float)
+    ids = rng.integers(0, 5, n)
+    data = build_random_effect_data(X, y, np.ones(n), ids,
+                                    effect_name="re", num_buckets=2)
+    # degenerate shape: a bucket stripped to zero entities
+    b = data.buckets[-1]
+    empty = dataclasses.replace(
+        b, entity_ids=np.asarray(b.entity_ids)[:0], indices=b.indices[:0],
+        values=b.values[:0], labels=b.labels[:0], weights=b.weights[:0],
+        sample_idx=b.sample_idx[:0], projection=b.projection[:0],
+        local_maps=[])
+    data = dataclasses.replace(data,
+                               buckets=list(data.buckets) + [empty])
+
+    fit = train_random_effect(data, np.zeros(n), task="logistic", l2=1.0)
+    assert fit.coefficients[-1].shape == (0, empty.local_dim)
+    # the real buckets still trained
+    assert sum(c.shape[0] for c in fit.coefficients) == 5

@@ -1,0 +1,180 @@
+"""Kill-and-rerun driver tests through the fault-injection harness.
+
+Acceptance contract (ISSUE 1): for EACH CLI driver, an interrupted run
+resumes from its marker, the marker survives a SECOND failure of any kind
+(deferred consume — it is removed only when the run completes), and a
+rerun with mismatched validation inputs refuses resume with a clear
+error (the input fingerprint embedded in the marker). The interruptions
+here are injected device losses (``fault_injection`` kind="device_loss")
+— no monkeypatching of fit internals.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.game_training_driver import main as train_main
+from photon_ml_tpu.cli.glm_driver import main as glm_main
+from photon_ml_tpu.parallel import fault_injection as fi
+from photon_ml_tpu.parallel.resilience import ResumeMismatch
+from photon_ml_tpu.testing import synthetic_game_data, write_game_avro_fixture
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def _write_libsvm(path, X, y):
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            toks = [f"{int(y[i]) * 2 - 1}"]
+            for j in np.nonzero(X[i])[0]:
+                toks.append(f"{j + 1}:{X[i, j]:.6f}")
+            f.write(" ".join(toks) + "\n")
+
+
+def _events(out):
+    return [json.loads(l)["event"]
+            for l in (out / "photon.log.jsonl").read_text().splitlines()]
+
+
+# -- GLM driver ------------------------------------------------------------
+@pytest.fixture
+def glm_case(tmp_path, rng):
+    n, d = 260, 8
+    X = (rng.random((n, d)) < 0.5) * rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
+    _write_libsvm(tmp_path / "train.svm", X[:180], y[:180])
+    _write_libsvm(tmp_path / "val.svm", X[180:], y[180:])
+    return tmp_path, X, y
+
+
+def test_glm_kill_rerun_and_validation_fingerprint(glm_case):
+    """Injected device loss mid-grid -> exit 75 + marker; a rerun against
+    REWRITTEN validation data (same path, different rows) refuses resume;
+    the original rerun resumes and consumes the marker."""
+    tmp_path, X, y = glm_case
+    out = tmp_path / "out"
+    argv = [
+        "--train-data", str(tmp_path / "train.svm"),
+        "--validation-data", str(tmp_path / "val.svm"),
+        "--input-format", "libsvm",
+        "--reg-weights", "10.0", "1.0",
+        "--max-iters", "40", "--dtype", "float64",
+        "--output-dir", str(out),
+    ]
+    # die entering the SECOND lambda: lambda #1's result is resume state
+    fi.install([fi.Fault(site="glm.lambda", at=1, kind="device_loss")])
+    assert glm_main(argv) == 75
+    marker = out / "RESUME_GLM.npz"
+    assert marker.exists()
+    assert "device_lost" in _events(out)
+    fi.clear()
+
+    # mismatched validation inputs: same path, different row count ->
+    # restored per-lambda metrics would mix datasets; refused loudly
+    _write_libsvm(tmp_path / "val.svm", X[170:], y[170:])
+    with pytest.raises(ResumeMismatch, match="validation_rows"):
+        glm_main(argv + ["--auto-resume"])
+    assert marker.exists()  # refusal must not consume the marker
+
+    # original inputs: resumes the grid and consumes the marker
+    _write_libsvm(tmp_path / "val.svm", X[180:], y[180:])
+    assert glm_main(argv + ["--auto-resume"]) == 0
+    assert not marker.exists()
+    assert (out / "best" / "metadata.json").exists()
+
+
+# -- GAME driver -----------------------------------------------------------
+@pytest.fixture
+def game_case(tmp_path):
+    data = synthetic_game_data({"userId": 8}, seed=4)
+    train = str(tmp_path / "train.avro")
+    val = str(tmp_path / "val.avro")
+    n = len(data.labels)
+    write_game_avro_fixture(train, data, rows=np.arange(0, n - 40))
+    write_game_avro_fixture(val, data, rows=np.arange(n - 40, n))
+    coords = json.dumps([
+        {"name": "fixed", "coordinate_type": "fixed",
+         "feature_shard": "global", "reg_type": "l2", "reg_weight": 0.5,
+         "max_iters": 25},
+        {"name": "per-user", "coordinate_type": "random",
+         "feature_shard": "entity", "entity_column": "userId",
+         "reg_type": "l2", "reg_weight": 1.0, "max_iters": 15},
+    ])
+    shards = json.dumps({"global": ["g"], "entity": ["u"]})
+    return tmp_path, train, val, coords, shards
+
+
+def test_game_kill_rerun_marker_survives_second_failure(game_case):
+    """Injected device loss after the first outer iteration's checkpoint
+    -> exit 75 + marker. A resumed run that dies from a NON-device-loss
+    failure keeps the marker (deferred consume); the clean rerun resumes
+    from the checkpoint and consumes it."""
+    tmp_path, train, val, coords, shards = game_case
+    out = tmp_path / "out"
+    argv = [
+        "--train-data", train, "--validation-data", val,
+        "--output-dir", str(out), "--task", "logistic_regression",
+        "--coordinates", coords, "--feature-shards", shards,
+        "--n-iterations", "2", "--checkpoint", "--dtype", "float64",
+    ]
+    # cd.step fires once per (iteration, coordinate); at=2 dies on the
+    # second outer iteration, AFTER iter-0's checkpoint was written
+    fi.install([fi.Fault(site="cd.step", at=2, kind="device_loss")])
+    assert train_main(argv) == 75
+    marker = out / "RESUME.json"
+    assert marker.exists()
+    ckpt = json.loads(marker.read_text())["checkpoint"]
+    assert ckpt and "iter-0" in ckpt
+    assert not (out / "best" / "metadata.json").exists()
+
+    # second failure of a DIFFERENT kind (plain raise, not device loss):
+    # the resume state must survive it — this is the regression the old
+    # consume-at-startup semantics had (ADVICE.md)
+    fi.install([fi.Fault(site="cd.step", at=0, kind="raise")])
+    with pytest.raises(fi.InjectedFault):
+        train_main(argv + ["--auto-resume"])
+    assert marker.exists()
+
+    fi.clear()
+    assert train_main(argv + ["--auto-resume"]) == 0
+    assert not marker.exists()  # consumed only on completion
+    assert (out / "best" / "metadata.json").exists()
+    events = _events(out)
+    assert "device_lost" in events and "auto_resume" in events
+
+
+def test_game_resume_refuses_mismatched_validation(game_case):
+    """A rerun pointed at different --validation-data must refuse resume
+    with a clear error instead of warm-starting against mixed inputs."""
+    tmp_path, train, val, coords, shards = game_case
+    out = tmp_path / "out2"
+    argv = [
+        "--train-data", train, "--validation-data", val,
+        "--output-dir", str(out), "--task", "logistic_regression",
+        "--coordinates", coords, "--feature-shards", shards,
+        "--n-iterations", "2", "--checkpoint", "--dtype", "float64",
+    ]
+    fi.install([fi.Fault(site="cd.step", at=2, kind="device_loss")])
+    assert train_main(argv) == 75
+    fi.clear()
+
+    other_val = str(tmp_path / "val_b.avro")
+    data = synthetic_game_data({"userId": 8}, seed=4)
+    write_game_avro_fixture(other_val, data,
+                            rows=np.arange(len(data.labels) - 30,
+                                           len(data.labels)))
+    argv_b = list(argv)
+    argv_b[argv_b.index(val)] = other_val
+    with pytest.raises(ResumeMismatch, match="refusing to resume"):
+        train_main(argv_b + ["--auto-resume"])
+    assert (out / "RESUME.json").exists()
+
+    assert train_main(argv + ["--auto-resume"]) == 0
+    assert not (out / "RESUME.json").exists()
