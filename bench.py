@@ -316,6 +316,124 @@ def main() -> None:
     }))
 
 
+def serving_main() -> None:
+    """``python bench.py serving`` — online-scoring latency/throughput.
+
+    Measures the resident serving stack (ScoringSession + MicroBatcher +
+    ScoringService, in-process — no sockets, so the numbers are the
+    scoring stack's, not the kernel's TCP stack) on CPU against a
+    synthetic GAME model: p50/p99 request latency and row throughput at
+    each batch size, after warmup (the shape ladder is pre-compiled, so
+    nothing here times XLA). Writes ``BENCH_serving.json`` next to this
+    file and prints the same JSON line."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    import jax
+
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
+    import numpy as np
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        make_game_dataset,
+    )
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    rng = np.random.default_rng(0)
+    n, d_fix, d_re, n_entities = 600, 32, 8, 64
+    Xg = rng.normal(size=(n, d_fix))
+    Xu = rng.normal(size=(n, d_re))
+    uid = rng.integers(0, n_entities, n)
+    y = (rng.random(n) < 0.5).astype(float)
+    ds = make_game_dataset({"g": Xg, "u": Xu}, y,
+                           entity_ids={"userId": uid})
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                          reg_weight=1.0),
+         CoordinateConfig("per-user", coordinate_type="random",
+                          feature_shard="u", entity_column="userId",
+                          reg_type="l2", reg_weight=1.0)],
+        task="logistic")
+    model, _ = cd.run(ds)
+    model_dir = os.path.join(tempfile.mkdtemp(prefix="bench-serving-"),
+                             "model")
+    save_game_model(model, model_dir, {
+        "g": IndexMap({f"g{j}": j for j in range(d_fix)}),
+        "u": IndexMap({f"u{j}": j for j in range(d_re)}),
+    })
+
+    max_batch = 64
+    session = ScoringSession(model_dir, max_batch=max_batch,
+                             coeff_cache_entries=n_entities)
+    batcher = MicroBatcher(session.score_rows, max_batch=max_batch,
+                           max_delay_ms=0.5, max_queue=512,
+                           metrics=session.metrics)
+    service = ScoringService(session, batcher)
+
+    def make_row(i):
+        return {
+            "features": (
+                [{"name": f"g{j}", "value": float(Xg[i % n, j])}
+                 for j in range(d_fix)]
+                + [{"name": f"u{j}", "value": float(Xu[i % n, j])}
+                   for j in range(d_re)]),
+            "entityIds": {"userId": str(uid[i % n])},
+        }
+
+    results = []
+    reps = int(os.environ.get("BENCH_SERVING_REPS", 100))
+    for batch_size in (1, 8, 32, 64):
+        rows = [make_row(i) for i in range(batch_size)]
+        for _ in range(5):  # warm the cache ladder + coefficient LRU
+            service.handle_score({"rows": rows})
+        lat = []
+        t_all = time.perf_counter()
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            status, _body = service.handle_score({"rows": rows})
+            lat.append((time.perf_counter() - t0) * 1e3)
+            assert status == 200, f"bench request failed: {status}"
+        wall = time.perf_counter() - t_all
+        lat.sort()
+        results.append({
+            "batch_size": batch_size,
+            "p50_ms": round(lat[len(lat) // 2], 3),
+            "p99_ms": round(lat[min(len(lat) - 1,
+                                    int(len(lat) * 0.99))], 3),
+            "rows_per_s": round(batch_size * reps / wall, 1),
+        })
+    snap = service.metrics.snapshot()
+    service.close()
+    record = {
+        "metric": "serving_score_latency_cpu",
+        "value": results[-1]["rows_per_s"],
+        "unit": (f"rows/sec at batch={results[-1]['batch_size']} "
+                 f"({jax.devices()[0].platform}, in-process service, "
+                 f"d_fix={d_fix}, d_re={d_re}, entities={n_entities}; "
+                 "per-batch-size p50/p99 in 'results')"),
+        "results": results,
+        "compile_cache": {
+            "misses": snap["compile_cache_misses"],
+            "hits": snap["compile_cache_hits"],
+        },
+        "coeff_cache_hit_rate": round(snap["coeff_cache_hit_rate"], 4),
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_serving.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+
+
 def _baseline() -> "tuple[float, str] | None":
     """The honest comparator for ``vs_baseline``.
 
@@ -367,4 +485,7 @@ def _baseline() -> "tuple[float, str] | None":
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        serving_main()
+    else:
+        main()

@@ -25,6 +25,14 @@ from photon_ml_tpu.models import RandomEffectModel
 from photon_ml_tpu.utils import PhotonLogger, Timed, resolve_dtype
 
 
+def _positive_int(value: str) -> int:
+    n = int(value)
+    if n <= 0:
+        raise argparse.ArgumentTypeError(
+            f"expected a positive integer, got {value!r}")
+    return n
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="GAME scoring driver (TPU-native)")
     p.add_argument("--data", required=True, nargs="+")
@@ -38,9 +46,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="include a per-coordinate score breakdown")
     p.add_argument("--input-columns", default=None,
                    help="JSON (inline or path) remapping record field names")
-    p.add_argument("--batch-rows", type=int, default=None,
+    p.add_argument("--batch-rows", type=_positive_int, default=None,
                    help="score in row batches of this size (bounds device "
-                        "memory for large scoring sets)")
+                        "memory for large scoring sets; must be positive "
+                        "— 0/negative used to silently produce no output "
+                        "rows mid-write)")
     p.add_argument("--out-of-core", action="store_true",
                    help="larger-than-host-RAM scoring: decode block "
                         "windows of ~--batch-rows rows one at a time "
@@ -140,9 +150,16 @@ def _main(argv: Sequence[str] | None = None) -> int:
 
     with Timed(logger, "score"):
         n = len(labels)
-        step = args.batch_rows or max(n, 1)
-        chunks = [score_rows(slice(i, min(i + step, n)))
-                  for i in range(0, max(n, 1), step)]
+        if n == 0:
+            # empty scoring set: a valid, COMPLETE empty output (the
+            # atomic write below still runs), not a device no-op that
+            # happens to work — downstream consumers see scores.avro
+            # with zero records and evaluation is skipped
+            chunks = []
+        else:
+            step = args.batch_rows or n
+            chunks = [score_rows(slice(i, min(i + step, n)))
+                      for i in range(0, n, step)]
         scores = np.concatenate([c[0] for c in chunks]) if chunks else np.zeros(0)
         parts = {}
         if chunks and chunks[0][1]:
@@ -150,6 +167,13 @@ def _main(argv: Sequence[str] | None = None) -> int:
                      for k in chunks[0][1]}
 
     with Timed(logger, "write_scores"):
+        if len(scores) != len(uids):
+            # belt-and-braces: never start streaming records whose score
+            # lookups will IndexError halfway through the Avro write
+            raise RuntimeError(
+                f"scored {len(scores)} rows but read {len(uids)} — "
+                "refusing to write a partial scoring set")
+
         def records():
             for i, uid in enumerate(uids):
                 yield _scoring_record(uid, scores[i], labels[i], parts, i)

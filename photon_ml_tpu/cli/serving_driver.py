@@ -1,0 +1,109 @@
+"""Online scoring driver: serve a saved GAME model over HTTP.
+
+The fourth driver next to train/score/index: load a model ONCE, keep it
+resident (``serve/session.py``), and answer JSON scoring requests with
+micro-batching, shape-bucketed pre-compiled executables, and an
+entity-coefficient LRU. See docs/serving.md for the endpoint and
+operational contract.
+
+    photon-game-serve --model-dir out/model --port 8471 \
+        --max-batch 64 --max-delay-ms 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Sequence
+
+from photon_ml_tpu.utils import PhotonLogger, Timed
+
+
+def positive_int(value: str) -> int:
+    n = int(value)
+    if n <= 0:
+        raise argparse.ArgumentTypeError(
+            f"expected a positive integer, got {value!r}")
+    return n
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="GAME online scoring server (TPU-native)")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8471,
+                   help="0 binds an ephemeral port (printed at startup)")
+    p.add_argument("--max-batch", type=positive_int, default=64,
+                   help="rows per scoring execution; also the top of the "
+                        "pre-compiled shape ladder")
+    p.add_argument("--max-delay-ms", type=float, default=5.0,
+                   help="longest a request waits for batch companions")
+    p.add_argument("--max-queue", type=positive_int, default=256,
+                   help="admission-queue bound; beyond it requests are "
+                        "shed with HTTP 429")
+    p.add_argument("--pad-nnz", type=positive_int, default=64,
+                   help="padded nonzeros per row in the compiled shapes")
+    p.add_argument("--coeff-cache-entries", type=positive_int, default=4096,
+                   help="resident entities per random effect (LRU)")
+    p.add_argument("--watchdog-s", type=float, default=60.0,
+                   help="stuck-batch watchdog; <= 0 disables")
+    p.add_argument("--request-timeout-s", type=float, default=30.0)
+    p.add_argument("--log-dir", default=None,
+                   help="photon.log.jsonl location (default: model dir)")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64"])
+    return p
+
+
+def build_server(args):
+    """Session + batcher + HTTP server from parsed args (shared with the
+    serving bench, which drives the service without the process exec)."""
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringServer,
+        ScoringService,
+        ScoringSession,
+    )
+
+    session = ScoringSession(
+        args.model_dir, dtype=args.dtype, max_batch=args.max_batch,
+        pad_nnz=args.pad_nnz, coeff_cache_entries=args.coeff_cache_entries)
+    batcher = MicroBatcher(
+        session.score_rows, max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms, max_queue=args.max_queue,
+        watchdog_s=(None if args.watchdog_s <= 0 else args.watchdog_s),
+        metrics=session.metrics)
+    service = ScoringService(session, batcher,
+                             request_timeout_s=args.request_timeout_s)
+    return ScoringServer(service, host=args.host, port=args.port)
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    log_dir = args.log_dir or args.model_dir
+    os.makedirs(log_dir, exist_ok=True)
+    logger = PhotonLogger(os.path.join(log_dir, "photon.log.jsonl"))
+    logger.log("driver_start", driver="serving", args=vars(args))
+    with Timed(logger, "load_and_warmup"):
+        server = build_server(args)
+    compiled = server.service.session.compile_count
+    logger.log("serving_ready", host=server.host, port=server.port,
+               precompiled_executables=compiled)
+    print(f"serving {args.model_dir} on http://{server.host}:{server.port} "
+          f"({compiled} pre-compiled executables; POST /score, "
+          "GET /healthz, GET /metrics)", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+        logger.log("driver_done",
+                   **server.service.metrics.snapshot())
+        logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,81 @@ from photon_ml_tpu.models import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.types import SparseFeatures, margins as _margins
 
 
+def fixed_effect_margins(sp, coord: FixedEffectModel, dtype) -> jax.Array:
+    """Per-row margins of one fixed-effect coordinate over a HostSparse
+    batch — the single definition of the fixed-effect margin math, shared
+    by the batch path below and the serving session's parity reference."""
+    feats = SparseFeatures(
+        jnp.asarray(sp.indices),
+        None if sp.values is None else jnp.asarray(sp.values, dtype),
+        dim=sp.dim,
+    )
+    return _margins(feats, jnp.asarray(coord.model.coefficients.means, dtype))
+
+
+def build_model_score_views(
+    model: GameModel,
+    host: Dict[str, object],
+    entity_ids: Dict[str, np.ndarray],
+) -> Dict[str, tuple]:
+    """Pre-built random-effect score views for every random coordinate:
+    coordinate name -> (views, coeffs) as :func:`score_single_batch`
+    consumes them. Split out so callers that assemble their own views
+    (the serving session's coefficient cache) share the scoring entry."""
+    out = {}
+    for name, coord in model.coordinates.items():
+        if isinstance(coord, RandomEffectModel):
+            ids = _entity_ids_for(entity_ids, coord, name)
+            out[name] = _model_score_view(coord, host[coord.feature_shard],
+                                          ids)
+    return out
+
+
+def score_single_batch(
+    model: GameModel,
+    features: Dict[str, object],
+    score_views: Dict[str, tuple],
+    offsets: Optional[np.ndarray] = None,
+    dtype=jnp.float32,
+    per_coordinate: bool = False,
+    fixed_scorer=None,
+):
+    """Score ONE batch through pre-built random-effect score views.
+
+    The serving session (``serve/session.py``) and the batch scoring path
+    (:func:`score_game_model`) both land here, so there is exactly one
+    definition of the per-coordinate margin math. ``score_views`` maps
+    each random coordinate name to ``(views, coeffs)`` — a sequence of
+    :class:`~photon_ml_tpu.game.data.REScoreBucket` plus the matching
+    per-bucket ``[E, D]`` coefficient arrays (``build_model_score_views``
+    builds them from a full model; the serving session builds them from
+    its entity-coefficient cache).
+
+    ``fixed_scorer`` optionally overrides HOW a fixed-effect coordinate's
+    margins are computed — ``(name, coord, host_sparse) -> [n] margins`` —
+    without forking the coordinate loop: the serving session routes fixed
+    effects through its device-resident pre-compiled executables here,
+    while the default stays the eager :func:`fixed_effect_margins`."""
+    host = {k: host_sparse_from_features(v) for k, v in features.items()}
+    n = next(iter(host.values())).num_rows
+    total = (jnp.zeros((n,), dtype) if offsets is None
+             else jnp.asarray(offsets, dtype))
+    parts = {}
+    for name, coord in model.coordinates.items():
+        if isinstance(coord, FixedEffectModel):
+            sp = host[coord.feature_shard]
+            s = (fixed_scorer(name, coord, sp) if fixed_scorer is not None
+                 else fixed_effect_margins(sp, coord, dtype))
+        else:
+            views, coeffs = score_views[name]
+            s = score_random_effect(views, coeffs, n, dtype)
+        parts[name] = s
+        total = total + s
+    if per_coordinate:
+        return total, parts
+    return total
+
+
 def _model_score_view(re_model: RandomEffectModel, sp, entity_ids):
     """Build score-view buckets directly from a RandomEffectModel's
     projections (used when scoring without the original train data); shares
@@ -68,27 +143,9 @@ def score_game_model(
     convention the RandomEffectModel's ``effect_name``."""
     entity_ids = entity_ids or {}
     host = {k: host_sparse_from_features(v) for k, v in features.items()}
-    n = next(iter(host.values())).num_rows
-    total = jnp.zeros((n,), dtype) if offsets is None else jnp.asarray(offsets, dtype)
-    parts = {}
-    for name, coord in model.coordinates.items():
-        sp = host[coord.feature_shard]
-        if isinstance(coord, FixedEffectModel):
-            feats = SparseFeatures(
-                jnp.asarray(sp.indices),
-                None if sp.values is None else jnp.asarray(sp.values, dtype),
-                dim=sp.dim,
-            )
-            s = _margins(feats, jnp.asarray(coord.model.coefficients.means, dtype))
-        else:
-            ids = _entity_ids_for(entity_ids, coord, name)
-            views, coeffs = _model_score_view(coord, sp, ids)
-            s = score_random_effect(views, coeffs, n, dtype)
-        parts[name] = s
-        total = total + s
-    if per_coordinate:
-        return total, parts
-    return total
+    views = build_model_score_views(model, host, entity_ids)
+    return score_single_batch(model, host, views, offsets=offsets,
+                              dtype=dtype, per_coordinate=per_coordinate)
 
 
 def _entity_ids_for(entity_ids: Dict, coord: RandomEffectModel, name: str):

@@ -180,47 +180,104 @@ def _save_game_model_tree(
         json.dump(meta, f, indent=2)
 
 
-def load_game_model(directory: str) -> GameModel:
+def load_model_metadata(directory: str) -> dict:
+    """The model directory's ``metadata.json`` payload (task + coordinate
+    order/types) — shared by :func:`load_game_model` and the serving
+    session, which loads coordinates selectively."""
     with open(os.path.join(directory, "metadata.json")) as f:
-        meta = json.load(f)
+        return json.load(f)
+
+
+def load_model_index_map(directory: str, shard: str):
+    """Open one shard's persisted index map (either backend — JSON or the
+    native paldb-style store) from a saved model directory."""
+    from photon_ml_tpu.io.paldb import load_index_map
+
+    return load_index_map(os.path.join(directory, f"index-map.{shard}.json"))
+
+
+def read_random_effect_records(directory: str, name: str):
+    """All BayesianLinearModelAvro records of one random-effect coordinate
+    (one record per entity). The serving coefficient cache reads through
+    this so its decode can never diverge from :func:`load_game_model`."""
+    path = os.path.join(directory, "random-effect", name,
+                        "coefficients.avro")
+    records, _ = read_avro_file(path)
+    return records
+
+
+def entity_support_from_record(rec, imap: IndexMap):
+    """Parse ONE RandomEffectModel record into its (sorted global feature
+    ids, matching coefficient values) support — the per-entity payload the
+    bulk rebuild and the serving entity-coefficient cache share. Sorting
+    ascending fixes the local-slot order, so a cache entry's slot map is
+    identical to the loaded model's projection row."""
+    ids, vals = [], []
+    for coef in rec["means"]:
+        idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
+        if idx is not None:
+            ids.append(idx)
+            vals.append(coef["value"])
+    order = np.argsort(ids)
+    return (np.asarray(ids, np.int64)[order],
+            np.asarray(vals, np.float64)[order])
+
+
+def sketch_coefficients_from_record(rec, dim: int) -> np.ndarray:
+    """Dense sketched-space coefficient vector of one RandomEffectModel
+    record saved under synthetic ``(SKETCH j)`` slot names."""
+    w = np.zeros(dim)
+    for coef in rec["means"]:
+        nm = coef["name"]
+        if nm.startswith("(SKETCH ") and nm.endswith(")"):
+            w[int(nm[len("(SKETCH "):-1])] = coef["value"]
+    return w
+
+
+def load_fixed_effect_coordinate(directory: str, name: str, imap: IndexMap,
+                                 task: str, shard: str) -> FixedEffectModel:
+    """Rebuild one fixed-effect coordinate from its saved record (shared
+    by the bulk load and the serving session, which loads fixed effects
+    eagerly but random effects through its coefficient cache)."""
+    path = os.path.join(directory, "fixed-effect", name, "coefficients.avro")
+    records, _ = read_avro_file(path)
+    rec = records[0]
+    w = np.zeros(imap.size)
+    for coef in rec["means"]:
+        idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
+        if idx is not None:
+            w[idx] = coef["value"]
+    var = None
+    if rec.get("variances"):
+        var = np.zeros(imap.size)
+        for coef in rec["variances"]:
+            idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
+            if idx is not None:
+                var[idx] = coef["value"]
+    return FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(jnp.asarray(w),
+                         None if var is None else jnp.asarray(var)),
+            task,
+        ),
+        shard,
+    )
+
+
+def load_game_model(directory: str) -> GameModel:
+    meta = load_model_metadata(directory)
     index_maps: Dict[str, IndexMap] = {}
     coords = {}
     for c in meta["coordinates"]:
         shard = c["feature_shard"]
         if shard not in index_maps:
-            from photon_ml_tpu.io.paldb import load_index_map
-
-            index_maps[shard] = load_index_map(
-                os.path.join(directory, f"index-map.{shard}.json")
-            )
+            index_maps[shard] = load_model_index_map(directory, shard)
         imap = index_maps[shard]
         if c["type"] == "fixed":
-            path = os.path.join(directory, "fixed-effect", c["name"], "coefficients.avro")
-            records, _ = read_avro_file(path)
-            rec = records[0]
-            w = np.zeros(imap.size)
-            for coef in rec["means"]:
-                idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
-                if idx is not None:
-                    w[idx] = coef["value"]
-            var = None
-            if rec.get("variances"):
-                var = np.zeros(imap.size)
-                for coef in rec["variances"]:
-                    idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
-                    if idx is not None:
-                        var[idx] = coef["value"]
-            coords[c["name"]] = FixedEffectModel(
-                GeneralizedLinearModel(
-                    Coefficients(jnp.asarray(w),
-                                 None if var is None else jnp.asarray(var)),
-                    meta["task"],
-                ),
-                shard,
-            )
+            coords[c["name"]] = load_fixed_effect_coordinate(
+                directory, c["name"], imap, meta["task"], shard)
         else:
-            path = os.path.join(directory, "random-effect", c["name"], "coefficients.avro")
-            records, _ = read_avro_file(path)
+            records = read_random_effect_records(directory, c["name"])
             coords[c["name"]] = _rebuild_random_effect(
                 c["name"], records, imap, meta["task"], shard,
                 c.get("entity_column", ""), c.get("projection"),
@@ -238,21 +295,14 @@ def _rebuild_random_effect(name, records, imap: IndexMap, task, shard,
         )
     entities: List[tuple] = []
     for rec in records:
-        ids, vals, variances = [], [], {}
-        for coef in rec["means"]:
-            idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
-            if idx is not None:
-                ids.append(idx)
-                vals.append(coef["value"])
+        ids, vals = entity_support_from_record(rec, imap)
+        variances = {}
         if rec.get("variances"):
             for coef in rec["variances"]:
                 idx = _model_index_of(imap, coef["name"], coef.get("term", ""))
                 if idx is not None:
                     variances[idx] = coef["value"]
-        order = np.argsort(ids)
-        entities.append(
-            (rec["modelId"], np.asarray(ids)[order], np.asarray(vals)[order], variances)
-        )
+        entities.append((rec["modelId"], ids, vals, variances))
     # bucket by support size
     by_size: Dict[int, List[tuple]] = {}
     for ent in entities:
@@ -292,11 +342,7 @@ def _rebuild_sketched_random_effect(name, records, task, shard, entity_column,
     eids, coefs_list, var_list = [], [], []
     has_var = False
     for rec in records:
-        w = np.zeros(dim)
-        for coef in rec["means"]:
-            nm = coef["name"]
-            if nm.startswith("(SKETCH ") and nm.endswith(")"):
-                w[int(nm[len("(SKETCH "):-1])] = coef["value"]
+        w = sketch_coefficients_from_record(rec, dim)
         v = np.zeros(dim)
         if rec.get("variances"):
             has_var = True

@@ -1,0 +1,44 @@
+"""Online GAME scoring service.
+
+The batch path (``cli/game_scoring_driver.py``) loads a model, scores a
+dataset, and exits; this package keeps a model RESIDENT and answers
+scoring requests while it stays loaded — the Snap ML-style hierarchy
+(PAPERS.md, arXiv:1803.06333) of pinning hot state next to the compute
+and pipelining host work around it, applied to a GAME model:
+
+* :class:`~photon_ml_tpu.serve.session.ScoringSession` — fixed-effect
+  coefficients live on device; jit executables are pre-compiled for a
+  bounded ladder of padded batch shapes so steady-state traffic never
+  recompiles; per-entity random-effect coefficients come from an LRU.
+* :class:`~photon_ml_tpu.serve.batcher.MicroBatcher` — deadline-based
+  micro-batching (``max_batch`` / ``max_delay_ms``) with a bounded
+  admission queue and explicit load shedding.
+* :class:`~photon_ml_tpu.serve.coeff_cache.EntityCoefficientLRU` — hot
+  entity coefficients resident, cold entities re-read from the saved
+  model directory; unknown entities fall back to fixed-effect-only
+  scores exactly as ``game/scoring.py`` does.
+* :class:`~photon_ml_tpu.serve.server.ScoringServer` — stdlib-only JSON
+  endpoint with ``/healthz`` and a text ``/metrics`` exporter.
+
+See ``docs/serving.md`` for the architecture and operational contract.
+"""
+
+from photon_ml_tpu.serve.batcher import (
+    BatchWatchdogTimeout,
+    MicroBatcher,
+    QueueFullError,
+)
+from photon_ml_tpu.serve.coeff_cache import (
+    EntityCoefficientLRU,
+    ModelDirCoefficientStore,
+)
+from photon_ml_tpu.serve.metrics import Histogram, ServingMetrics
+from photon_ml_tpu.serve.session import ScoringSession
+from photon_ml_tpu.serve.server import ScoringService, ScoringServer
+
+__all__ = [
+    "ScoringSession", "MicroBatcher", "QueueFullError",
+    "BatchWatchdogTimeout", "EntityCoefficientLRU",
+    "ModelDirCoefficientStore", "Histogram", "ServingMetrics",
+    "ScoringService", "ScoringServer",
+]

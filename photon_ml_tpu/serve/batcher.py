@@ -1,0 +1,276 @@
+"""Deadline-based micro-batcher with a bounded admission queue.
+
+The device scores padded batches; requests arrive one at a time. The
+micro-batcher bridges the two: an admitted request waits at most
+``max_delay_ms`` for companions, and a batch dispatches as soon as it
+reaches ``max_batch`` rows — the classic throughput/latency knob
+("right-sized batches keep the device fed", PAPERS.md GPU-learning
+entry; Snap ML's pipelined host tier).
+
+**Bounded, not elastic.** The admission queue holds at most ``max_queue``
+requests. When it is full, :meth:`MicroBatcher.submit` raises
+:class:`QueueFullError` IMMEDIATELY — explicit load shedding the caller
+can convert into HTTP 429/503 — instead of queuing unboundedly and
+converting overload into unbounded latency for everyone. (A server that
+melts down by latency is much harder to operate than one that says no.)
+
+**Stuck-batch watchdog.** A scoring execution that wedges (a device gone
+bad, a compile that never returns — see docs/PERF.md for this
+environment's tunnel history) would otherwise hang the worker and every
+queued request behind it. Each execution runs under the PR-1 watchdog
+discipline from ``parallel/resilience.py``: the batch is scored on a
+helper thread joined with a timeout, and on expiry every request of that
+batch fails with :class:`BatchWatchdogTimeout` (a
+``resilience.WatchdogTimeout`` subclass) while the worker moves on —
+same abandon-the-thread semantics as the health barrier's allgather
+watchdog, for the same reason.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from photon_ml_tpu.parallel.resilience import WatchdogTimeout
+
+__all__ = ["QueueFullError", "BatchWatchdogTimeout", "MicroBatcher",
+           "PendingRequest"]
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity: the request was SHED, not queued.
+    Callers should surface this as retryable backpressure (HTTP 429)."""
+
+    def __init__(self, depth: int, capacity: int):
+        super().__init__(
+            f"admission queue full ({depth}/{capacity}); request shed — "
+            "retry with backoff or scale out")
+        self.depth = depth
+        self.capacity = capacity
+
+
+class BatchWatchdogTimeout(WatchdogTimeout):
+    """One scoring execution exceeded the batch watchdog; the batch's
+    requests fail, the worker abandons the execution thread and
+    continues (fail-stop discipline from ``parallel/resilience.py``)."""
+
+
+class PendingRequest:
+    """One admitted request: rows in, (scores, parts) or an exception
+    out. ``result()`` blocks the submitting thread until the batcher's
+    worker resolves it."""
+
+    __slots__ = ("rows", "per_coordinate", "_event", "_result", "_error",
+                 "admitted_at")
+
+    def __init__(self, rows: Sequence[dict], per_coordinate: bool):
+        self.rows = list(rows)
+        self.per_coordinate = per_coordinate
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self.admitted_at = time.monotonic()
+
+    def set_result(self, value) -> None:
+        self._result = value
+        self._event.set()
+
+    def set_error(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("scoring request not resolved in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class MicroBatcher:
+    """Coalesce scoring requests into bounded, deadline-dispatched batches.
+
+    ``score_fn(rows, per_coordinate)`` is the execution target — in the
+    serving stack, ``ScoringSession.score_rows``. Requests carrying
+    multiple rows are admitted atomically and their scores sliced back
+    out of the batch result in order. ``max_batch`` bounds the rows per
+    execution; a single request larger than ``max_batch`` is rejected at
+    submit (ValueError) — the transport layer splits if it wants to.
+
+    ``watchdog_s=None`` disables the stuck-batch watchdog (execution runs
+    inline on the worker); the default keeps it armed.
+    """
+
+    def __init__(self, score_fn: Callable, *, max_batch: int = 64,
+                 max_delay_ms: float = 5.0, max_queue: int = 256,
+                 watchdog_s: Optional[float] = 60.0, metrics=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._score_fn = score_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.watchdog_s = watchdog_s
+        self._queue: "queue.Queue[Optional[PendingRequest]]" = queue.Queue(
+            maxsize=int(max_queue))
+        self._metrics = metrics
+        self._closed = False
+        self._carry: Optional[PendingRequest] = None  # worker-only state
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="photon-serve-batcher")
+        self._worker.start()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, rows: Sequence[dict],
+               per_coordinate: bool = False) -> PendingRequest:
+        """Admit a request (non-blocking). Raises :class:`QueueFullError`
+        when the queue is at capacity and ValueError for oversized or
+        empty requests; never blocks the caller on a full queue."""
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        rows = list(rows)
+        if not rows:
+            raise ValueError("empty request (no rows)")
+        if len(rows) > self.max_batch:
+            raise ValueError(
+                f"request of {len(rows)} rows exceeds max_batch="
+                f"{self.max_batch}; split it client-side")
+        req = PendingRequest(rows, per_coordinate)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            if self._metrics is not None:
+                self._metrics.record_shed()
+            raise QueueFullError(self._queue.qsize(),
+                                 self._queue.maxsize) from None
+        if self._metrics is not None:
+            self._metrics.set_queue_depth(self._queue.qsize())
+        return req
+
+    def score(self, rows: Sequence[dict], per_coordinate: bool = False,
+              timeout: Optional[float] = None):
+        """Blocking convenience: submit + wait for the result."""
+        return self.submit(rows, per_coordinate).result(timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def close(self, drain_timeout_s: float = 5.0) -> None:
+        """Stop admitting, let the worker drain, join it."""
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)  # wake the worker for shutdown
+        self._worker.join(drain_timeout_s)
+
+    # -- worker ------------------------------------------------------------
+    def _collect_batch(self) -> Optional[List[PendingRequest]]:
+        """Block for the first request, then coalesce companions until
+        the deadline (first request's arrival + max_delay) or max_batch
+        rows. Requests are admitted whole: one whose rows would overflow
+        the batch stays queued for the next one."""
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+        else:
+            first = self._queue.get()
+            if first is None:
+                return None
+        batch = [first]
+        rows = len(first.rows)
+        deadline = time.monotonic() + self.max_delay_s
+        while rows < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._queue.put(None)  # re-post the shutdown token
+                break
+            if rows + len(nxt.rows) > self.max_batch:
+                # no peeking API on queue.Queue: hold the overflow
+                # request back; it seeds the next batch
+                self._carry = nxt
+                break
+            batch.append(nxt)
+            rows += len(nxt.rows)
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect_batch()
+            if batch is None:
+                return
+            self._execute(batch)
+            if self._metrics is not None:
+                self._metrics.set_queue_depth(self._queue.qsize())
+            if (self._closed and self._carry is None
+                    and self._queue.empty()):
+                return
+
+    def _score_with_watchdog(self, rows: List[dict], per_coordinate: bool):
+        if self.watchdog_s is None:
+            return self._score_fn(rows, per_coordinate)
+        box: dict = {}
+
+        def run():
+            try:
+                box["result"] = self._score_fn(rows, per_coordinate)
+            except BaseException as e:  # surfaced to the batch below
+                box["error"] = e
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="photon-serve-score")
+        t.start()
+        t.join(self.watchdog_s)
+        if t.is_alive():
+            raise BatchWatchdogTimeout(
+                f"scoring execution exceeded the {self.watchdog_s:.1f}s "
+                "batch watchdog (stuck device or compile); abandoning it "
+                "and failing this batch's requests")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _execute(self, batch: List[PendingRequest]) -> None:
+        rows: List[dict] = []
+        for req in batch:
+            rows.extend(req.rows)
+        t0 = time.monotonic()
+        per_coord = any(r.per_coordinate for r in batch)
+        try:
+            result = self._score_with_watchdog(rows, per_coord)
+        except BaseException as e:
+            for req in batch:
+                req.set_error(e)
+            if self._metrics is not None:
+                self._metrics.record_error()
+            return
+        if per_coord:
+            scores, parts = result
+        else:
+            scores, parts = result, {}
+        elapsed_ms = (time.monotonic() - t0) * 1e3
+        if self._metrics is not None:
+            self._metrics.record_batch(len(rows), self.max_batch,
+                                       elapsed_ms)
+        now = time.monotonic()
+        start = 0
+        for req in batch:
+            end = start + len(req.rows)
+            sl = {k: v[start:end] for k, v in parts.items()}
+            req.set_result((scores[start:end], sl)
+                           if req.per_coordinate else scores[start:end])
+            if self._metrics is not None:
+                self._metrics.record_request(
+                    len(req.rows), (now - req.admitted_at) * 1e3)
+            start = end

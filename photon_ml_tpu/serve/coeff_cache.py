@@ -1,0 +1,183 @@
+"""Per-entity random-effect coefficient store with LRU eviction.
+
+A GAME model's random effects can hold millions of per-entity coefficient
+rows; a serving process must NOT require them all resident (that is the
+batch loader's trade). This module keeps the HOT entities' coefficients in
+memory behind an LRU and re-reads cold entities from the saved model
+directory — the same ``coefficients.avro`` + index-map layout
+``io/model_io`` writes, decoded through the same per-record helpers
+(``entity_support_from_record`` / ``sketch_coefficients_from_record``), so
+a cache entry can never diverge from what ``load_game_model`` would build.
+
+An entity absent from the store is cached as ``None`` (negative entry):
+the serving session then scores it with fixed effects only — byte-for-byte
+the fallback ``game/scoring.py`` applies to unknown entities (their rows
+are dropped from every random-effect score view, contributing score 0).
+Negative entries occupy LRU slots like positive ones, so a scan of unknown
+ids cannot pin the whole store in memory.
+
+Cost model: a cold miss streams the coordinate's Avro file until the
+entity's record (O(file) worst case); a first access builds a known-id set
+in one streaming pass so ABSENT ids answer without touching the file
+again. The LRU exists to make cold misses rare; size it to the working
+set, not the model.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["CoeffEntry", "EntityCoefficientLRU", "ModelDirCoefficientStore"]
+
+
+class CoeffEntry:
+    """One entity's serving payload: ``local_map`` (global feature id ->
+    local slot dict, or a shared SketchProjection) plus the matching local
+    coefficient vector — exactly the pair ``_model_score_view`` derives
+    from a loaded RandomEffectModel bucket row."""
+
+    __slots__ = ("local_map", "coefficients")
+
+    def __init__(self, local_map, coefficients: np.ndarray):
+        self.local_map = local_map
+        self.coefficients = np.asarray(coefficients, np.float64)
+
+    @property
+    def local_dim(self) -> int:
+        return int(self.coefficients.shape[0])
+
+
+class ModelDirCoefficientStore:
+    """Cold-path loader over one random-effect coordinate of a saved model
+    directory (the PalDB-backed-store role from the reference, built on
+    this repo's persisted index maps + Avro records)."""
+
+    def __init__(self, model_dir: str, name: str, imap,
+                 projection_meta: Optional[dict] = None):
+        self.model_dir = model_dir
+        self.name = name
+        self.imap = imap
+        self.projection_meta = projection_meta
+        self._sketch = None
+        if projection_meta and projection_meta.get("type") == "random":
+            from photon_ml_tpu.game.data import SketchProjection
+
+            self._sketch = SketchProjection(
+                int(projection_meta["dim"]),
+                int(projection_meta.get("seed", 0)))
+        self._known: Optional[frozenset] = None
+        self._lock = threading.Lock()
+
+    def _path(self) -> str:
+        return os.path.join(self.model_dir, "random-effect", self.name,
+                            "coefficients.avro")
+
+    def known_ids(self) -> frozenset:
+        """Every entity id present in the store (one streaming pass, ids
+        only — payloads are not retained)."""
+        with self._lock:
+            if self._known is None:
+                from photon_ml_tpu.io.avro import iter_avro_records
+
+                self._known = frozenset(
+                    str(rec["modelId"])
+                    for rec in iter_avro_records([self._path()]))
+            return self._known
+
+    def _parse(self, rec) -> CoeffEntry:
+        if self._sketch is not None:
+            from photon_ml_tpu.io.model_io import (
+                sketch_coefficients_from_record,
+            )
+
+            w = sketch_coefficients_from_record(rec, self._sketch.dim)
+            return CoeffEntry(self._sketch, w)
+        from photon_ml_tpu.io.model_io import entity_support_from_record
+
+        ids, vals = entity_support_from_record(rec, self.imap)
+        local_map = {int(g): s for s, g in enumerate(ids)}
+        return CoeffEntry(local_map, vals)
+
+    def load(self, entity_id: str) -> Optional[CoeffEntry]:
+        """The entity's coefficients, or None when the store has no model
+        for it (the caller caches that outcome as a negative entry)."""
+        if str(entity_id) not in self.known_ids():
+            return None
+        from photon_ml_tpu.io.avro import iter_avro_records
+
+        for rec in iter_avro_records([self._path()]):
+            if str(rec["modelId"]) == str(entity_id):
+                return self._parse(rec)
+        return None  # pragma: no cover - known_ids guarantees a record
+
+
+class EntityCoefficientLRU:
+    """Bounded LRU over :class:`CoeffEntry` payloads (negative entries
+    included). ``loader`` is any ``entity_id -> CoeffEntry | None``
+    callable — production passes :meth:`ModelDirCoefficientStore.load`;
+    tests pass fakes to pin eviction/counter behaviour."""
+
+    def __init__(self, loader: Callable[[str], Optional[CoeffEntry]],
+                 capacity: int, metrics=None):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self._loader = loader
+        self.capacity = int(capacity)
+        self._data: "OrderedDict[str, Optional[CoeffEntry]]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def cached_ids(self) -> Sequence[str]:
+        """Current residents, least-recently-used first."""
+        with self._lock:
+            return list(self._data)
+
+    def get(self, entity_id) -> Optional[CoeffEntry]:
+        key = str(entity_id)
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+                self.hits += 1
+                if self._metrics is not None:
+                    self._metrics.record_coeff(hits=1)
+                return self._data[key]
+            self.misses += 1
+        # load OUTSIDE the lock: a cold miss may stream the model file
+        entry = self._loader(key)
+        evicted = 0
+        with self._lock:
+            self._data[key] = entry
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+                evicted += 1
+            self.evictions += evicted
+            if self._metrics is not None:
+                self._metrics.record_coeff(misses=1, evictions=evicted)
+        return entry
+
+    def get_many(self, entity_ids) -> Dict[str, Optional[CoeffEntry]]:
+        """Resolve a batch of ids (deduplicated; order-preserving dict)."""
+        out: Dict[str, Optional[CoeffEntry]] = {}
+        for eid in entity_ids:
+            key = str(eid)
+            if key not in out:
+                out[key] = self.get(key)
+        return out
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0

@@ -1,0 +1,195 @@
+"""Stdlib-only JSON scoring endpoint over the resident session.
+
+Two layers, deliberately separated:
+
+* :class:`ScoringService` — transport-agnostic request handling: parse /
+  validate a payload dict, run it through the micro-batcher, shape the
+  response and status code. The tier-1 tests exercise THIS layer
+  in-process (no sockets, no ports, no flakes).
+* :class:`ScoringServer` — a ``http.server.ThreadingHTTPServer`` wrapper
+  exposing ``POST /score``, ``GET /healthz``, and ``GET /metrics``
+  (Prometheus text). One real-HTTP smoke test covers the wire.
+
+Status-code contract (the load-shedding contract callers program
+against; see docs/serving.md):
+
+  200 scored; 400 malformed request; 404 unknown path;
+  429 shed — admission queue full, retry with backoff (explicit
+      backpressure instead of unbounded queueing latency);
+  503 scoring failed; 504 batch watchdog expired (stuck execution).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from photon_ml_tpu.serve.batcher import (
+    BatchWatchdogTimeout,
+    MicroBatcher,
+    QueueFullError,
+)
+from photon_ml_tpu.serve.metrics import ServingMetrics
+from photon_ml_tpu.serve.session import ScoringSession
+
+__all__ = ["ScoringService", "ScoringServer"]
+
+
+class ScoringService:
+    """Session + batcher + metrics behind a payload-in/payload-out API."""
+
+    def __init__(self, session: ScoringSession,
+                 batcher: Optional[MicroBatcher] = None,
+                 request_timeout_s: float = 30.0):
+        self.session = session
+        self.metrics: ServingMetrics = session.metrics
+        self.batcher = batcher or MicroBatcher(
+            session.score_rows, max_batch=session.max_batch,
+            metrics=self.metrics)
+        self.request_timeout_s = float(request_timeout_s)
+
+    # -- endpoints ---------------------------------------------------------
+    def handle_score(self, payload) -> Tuple[int, dict]:
+        """``{"rows": [...], "perCoordinate": bool}`` -> scores. Each row
+        as ``ScoringSession.score_rows`` documents (features /
+        entityIds / offset, plus an optional echoed ``uid``)."""
+        if not isinstance(payload, dict) or not isinstance(
+                payload.get("rows"), list):
+            return 400, {"error": "payload must be "
+                                  '{"rows": [...], "perCoordinate"?: bool}'}
+        rows = payload["rows"]
+        if not rows:
+            return 400, {"error": "empty rows"}
+        if not all(isinstance(r, dict) for r in rows):
+            return 400, {"error": "every row must be an object"}
+        per_coord = bool(payload.get("perCoordinate"))
+        try:
+            result = self.batcher.score(rows, per_coord,
+                                        timeout=self.request_timeout_s)
+        except QueueFullError as e:
+            return 429, {"error": str(e), "shed": True}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except BatchWatchdogTimeout as e:
+            return 504, {"error": str(e)}
+        except TimeoutError as e:
+            return 504, {"error": str(e)}
+        except Exception as e:
+            return 503, {"error": f"scoring failed: {e}"}
+        if per_coord:
+            scores, parts = result
+        else:
+            scores, parts = result, {}
+        body = {"scores": [float(s) for s in scores]}
+        uids = [r.get("uid") for r in rows]
+        if any(u is not None for u in uids):
+            body["uids"] = uids
+        if per_coord:
+            body["scoreComponents"] = {
+                k: [float(x) for x in v] for k, v in parts.items()}
+        return 200, body
+
+    def handle_healthz(self) -> Tuple[int, dict]:
+        return 200, {
+            "status": "ok",
+            "model_dir": self.session.model_dir,
+            "task": self.session.task,
+            "queue_depth": self.batcher.queue_depth,
+            "max_batch": self.batcher.max_batch,
+        }
+
+    def handle_metrics(self) -> Tuple[int, str]:
+        return 200, self.metrics.render()
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: ScoringService  # injected by ScoringServer via subclassing
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet; metrics carry the signal
+        pass
+
+    def _reply(self, status: int, body, content_type="application/json"):
+        data = (body if isinstance(body, (bytes, str))
+                else json.dumps(body))
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            status, body = self.service.handle_healthz()
+            self._reply(status, body)
+        elif self.path == "/metrics":
+            status, text = self.service.handle_metrics()
+            self._reply(status, text, content_type="text/plain; version=0.0.4")
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/score":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"null")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad JSON: {e}"})
+            return
+        status, body = self.service.handle_score(payload)
+        self._reply(status, body)
+
+
+class ScoringServer:
+    """Threaded HTTP server over a :class:`ScoringService`. ``port=0``
+    binds an ephemeral port (tests); ``start()`` serves on a daemon
+    thread, ``close()`` shuts the listener and drains the batcher."""
+
+    def __init__(self, service: ScoringService, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.service = service
+        handler = type("BoundHandler", (_Handler,), {"service": service})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self._serving = False
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ScoringServer":
+        self._serving = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="photon-serve-http")
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground serve (the CLI driver's main loop)."""
+        self._serving = True
+        self._httpd.serve_forever()
+
+    def close(self) -> None:
+        # shutdown() handshakes with a RUNNING serve_forever loop and
+        # blocks forever without one — only call it when a loop started
+        if self._serving:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        self.service.close()

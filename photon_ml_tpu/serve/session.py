@@ -1,0 +1,384 @@
+"""Resident scoring session: the device-side half of the serving stack.
+
+A :class:`ScoringSession` loads a saved GAME model ONCE and answers
+scoring batches for as long as the process lives:
+
+* **Fixed effects resident on device.** Each fixed coordinate's
+  coefficient vector is uploaded once at construction (through
+  ``utils/transfer_budget`` — sanctioned, budget-accounted) and captured
+  by the jit executables, so steady-state requests move only the batch's
+  padded index/value arrays.
+
+* **Shape-bucketed compile cache.** XLA executables are specialized to
+  input shapes, so naive serving would recompile on every new batch size
+  — tens of ms to seconds of latency cliff, exactly the "keep the device
+  fed with right-sized batches" failure mode the GPU-learning literature
+  warns about (PAPERS.md). The session instead pads every batch up a
+  bounded POWER-OF-TWO ladder of row counts (and one fixed nnz width per
+  shard), pre-compiles the whole ladder at warmup, and counts
+  hits/misses so a recompile in steady state is observable (the tier-1
+  suite asserts the miss counter stays flat).
+
+* **Random effects through the entity LRU.** Per-entity coefficients are
+  fetched from :class:`~photon_ml_tpu.serve.coeff_cache
+  .EntityCoefficientLRU`; a batch's score views are assembled with the
+  SAME ``build_score_buckets`` / ``score_random_effect`` machinery the
+  batch path uses, and the whole batch funnels through
+  ``game.scoring.score_single_batch`` — one margin-math code path for
+  offline and online scoring. Entities without a model contribute score
+  0 (fixed-effect-only fallback), identical to ``score_game_model``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import HostSparse
+from photon_ml_tpu.game.scoring import score_single_batch
+from photon_ml_tpu.io.model_io import (
+    load_fixed_effect_coordinate,
+    load_model_index_map,
+    load_model_metadata,
+)
+from photon_ml_tpu.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.serve.coeff_cache import (
+    EntityCoefficientLRU,
+    ModelDirCoefficientStore,
+)
+from photon_ml_tpu.serve.metrics import ServingMetrics
+from photon_ml_tpu.types import SparseFeatures, margins as _margins
+from photon_ml_tpu.utils import resolve_dtype, transfer_budget
+
+__all__ = ["ScoringSession", "bucket_ladder", "bucketize"]
+
+
+def bucket_ladder(top: int, start: int = 1) -> List[int]:
+    """Power-of-two ladder ``[start, 2*start, ...]`` whose last rung is
+    the smallest power of two >= ``top``."""
+    if top < 1:
+        raise ValueError(f"ladder top must be >= 1, got {top}")
+    out, b = [], max(1, start)
+    while b < top:
+        out.append(b)
+        b *= 2
+    out.append(b)
+    return out
+
+
+def bucketize(n: int, ladder: Sequence[int]) -> int:
+    """Smallest ladder rung >= n; above the ladder, the next power of two
+    (an off-ladder compile — counted as a cache miss, never silent)."""
+    for b in ladder:
+        if n <= b:
+            return b
+    b = ladder[-1]
+    while b < n:
+        b *= 2
+    return b
+
+
+class ScoringSession:
+    """One resident GAME model + its pre-compiled scoring executables.
+
+    Thread-safety: ``score_rows`` is safe to call from any thread (the
+    compile cache takes a lock); the intended topology is a single
+    :class:`~photon_ml_tpu.serve.batcher.MicroBatcher` worker calling it.
+
+    Parameters:
+      model_dir: saved model directory (``io/model_io`` layout).
+      dtype: scoring dtype ("float32"/"float64" or a jnp dtype); float64
+        requires ``jax_enable_x64``.
+      max_batch: top of the row-count bucket ladder; the micro-batcher's
+        ``max_batch`` should equal it so no steady-state batch exceeds
+        the pre-compiled shapes.
+      pad_nnz: padded nonzero width per row (one per shard, clamped to
+        the shard's feature-map size). A request row with more resolved
+        features than this takes the uncompiled eager path (counted in
+        ``fixed_eager_batches``) instead of minting a new executable.
+      coeff_cache_entries: LRU capacity per random-effect coordinate.
+      warmup: pre-compile the full ladder at construction (recommended;
+        tests that exercise lazy compilation pass False).
+    """
+
+    def __init__(self, model_dir: str, *, dtype="float32",
+                 max_batch: int = 64, pad_nnz: int = 64,
+                 coeff_cache_entries: int = 4096,
+                 metrics: Optional[ServingMetrics] = None,
+                 warmup: bool = True):
+        self.model_dir = model_dir
+        self.dtype = resolve_dtype(dtype) if isinstance(dtype, str) else dtype
+        self.max_batch = int(max_batch)
+        self.metrics = metrics or ServingMetrics()
+        self.row_ladder = bucket_ladder(self.max_batch)
+        self.fixed_eager_batches = 0
+
+        meta = load_model_metadata(model_dir)
+        self.task = meta["task"]
+        self._index_maps: Dict[str, object] = {}
+        self._k_pad: Dict[str, int] = {}
+        coords: Dict[str, object] = {}
+        self._coeff_caches: Dict[str, EntityCoefficientLRU] = {}
+        for c in meta["coordinates"]:
+            shard = c["feature_shard"]
+            if shard not in self._index_maps:
+                imap = load_model_index_map(model_dir, shard)
+                self._index_maps[shard] = imap
+                self._k_pad[shard] = max(1, min(int(pad_nnz), imap.size))
+            imap = self._index_maps[shard]
+            if c["type"] == "fixed":
+                coords[c["name"]] = load_fixed_effect_coordinate(
+                    model_dir, c["name"], imap, self.task, shard)
+            else:
+                # bucketless stub: the coordinate participates in the
+                # shared scoring loop, but its per-entity coefficients
+                # come from the LRU, never from resident buckets
+                coords[c["name"]] = RandomEffectModel(
+                    c["name"], [], self.task, shard,
+                    entity_column=c.get("entity_column", ""))
+                store = ModelDirCoefficientStore(
+                    model_dir, c["name"], imap, c.get("projection"))
+                self._coeff_caches[c["name"]] = EntityCoefficientLRU(
+                    store.load, coeff_cache_entries, metrics=self.metrics)
+        self.model = GameModel(coords, self.task)
+
+        # -- device residency: one budget-accounted upload per fixed coord
+        self._resident: Dict[str, object] = {}
+        for name, coord in self.model.coordinates.items():
+            if isinstance(coord, FixedEffectModel):
+                w = np.asarray(coord.model.coefficients.means,
+                               np.dtype(self.dtype))
+                self._resident[name] = transfer_budget.device_put(
+                    w, what=f"serve.fixed[{name}]")
+
+        # -- shape-bucketed compile cache ------------------------------
+        self._compiled: Dict[tuple, object] = {}
+        self._compile_lock = threading.Lock()
+        if warmup:
+            self.warmup()
+
+    # -- compile cache -----------------------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Number of executables compiled so far (== compile-cache
+        misses); the no-steady-state-recompile tests watch this."""
+        return self.metrics.compile_cache_misses
+
+    def _executable(self, name: str, B: int, k: int):
+        """The (coordinate, rows, nnz)-shaped executable, compiling on
+        first use. The jitted callable closes over the RESIDENT device
+        coefficients, so its only arguments are the batch's padded
+        arrays; jax's own jit cache is keyed by exactly (B, k) for it,
+        which keeps our hit/miss counters faithful to real compiles."""
+        import jax
+
+        key = (name, B, k)
+        with self._compile_lock:
+            fn = self._compiled.get(key)
+            if fn is not None:
+                self.metrics.record_compile(hit=True)
+                return fn
+            self.metrics.record_compile(hit=False)
+            w_dev = self._resident[name]
+            dim = int(np.shape(w_dev)[0])
+
+            @jax.jit
+            def run(indices, values):
+                feats = SparseFeatures(indices, values, dim=dim)
+                return _margins(feats, w_dev)
+
+            dt = np.dtype(self.dtype)
+            run(jnp.zeros((B, k), jnp.int32), jnp.zeros((B, k), dt))
+            self._compiled[key] = run
+            return run
+
+    def warmup(self) -> int:
+        """Pre-compile every (fixed coordinate, row-bucket) executable so
+        steady-state traffic inside the ladder never waits on XLA.
+        Returns the number of executables compiled."""
+        before = self.metrics.compile_cache_misses
+        for name, coord in self.model.coordinates.items():
+            if not isinstance(coord, FixedEffectModel):
+                continue
+            k = self._k_pad[coord.feature_shard]
+            for B in self.row_ladder:
+                self._executable(name, B, k)
+        return self.metrics.compile_cache_misses - before
+
+    # -- scoring -----------------------------------------------------------
+    def _pad_shard(self, sp: HostSparse, B: int, k: int) -> HostSparse:
+        n, kk = sp.indices.shape
+        idx = np.zeros((B, k), np.int32)
+        val = np.zeros((B, k), np.dtype(self.dtype))
+        kc = min(kk, k)
+        idx[:n, :kc] = sp.indices[:, :kc]
+        if sp.values is not None:
+            val[:n, :kc] = sp.values[:, :kc]
+        else:
+            val[:n, :kc] = 1.0
+        return HostSparse(idx, val, sp.dim)
+
+    def _fixed_scorer(self, n: int):
+        """The ``fixed_scorer`` hook for ``score_single_batch``: route a
+        fixed coordinate through the padded, device-resident executable
+        (or the eager path for rows wider than the shard's pad width)."""
+
+        def score(name, coord, sp: HostSparse):
+            k = self._k_pad[coord.feature_shard]
+            if sp.indices.shape[1] > k and _max_live_nnz(sp) > k:
+                from photon_ml_tpu.game.scoring import fixed_effect_margins
+
+                self.fixed_eager_batches += 1
+                return fixed_effect_margins(sp, coord, self.dtype)
+            B = bucketize(max(n, 1), self.row_ladder)
+            padded = self._pad_shard(sp, B, k)
+            run = self._executable(name, B, k)
+            idx_dev = transfer_budget.device_put(
+                padded.indices, what=f"serve.batch_idx[{name}]")
+            val_dev = transfer_budget.device_put(
+                padded.values, what=f"serve.batch_val[{name}]")
+            return run(idx_dev, val_dev)[:n]
+
+        return score
+
+    def _re_views(self, name: str, coord: RandomEffectModel,
+                  entity_ids: np.ndarray, host: Dict[str, HostSparse]):
+        """(views, coeffs) for one random coordinate of one batch, from
+        cached entity coefficients — the same structures
+        ``build_model_score_views`` derives from a fully-loaded model."""
+        from photon_ml_tpu.game.data import (
+            build_score_buckets,
+            group_rows_by_slot,
+        )
+
+        cache = self._coeff_caches[name]
+        resolved = cache.get_many(entity_ids)
+        present = [eid for eid, entry in resolved.items()
+                   if entry is not None]
+        if not present:
+            return [], []
+        entity_to_slot = {eid: (0, j) for j, eid in enumerate(present)}
+        per_bucket_rows = group_rows_by_slot(
+            entity_ids, entity_to_slot, [len(present)])
+        local_maps = [[resolved[eid].local_map for eid in present]]
+        D = max(max(resolved[eid].local_dim for eid in present), 1)
+        coeffs = np.zeros((len(present), D))
+        for j, eid in enumerate(present):
+            row = resolved[eid].coefficients
+            coeffs[j, : row.shape[0]] = row
+        views = build_score_buckets(
+            host[coord.feature_shard], per_bucket_rows, local_maps)
+        return views, [coeffs]
+
+    def score_rows(self, rows: List[dict], per_coordinate: bool = False):
+        """Score a batch of request rows.
+
+        Each row is a dict: ``features`` — list of ``{"name", "term",
+        "value"}`` feature dicts (or ``(name, term, value)`` tuples);
+        ``entityIds`` — entity-column -> id for the random effects;
+        ``offset`` — optional margin offset. Returns ``np.ndarray [n]``
+        scores (plus a per-coordinate dict when requested), in row order.
+        """
+        n = len(rows)
+        if n == 0:
+            return ((np.zeros(0), {}) if per_coordinate else np.zeros(0))
+        if n > self.max_batch:
+            raise ValueError(
+                f"batch of {n} rows exceeds max_batch={self.max_batch}; "
+                "split it (the micro-batcher never sends oversized "
+                "batches)")
+        host = {shard: self._resolve_features(rows, shard)
+                for shard in self._index_maps}
+        offsets = np.asarray(
+            [float(r.get("offset") or 0.0) for r in rows],
+            np.dtype(self.dtype))
+        score_views = {}
+        for name, coord in self.model.coordinates.items():
+            if isinstance(coord, RandomEffectModel):
+                ids = self._entity_column_values(rows, coord, name)
+                score_views[name] = self._re_views(name, coord, ids, host)
+        result = score_single_batch(
+            self.model, host, score_views, offsets=offsets,
+            dtype=self.dtype, per_coordinate=per_coordinate,
+            fixed_scorer=self._fixed_scorer(n))
+        if per_coordinate:
+            total, parts = result
+            return (np.asarray(total),
+                    {k: np.asarray(v) for k, v in parts.items()})
+        return np.asarray(result)
+
+    # -- request parsing ---------------------------------------------------
+    def _resolve_features(self, rows: List[dict], shard: str) -> HostSparse:
+        """Resolve request feature names through the shard's persisted
+        index map — the same resolution (+ implicit intercept) the Avro
+        data reader applies, so served rows see the exact training-time
+        feature space. Unknown features are dropped (per-shard feature
+        selection, as in the batch path)."""
+        imap = self._index_maps[shard]
+        intercept = imap.intercept_index
+        parsed: List[List[tuple]] = []
+        for r in rows:
+            out = []
+            for feat in r.get("features") or ():
+                if isinstance(feat, dict):
+                    name, term, value = (feat["name"], feat.get("term", ""),
+                                         feat.get("value", 1.0))
+                else:
+                    name, term, value = feat
+                idx = imap.index_of(str(name), str(term))
+                if idx is not None:
+                    out.append((idx, float(value)))
+            if intercept is not None and intercept >= 0:
+                out.append((intercept, 1.0))
+            parsed.append(out)
+        k = max(max((len(p) for p in parsed), default=0), 1)
+        indices = np.zeros((len(rows), k), np.int32)
+        values = np.zeros((len(rows), k))
+        for i, p in enumerate(parsed):
+            for j, (idx, val) in enumerate(p):
+                indices[i, j] = idx
+                values[i, j] = val
+        return HostSparse(indices, values, imap.size)
+
+    @staticmethod
+    def _entity_column_values(rows: List[dict], coord: RandomEffectModel,
+                              name: str) -> np.ndarray:
+        """Per-row entity ids for one random coordinate; a row without an
+        id for this effect gets a sentinel no real id can equal, so it
+        falls into the fixed-effect-only path."""
+        keys = [k for k in (coord.entity_column, name, coord.effect_name)
+                if k]
+        out = []
+        for r in rows:
+            ids = r.get("entityIds") or {}
+            val = None
+            for key in keys:
+                if key in ids:
+                    val = ids[key]
+                    break
+            out.append("\x00<no-entity>" if val is None else str(val))
+        return np.asarray(out)
+
+    # -- introspection -----------------------------------------------------
+    def coeff_cache_stats(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {"hits": c.hits, "misses": c.misses,
+                   "evictions": c.evictions, "size": len(c),
+                   "hit_rate": c.hit_rate}
+            for name, c in self._coeff_caches.items()
+        }
+
+
+def _max_live_nnz(sp: HostSparse) -> int:
+    """Widest row by LIVE (nonzero-value) entries — rows narrower than
+    the storage width still fit the compiled pad width."""
+    if sp.values is None:
+        return sp.indices.shape[1]
+    return int((np.asarray(sp.values) != 0).sum(axis=1).max(initial=0))

@@ -48,6 +48,58 @@ def test_batched_scoring_matches_full(tmp_path, rng):
         assert abs(full[uid] - batched[uid]) < 1e-9
 
 
+def test_batch_rows_must_be_positive(tmp_path, rng, capsys):
+    """--batch-rows 0/negative is an argparse error (exit 2, clear
+    message) — a negative step used to silently score zero chunks and
+    IndexError mid-write with the output half-streamed."""
+    import pytest as _pytest
+
+    _fixture(tmp_path, rng)
+    out = tmp_path / "model"
+    assert glm_main([
+        "--train-data", str(tmp_path / "train.avro"),
+        "--output-dir", str(out), "--reg-weights", "1.0",
+    ]) == 0
+    for bad in ("0", "-5"):
+        with _pytest.raises(SystemExit) as exc:
+            score_main([
+                "--data", str(tmp_path / "train.avro"),
+                "--model-dir", str(out / "best"),
+                "--output-dir", str(tmp_path / "scores-bad"),
+                "--batch-rows", bad,
+            ])
+        assert exc.value.code == 2
+        assert "positive integer" in capsys.readouterr().err
+
+
+def test_empty_input_writes_valid_empty_output(tmp_path, rng):
+    """An empty scoring set produces a COMPLETE, readable scores.avro
+    with zero records (evaluation skipped) on both the resident and
+    batched paths."""
+    _fixture(tmp_path, rng)
+    out = tmp_path / "model"
+    assert glm_main([
+        "--train-data", str(tmp_path / "train.avro"),
+        "--output-dir", str(out), "--reg-weights", "1.0",
+    ]) == 0
+    write_training_examples(str(tmp_path / "empty.avro"), iter([]),
+                            labels=None)
+    for extra, dirname in (([], "scores-empty"),
+                           (["--batch-rows", "16"], "scores-empty-b"),
+                           (["--out-of-core"], "scores-empty-ooc")):
+        sout = tmp_path / dirname
+        assert score_main([
+            "--data", str(tmp_path / "empty.avro"),
+            "--model-dir", str(out / "best"),
+            "--output-dir", str(sout),
+            "--evaluators", "auc",
+        ] + extra) == 0, extra
+        recs, _ = read_avro_file(str(sout / "scores.avro"))
+        assert recs == []
+        log_text = (sout / "photon.log.jsonl").read_text()
+        assert '"num_scored": 0' in log_text
+
+
 def test_scoring_hashed_model(tmp_path, rng):
     _fixture(tmp_path, rng)
     out = tmp_path / "model"

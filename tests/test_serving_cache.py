@@ -1,0 +1,157 @@
+"""Entity-coefficient LRU: eviction order, counters, negative caching,
+model-dir backing store, cold-entity fallback parity, and behaviour on
+models with zero random-effect coordinates."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.conftest import serving_rows
+
+
+def _fake_loader(store):
+    from photon_ml_tpu.serve.coeff_cache import CoeffEntry
+
+    def load(eid):
+        if eid in store:
+            return CoeffEntry({0: 0}, np.asarray(store[eid]))
+        return None
+
+    return load
+
+
+def test_lru_eviction_order():
+    from photon_ml_tpu.serve import EntityCoefficientLRU
+
+    loads = []
+
+    def loader(eid):
+        loads.append(eid)
+        return _fake_loader({e: [1.0] for e in "abcdef"})(eid)
+
+    cache = EntityCoefficientLRU(loader, capacity=3)
+    for eid in ("a", "b", "c"):
+        cache.get(eid)
+    assert cache.cached_ids() == ["a", "b", "c"]
+    cache.get("a")  # refresh 'a' -> 'b' is now LRU
+    cache.get("d")  # evicts 'b'
+    assert cache.cached_ids() == ["c", "a", "d"]
+    assert cache.evictions == 1
+    cache.get("b")  # cold again: must reload
+    assert loads.count("b") == 2
+    assert cache.cached_ids() == ["a", "d", "b"]
+
+
+def test_lru_hit_miss_counters_and_negative_caching():
+    from photon_ml_tpu.serve import EntityCoefficientLRU
+    from photon_ml_tpu.serve.metrics import ServingMetrics
+
+    loads = []
+
+    def loader(eid):
+        loads.append(eid)
+        return _fake_loader({"x": [2.0]})(eid)
+
+    metrics = ServingMetrics()
+    cache = EntityCoefficientLRU(loader, capacity=4, metrics=metrics)
+    assert cache.get("x").coefficients[0] == 2.0
+    assert cache.get("x") is not None
+    assert cache.get("ghost") is None  # absent -> negative entry
+    assert cache.get("ghost") is None  # ... served from cache
+    assert (cache.hits, cache.misses) == (2, 2)
+    assert loads == ["x", "ghost"]  # one load each, negatives included
+    assert cache.hit_rate == 0.5
+    snap = metrics.snapshot()
+    assert snap["coeff_cache_hits"] == 2
+    assert snap["coeff_cache_misses"] == 2
+    # get_many deduplicates within a batch
+    out = cache.get_many(["x", "x", "ghost", "y"])
+    assert set(out) == {"x", "ghost", "y"}
+    assert cache.capacity == 4
+    with pytest.raises(ValueError):
+        EntityCoefficientLRU(loader, capacity=0)
+
+
+def test_model_dir_store_matches_loaded_model(saved_game_model):
+    """A store entry decodes to exactly the loaded model's per-entity
+    global-space coefficients."""
+    from photon_ml_tpu.io.model_io import load_model_index_map
+    from photon_ml_tpu.serve import ModelDirCoefficientStore
+
+    model_dir, bundle = saved_game_model
+    store = ModelDirCoefficientStore(
+        model_dir, "per-user", load_model_index_map(model_dir, "u"))
+    re_model = bundle["loaded"]["per-user"]
+    for eid in list(store.known_ids())[:4]:
+        entry = store.load(eid)
+        dense = np.zeros(bundle["d_re"])
+        for gid, slot in entry.local_map.items():
+            dense[gid] = entry.coefficients[slot]
+        ref = re_model.coefficients_for(eid)
+        np.testing.assert_allclose(dense[: len(ref)], ref, atol=1e-12)
+    assert store.load("no-such-entity") is None
+
+
+def test_cold_entity_fallback_parity(saved_game_model):
+    """With a capacity-1 LRU every batch churns the cache, and an unknown
+    entity must score EXACTLY like the batch scorer's fixed-effect-only
+    fallback."""
+    from photon_ml_tpu.game.scoring import score_game_model
+    from photon_ml_tpu.serve import ScoringSession
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, dtype="float64", max_batch=16,
+                             coeff_cache_entries=1, warmup=False)
+    idx = list(range(12))
+    uid = bundle["uid"].astype(str).copy()
+    uid[idx[0]] = "cold-unknown"
+    rows = serving_rows(bundle, idx, entity_ids=uid)
+    got = session.score_rows(rows)
+    ref = score_game_model(
+        bundle["loaded"],
+        {"g": bundle["Xg"][idx], "u": bundle["Xu"][idx]},
+        {"userId": np.asarray([str(uid[i]) for i in idx])},
+        dtype=jnp.float64)
+    np.testing.assert_allclose(got, np.asarray(ref), atol=1e-9)
+    stats = session.coeff_cache_stats()["per-user"]
+    assert stats["size"] <= 1  # capacity respected under churn
+    assert stats["evictions"] > 0
+    # the unknown entity's row equals fixed margins alone
+    _, parts = session.score_rows([rows[0]], per_coordinate=True)
+    assert parts["per-user"][0] == 0.0
+
+
+def test_zero_random_effect_model(tmp_path):
+    """A model with no random-effect coordinates serves without any
+    coefficient cache: no cache stats, flat hit rate, correct scores."""
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.models import (
+        Coefficients,
+        FixedEffectModel,
+        GameModel,
+        GeneralizedLinearModel,
+    )
+    from photon_ml_tpu.serve import ScoringSession
+
+    w = np.asarray([0.5, -1.0, 2.0])
+    model = GameModel({
+        "fixed": FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(jnp.asarray(w)), "logistic"),
+            "g"),
+    }, "logistic")
+    out = str(tmp_path / "fixed-only")
+    save_game_model(model, out, {"g": IndexMap({f"g{j}": j
+                                                for j in range(3)})})
+    session = ScoringSession(out, dtype="float64", max_batch=8)
+    assert session.coeff_cache_stats() == {}
+    rows = [{"features": [{"name": "g0", "value": 2.0},
+                          {"name": "g2", "value": -1.0}],
+             "entityIds": {"userId": "7"}}]  # ids tolerated, ignored
+    got = session.score_rows(rows)
+    np.testing.assert_allclose(got, [2.0 * 0.5 + (-1.0) * 2.0], atol=1e-12)
+    snap = session.metrics.snapshot()
+    assert snap["coeff_cache_hits"] == 0
+    assert snap["coeff_cache_misses"] == 0
+    assert snap["coeff_cache_hit_rate"] == 0.0

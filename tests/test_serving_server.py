@@ -1,0 +1,260 @@
+"""Scoring service + HTTP server: in-process (no-socket) endpoint tests,
+the load-shedding status contract, one real-HTTP smoke test, and a slow
+concurrency soak (excluded from tier-1 via the ``slow`` marker)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.conftest import serving_rows
+
+
+@pytest.fixture
+def service(saved_game_model):
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, dtype="float64", max_batch=16,
+                             coeff_cache_entries=16)
+    batcher = MicroBatcher(session.score_rows, max_batch=16,
+                           max_delay_ms=2.0, max_queue=32,
+                           metrics=session.metrics)
+    svc = ScoringService(session, batcher, request_timeout_s=30.0)
+    yield svc, bundle
+    svc.close()
+
+
+def test_score_endpoint_in_process(service):
+    from photon_ml_tpu.game.scoring import score_game_model
+
+    svc, bundle = service
+    idx = list(range(6))
+    rows = serving_rows(bundle, idx)
+    for pos, r in enumerate(rows):
+        r["uid"] = f"req-{pos}"
+    status, body = svc.handle_score({"rows": rows, "perCoordinate": True})
+    assert status == 200
+    ref = score_game_model(
+        bundle["loaded"],
+        {"g": bundle["Xg"][idx], "u": bundle["Xu"][idx]},
+        {"userId": np.asarray([str(bundle["uid"][i]) for i in idx])},
+        dtype=jnp.float64)
+    np.testing.assert_allclose(body["scores"], np.asarray(ref), atol=1e-9)
+    assert body["uids"] == [f"req-{p}" for p in range(6)]
+    assert set(body["scoreComponents"]) == {"fixed", "per-user"}
+
+
+def test_malformed_requests_are_400(service):
+    svc, _ = service
+    for payload in (None, [], {"rows": "nope"}, {"rows": []},
+                    {"rows": [42]}):
+        status, body = svc.handle_score(payload)
+        assert status == 400, payload
+        assert "error" in body
+    # oversized single request: explicit 400, not a hang or a shed
+    status, body = svc.handle_score(
+        {"rows": [{"features": []} for _ in range(17)]})
+    assert status == 400
+    assert "max_batch" in body["error"]
+
+
+def test_healthz_and_metrics_surface(service):
+    svc, bundle = service
+    svc.handle_score({"rows": serving_rows(bundle, [0, 1])})
+    status, health = svc.handle_healthz()
+    assert status == 200
+    assert health["status"] == "ok"
+    assert health["task"] == "logistic"
+    status, text = svc.handle_metrics()
+    assert status == 200
+    for series in (
+        "photon_serve_requests_total",
+        "photon_serve_request_latency_ms_bucket",
+        "photon_serve_queue_depth",
+        "photon_serve_batch_fill_ratio",
+        "photon_serve_compile_cache_hit_rate",
+        "photon_serve_coeff_cache_hit_rate",
+        "photon_serve_shed_total",
+    ):
+        assert series in text, f"missing {series} in /metrics"
+    snap = svc.metrics.snapshot()
+    assert snap["requests_total"] >= 1
+    assert snap["rows_total"] >= 2
+    assert 0 < snap["batch_fill_ratio"] <= 1.0
+
+
+def test_queue_full_is_429(saved_game_model):
+    """The bounded queue surfaces as HTTP 429 with shed=true — the
+    load-shedding contract, asserted without hangs."""
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, max_batch=4, warmup=False)
+    release = threading.Event()
+
+    def blocked(rows, per_coordinate=False):
+        release.wait(10.0)
+        return session.score_rows(rows, per_coordinate)
+
+    batcher = MicroBatcher(blocked, max_batch=4, max_delay_ms=1.0,
+                           max_queue=1, metrics=session.metrics)
+    svc = ScoringService(session, batcher, request_timeout_s=30.0)
+    rows = serving_rows(bundle, [0])
+    try:
+        holder = batcher.submit(rows)  # worker takes it, blocks
+        import time
+
+        time.sleep(0.05)
+        batcher.submit(rows)  # fills the queue
+        status, body = svc.handle_score({"rows": rows})
+        assert status == 429
+        assert body["shed"] is True
+        assert svc.metrics.snapshot()["shed_total"] == 1
+        release.set()
+        holder.result(10.0)
+    finally:
+        release.set()
+        svc.close()
+
+
+def test_http_smoke(service):
+    """One REAL-socket test: the stdlib server answers /score, /healthz,
+    /metrics, and 404s unknown paths over the wire."""
+    from photon_ml_tpu.serve import ScoringServer
+
+    svc, bundle = service
+    server = ScoringServer(svc, port=0).start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        rows = serving_rows(bundle, [0, 1, 2])
+        req = urllib.request.Request(
+            url + "/score",
+            data=json.dumps({"rows": rows}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+        assert len(body["scores"]) == 3
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            assert b"photon_serve_requests_total" in r.read()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url + "/nope", timeout=30)
+        assert err.value.code == 404
+        # bad JSON -> 400 over the wire
+        bad = urllib.request.Request(
+            url + "/score", data=b"{not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=30)
+        assert err.value.code == 400
+    finally:
+        server._httpd.shutdown()
+        server._httpd.server_close()
+
+
+def test_serving_driver_build(saved_game_model):
+    """The CLI driver wires session/batcher/server from args (ephemeral
+    port) and rejects non-positive sizing flags."""
+    from photon_ml_tpu.cli.serving_driver import build_arg_parser, build_server
+
+    model_dir, bundle = saved_game_model
+    parser = build_arg_parser()
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--model-dir", model_dir, "--max-batch", "0"])
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--model-dir", model_dir, "--max-queue", "-1"])
+    args = parser.parse_args([
+        "--model-dir", model_dir, "--port", "0", "--max-batch", "8",
+        "--watchdog-s", "0",  # <= 0 disables the watchdog
+    ])
+    server = build_server(args)
+    try:
+        assert server.port > 0
+        assert server.service.batcher.watchdog_s is None
+        assert server.service.session.compile_count >= 1  # warmed up
+        status, body = server.service.handle_score(
+            {"rows": serving_rows(bundle, [0, 1])})
+        assert status == 200 and len(body["scores"]) == 2
+    finally:
+        server.close()
+
+
+@pytest.mark.slow
+def test_concurrency_soak(saved_game_model):
+    """Long leg: many client threads hammering the HTTP server; every
+    non-shed response must be correct, metrics must reconcile, and the
+    compile cache must stay flat after warmup."""
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringServer,
+        ScoringService,
+        ScoringSession,
+    )
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, dtype="float64", max_batch=16)
+    warm = session.compile_count
+    batcher = MicroBatcher(session.score_rows, max_batch=16,
+                           max_delay_ms=2.0, max_queue=128,
+                           metrics=session.metrics)
+    svc = ScoringService(session, batcher)
+    server = ScoringServer(svc, port=0).start()
+    url = f"http://127.0.0.1:{server.port}/score"
+    rng = np.random.default_rng(5)
+    errors, shed, ok = [], [0], [0]
+
+    def client(seed):
+        r = np.random.default_rng(seed)
+        for _ in range(25):
+            n = int(r.integers(1, 5))
+            idx = r.integers(0, len(bundle["uid"]), n)
+            rows = serving_rows(bundle, idx)
+            req = urllib.request.Request(
+                url, data=json.dumps({"rows": rows}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    body = json.loads(resp.read())
+                    if len(body["scores"]) != n:
+                        errors.append("row-count mismatch")
+                    ok[0] += 1
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    shed[0] += 1
+                else:
+                    errors.append(f"http {e.code}")
+            except Exception as e:  # noqa: BLE001 - soak must report all
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    try:
+        assert not errors, errors[:5]
+        assert ok[0] + shed[0] == 8 * 25
+        assert ok[0] > 0
+        assert session.compile_count == warm, "soak must not recompile"
+        snap = svc.metrics.snapshot()
+        assert snap["requests_total"] == ok[0]
+        assert snap["shed_total"] == shed[0]
+    finally:
+        server.close()

@@ -1,0 +1,170 @@
+"""ScoringSession: parity with the batch scorer, shape-bucketed compile
+cache (no steady-state recompiles), transfer-budget routing, and the
+shared score_single_batch entry point."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.conftest import serving_rows
+
+
+def _reference_scores(bundle, idx, entity_ids=None, offsets=None,
+                      per_coordinate=False):
+    from photon_ml_tpu.game.scoring import score_game_model
+
+    uid = bundle["uid"] if entity_ids is None else entity_ids
+    return score_game_model(
+        bundle["loaded"],
+        {"g": bundle["Xg"][idx], "u": bundle["Xu"][idx]},
+        {"userId": np.asarray([str(uid[i]) for i in idx])},
+        offsets=offsets, dtype=jnp.float64,
+        per_coordinate=per_coordinate,
+    )
+
+
+def test_session_parity_float64(saved_game_model):
+    """Serving scores == batch scores to <= 1e-9 in float64, including
+    rows of an entity the model has never seen (fixed-effect fallback)."""
+    from photon_ml_tpu.serve import ScoringSession
+
+    model_dir, bundle = saved_game_model
+    idx = list(range(24))
+    uid = bundle["uid"].astype(str).copy()
+    uid[idx[3]] = "never-seen-entity"
+    uid[idx[17]] = "another-unknown"
+    offsets = np.linspace(-0.5, 0.5, len(idx))
+
+    session = ScoringSession(model_dir, dtype="float64", max_batch=32,
+                             coeff_cache_entries=16)
+    rows = serving_rows(bundle, idx, entity_ids=uid, offsets=offsets)
+    got = session.score_rows(rows)
+    ref = np.asarray(_reference_scores(bundle, idx, entity_ids=uid,
+                                       offsets=offsets))
+    np.testing.assert_allclose(got, ref, rtol=0, atol=1e-9)
+
+
+def test_session_per_coordinate_parity(saved_game_model):
+    from photon_ml_tpu.serve import ScoringSession
+
+    model_dir, bundle = saved_game_model
+    idx = list(range(10))
+    session = ScoringSession(model_dir, dtype="float64", max_batch=16,
+                             warmup=False)
+    got, parts = session.score_rows(serving_rows(bundle, idx),
+                                    per_coordinate=True)
+    ref, ref_parts = _reference_scores(bundle, idx, per_coordinate=True)
+    np.testing.assert_allclose(got, np.asarray(ref), atol=1e-9)
+    assert set(parts) == set(ref_parts)
+    for name in parts:
+        np.testing.assert_allclose(parts[name], np.asarray(ref_parts[name]),
+                                   atol=1e-9)
+
+
+def test_no_steady_state_recompiles(saved_game_model):
+    """After warmup, 100+ requests of varying sizes inside the bucket
+    ladder leave the compile-cache miss counter flat."""
+    from photon_ml_tpu.serve import ScoringSession
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, dtype="float64", max_batch=32)
+    warm = session.compile_count
+    assert warm == len(session.row_ladder)  # one fixed coord, full ladder
+    rng = np.random.default_rng(3)
+    for _ in range(110):
+        n = int(rng.integers(1, 33))  # every size within the ladder
+        idx = rng.integers(0, len(bundle["uid"]), n)
+        session.score_rows(serving_rows(bundle, idx))
+    assert session.compile_count == warm, (
+        "steady-state request sizes within the ladder must never compile")
+    assert session.metrics.compile_cache_hits >= 110
+    assert session.fixed_eager_batches == 0
+
+
+def test_lazy_compile_counts_misses(saved_game_model):
+    from photon_ml_tpu.serve import ScoringSession
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, dtype="float64", max_batch=32,
+                             warmup=False)
+    assert session.compile_count == 0
+    session.score_rows(serving_rows(bundle, [0, 1, 2]))  # bucket 4
+    assert session.compile_count == 1
+    session.score_rows(serving_rows(bundle, [3, 4]))  # bucket 2: new shape
+    assert session.compile_count == 2
+    session.score_rows(serving_rows(bundle, [5, 6, 7]))  # bucket 4 again
+    assert session.compile_count == 2
+
+
+def test_oversized_batch_rejected(saved_game_model):
+    from photon_ml_tpu.serve import ScoringSession
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, max_batch=4, warmup=False)
+    with pytest.raises(ValueError, match="max_batch"):
+        session.score_rows(serving_rows(bundle, list(range(5))))
+    assert session.score_rows([]).shape == (0,)
+
+
+def test_uploads_routed_through_transfer_budget(saved_game_model):
+    """Every steady-state upload (and the resident coefficient upload)
+    goes through utils/transfer_budget.charge."""
+    from photon_ml_tpu.serve import ScoringSession
+    from photon_ml_tpu.utils import transfer_budget
+
+    model_dir, bundle = saved_game_model
+    charges = []
+    transfer_budget.set_activity_hook(lambda: charges.append(1))
+    try:
+        session = ScoringSession(model_dir, dtype="float64", max_batch=8,
+                                 warmup=False)
+        after_init = len(charges)
+        assert after_init >= 1  # resident fixed-effect upload
+        session.score_rows(serving_rows(bundle, [0, 1, 2]))
+        assert len(charges) > after_init  # per-batch padded uploads
+    finally:
+        transfer_budget.set_activity_hook(None)
+
+
+def test_bucket_ladder_helpers():
+    from photon_ml_tpu.serve.session import bucket_ladder, bucketize
+
+    assert bucket_ladder(64) == [1, 2, 4, 8, 16, 32, 64]
+    assert bucket_ladder(48) == [1, 2, 4, 8, 16, 32, 64]
+    assert bucket_ladder(1) == [1]
+    ladder = bucket_ladder(16)
+    assert bucketize(1, ladder) == 1
+    assert bucketize(9, ladder) == 16
+    assert bucketize(16, ladder) == 16
+    assert bucketize(17, ladder) == 32  # off-ladder: next power of two
+    with pytest.raises(ValueError):
+        bucket_ladder(0)
+
+
+def test_score_single_batch_parity(saved_game_model):
+    """Satellite: the pre-built-views entry point matches
+    score_game_model to <= 1e-9 in float64 (they share the margin math
+    by construction; this pins the contract)."""
+    from photon_ml_tpu.game.scoring import (
+        build_model_score_views,
+        score_game_model,
+        score_single_batch,
+    )
+    from photon_ml_tpu.game.data import host_sparse_from_features
+
+    _, bundle = saved_game_model
+    idx = list(range(32))
+    model = bundle["loaded"]
+    feats = {"g": bundle["Xg"][idx], "u": bundle["Xu"][idx]}
+    ids = {"userId": np.asarray([str(bundle["uid"][i]) for i in idx])}
+    ref, ref_parts = score_game_model(model, feats, ids, dtype=jnp.float64,
+                                      per_coordinate=True)
+    host = {k: host_sparse_from_features(v) for k, v in feats.items()}
+    views = build_model_score_views(model, host, ids)
+    got, parts = score_single_batch(model, host, views, dtype=jnp.float64,
+                                    per_coordinate=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-9)
+    for name in ref_parts:
+        np.testing.assert_allclose(np.asarray(parts[name]),
+                                   np.asarray(ref_parts[name]), atol=1e-9)
