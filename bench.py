@@ -434,6 +434,131 @@ def serving_main() -> None:
     print(json.dumps(record))
 
 
+def swap_main() -> None:
+    """``python bench.py swap`` — model-lifecycle hot-swap latency.
+
+    Publishes a full version plus a delta version (a handful of
+    perturbed entities) into a throwaway registry, then alternates
+    ``ScoringSession.swap`` between them 50 times on CPU, measuring:
+    swap latency (build-next-state + install), the FIRST request's
+    latency after each swap (the cold-cache cliff a swap must not
+    reintroduce), and the compile count across all swaps (the invariant:
+    0 new executables — the shape ladder survives the swap). Writes
+    ``BENCH_swap.json`` next to this file and prints the same JSON."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+
+    import jax
+
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
+    import numpy as np
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        make_game_dataset,
+    )
+    from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.registry import ModelRegistry, publish_delta
+    from photon_ml_tpu.serve import ScoringSession
+
+    rng = np.random.default_rng(0)
+    n, d_fix, d_re, n_entities = 600, 32, 8, 64
+    Xg = rng.normal(size=(n, d_fix))
+    Xu = rng.normal(size=(n, d_re))
+    uid = rng.integers(0, n_entities, n)
+    y = (rng.random(n) < 0.5).astype(float)
+    ds = make_game_dataset({"g": Xg, "u": Xu}, y,
+                           entity_ids={"userId": uid})
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                          reg_weight=1.0),
+         CoordinateConfig("per-user", coordinate_type="random",
+                          feature_shard="u", entity_column="userId",
+                          reg_type="l2", reg_weight=1.0)],
+        task="logistic")
+    model, _ = cd.run(ds)
+    root = tempfile.mkdtemp(prefix="bench-swap-")
+    model_dir = os.path.join(root, "model")
+    save_game_model(model, model_dir, {
+        "g": IndexMap({f"g{j}": j for j in range(d_fix)}),
+        "u": IndexMap({f"u{j}": j for j in range(d_re)}),
+    })
+    # delta source: same model with ~5% of entities' RE records perturbed
+    delta_dir = os.path.join(root, "model-delta")
+    shutil.copytree(model_dir, delta_dir)
+    re_path = os.path.join(delta_dir, "random-effect", "per-user",
+                           "coefficients.avro")
+    records, schema = read_avro_file(re_path)
+    for rec in records[: max(1, len(records) // 20)]:
+        for coef in rec["means"]:
+            coef["value"] *= 1.05
+    write_avro_file(re_path, records, schema)
+
+    registry = ModelRegistry(os.path.join(root, "registry"))
+    v1 = registry.publish(model_dir, set_latest=True)
+    v2 = publish_delta(registry, delta_dir, parent=v1)
+
+    max_batch = 64
+    session = ScoringSession(registry.open_version(v1),
+                             max_batch=max_batch,
+                             coeff_cache_entries=n_entities)
+    rows = [{
+        "features": (
+            [{"name": f"g{j}", "value": float(Xg[i, j])}
+             for j in range(d_fix)]
+            + [{"name": f"u{j}", "value": float(Xu[i, j])}
+               for j in range(d_re)]),
+        "entityIds": {"userId": str(uid[i])},
+    } for i in range(32)]
+    for _ in range(5):  # warm the ladder + coefficient LRU
+        session.score_rows(rows)
+
+    n_swaps = int(os.environ.get("BENCH_SWAP_REPS", 50))
+    compiles_before = session.compile_count
+    swap_ms, first_req_ms = [], []
+    for i in range(n_swaps):
+        target = v2 if i % 2 == 0 else v1
+        t0 = time.perf_counter()
+        session.swap(registry.open_version(target), version=target)
+        swap_ms.append((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        session.score_rows(rows)
+        first_req_ms.append((time.perf_counter() - t0) * 1e3)
+    recompiles = session.compile_count - compiles_before
+    swap_ms.sort()
+    first_req_ms.sort()
+
+    def pct(xs, q):
+        return round(xs[min(len(xs) - 1, int(len(xs) * q))], 3)
+
+    record = {
+        "metric": "serving_hot_swap_latency_cpu",
+        "value": pct(swap_ms, 0.5),
+        "unit": (f"ms swap p50 over {n_swaps} full<->delta swaps "
+                 f"({jax.devices()[0].platform}, d_fix={d_fix}, "
+                 f"d_re={d_re}, entities={n_entities}, batch=32; "
+                 "invariant: recompiles_across_swaps == 0)"),
+        "swap_p50_ms": pct(swap_ms, 0.5),
+        "swap_p99_ms": pct(swap_ms, 0.99),
+        "first_request_after_swap_p50_ms": pct(first_req_ms, 0.5),
+        "first_request_after_swap_p99_ms": pct(first_req_ms, 0.99),
+        "recompiles_across_swaps": recompiles,
+        "swaps": n_swaps,
+        "delta_summary": registry.manifest(v2).get("delta_summary"),
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_swap.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+    shutil.rmtree(root, ignore_errors=True)
+
+
 def _baseline() -> "tuple[float, str] | None":
     """The honest comparator for ``vs_baseline``.
 
@@ -487,5 +612,7 @@ def _baseline() -> "tuple[float, str] | None":
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "swap":
+        swap_main()
     else:
         main()

@@ -89,6 +89,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "the warm start when a prior run died on device "
                         "loss (see the RESUME marker / exit code 75)")
     p.add_argument("--save-all-models", action="store_true")
+    p.add_argument("--publish-to", default=None,
+                   help="model-registry root (registry/): publish the "
+                        "best model there as an immutable version after "
+                        "saving. The FIRST publish into an empty "
+                        "registry also sets LATEST (bootstrap); later "
+                        "versions are promoted through the gate "
+                        "(photon-model-publish --gate-data ...)")
     p.add_argument("--summarize-features", action="store_true",
                    help="write FeatureSummarizationResultAvro output")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
@@ -515,6 +522,19 @@ def main(argv: Sequence[str] | None = None) -> int:
                         r.model,
                         os.path.join(args.output_dir, "all", f"config-{gi}"),
                         index_maps)
+    if args.publish_to and is_lead:
+        from photon_ml_tpu.registry import ModelRegistry
+
+        registry = ModelRegistry(args.publish_to)
+        best_metrics = ({} if best.evaluation is None
+                        else dict(best.evaluation.metrics))
+        bootstrap = registry.read_latest(retries=1) is None
+        version = registry.publish(
+            os.path.join(args.output_dir, "best"),
+            metrics=best_metrics, set_latest=bootstrap)
+        logger.log("model_published", registry=args.publish_to,
+                   version=version, set_latest=bootstrap,
+                   metrics=best_metrics)
     # outputs are published: ANY completed run consumes the marker (not
     # only --auto-resume ones) so a later auto-resume cannot warm-start
     # from a checkpoint that predates these outputs

@@ -4,16 +4,26 @@ The fourth driver next to train/score/index: load a model ONCE, keep it
 resident (``serve/session.py``), and answer JSON scoring requests with
 micro-batching, shape-bucketed pre-compiled executables, and an
 entity-coefficient LRU. See docs/serving.md for the endpoint and
-operational contract.
+operational contract, docs/lifecycle.md for the registry integration.
 
     photon-game-serve --model-dir out/model --port 8471 \
         --max-batch 64 --max-delay-ms 5
+
+    # registry mode: serve LATEST, follow promotions, hot-swap in place
+    photon-game-serve --registry /models/registry --watch-interval-s 10
+
+Shutdown contract: SIGTERM/SIGINT stop the listener (no new requests),
+DRAIN the micro-batcher (in-flight and queued batches finish and their
+responses go out), then exit 0 — a rolling restart never kills requests
+mid-batch.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import signal
+import threading
 from typing import Sequence
 
 from photon_ml_tpu.utils import PhotonLogger, Timed
@@ -30,7 +40,18 @@ def positive_int(value: str) -> int:
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description="GAME online scoring server (TPU-native)")
-    p.add_argument("--model-dir", required=True)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model-dir",
+                     help="serve one fixed saved-model directory")
+    src.add_argument("--registry",
+                     help="model-registry root (registry/): serve the "
+                          "LATEST version and hot-swap on promotion")
+    p.add_argument("--model-version", default=None,
+                   help="with --registry: pin a specific version instead "
+                        "of LATEST (also disables the watcher)")
+    p.add_argument("--watch-interval-s", type=float, default=10.0,
+                   help="with --registry: poll LATEST this often and "
+                        "hot-swap on change; <= 0 disables the watcher")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8471,
                    help="0 binds an ephemeral port (printed at startup)")
@@ -49,16 +70,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--watchdog-s", type=float, default=60.0,
                    help="stuck-batch watchdog; <= 0 disables")
     p.add_argument("--request-timeout-s", type=float, default=30.0)
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="longest a SIGTERM/SIGINT shutdown waits for the "
+                        "micro-batcher to flush in-flight batches")
     p.add_argument("--log-dir", default=None,
-                   help="photon.log.jsonl location (default: model dir)")
+                   help="photon.log.jsonl location (default: model dir "
+                        "or registry root)")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "float64"])
     return p
 
 
 def build_server(args):
-    """Session + batcher + HTTP server from parsed args (shared with the
-    serving bench, which drives the service without the process exec)."""
+    """Session + batcher + HTTP server (+ registry) from parsed args
+    (shared with the serving bench, which drives the service without
+    the process exec). Returns (server, registry_or_None)."""
     from photon_ml_tpu.serve import (
         MicroBatcher,
         ScoringServer,
@@ -66,8 +92,22 @@ def build_server(args):
         ScoringSession,
     )
 
+    registry = None
+    if args.registry:
+        from photon_ml_tpu.registry import ModelRegistry, RegistryError
+
+        registry = ModelRegistry(args.registry)
+        version = args.model_version or registry.read_latest()
+        if version is None:
+            raise RegistryError(
+                f"registry {args.registry} has no live version; publish "
+                "and promote one (photon-model-publish) or pass "
+                "--model-version")
+        source = registry.open_version(version)
+    else:
+        source = args.model_dir
     session = ScoringSession(
-        args.model_dir, dtype=args.dtype, max_batch=args.max_batch,
+        source, dtype=args.dtype, max_batch=args.max_batch,
         pad_nnz=args.pad_nnz, coeff_cache_entries=args.coeff_cache_entries)
     batcher = MicroBatcher(
         session.score_rows, max_batch=args.max_batch,
@@ -75,31 +115,77 @@ def build_server(args):
         watchdog_s=(None if args.watchdog_s <= 0 else args.watchdog_s),
         metrics=session.metrics)
     service = ScoringService(session, batcher,
-                             request_timeout_s=args.request_timeout_s)
-    return ScoringServer(service, host=args.host, port=args.port)
+                             request_timeout_s=args.request_timeout_s,
+                             registry=registry)
+    return ScoringServer(service, host=args.host, port=args.port), registry
+
+
+def install_signal_handlers(server, signals=(signal.SIGTERM, signal.SIGINT)):
+    """Arm graceful drain: the first SIGTERM/SIGINT stops the HTTP
+    accept loop FROM A HELPER THREAD (``shutdown()`` handshakes with the
+    running ``serve_forever`` loop and would deadlock if called inside
+    the signal handler on the same thread), letting ``main`` fall
+    through to ``server.close()`` — which drains the micro-batcher —
+    and return 0. A second signal is ignored (drain is already
+    running); must be called from the main thread (CPython restriction
+    on ``signal.signal``). Returns the handler's state dict
+    (``state["signal"]`` is the signum that fired, for logging)."""
+    state = {"signal": None}
+
+    def handler(signum, frame):
+        if state["signal"] is not None:
+            return
+        state["signal"] = signum
+        threading.Thread(target=server._httpd.shutdown, daemon=True,
+                         name="photon-serve-shutdown").start()
+
+    for sig in signals:
+        signal.signal(sig, handler)
+    state["handler"] = handler
+    return state
 
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
-    log_dir = args.log_dir or args.model_dir
+    log_dir = args.log_dir or args.model_dir or args.registry
     os.makedirs(log_dir, exist_ok=True)
     logger = PhotonLogger(os.path.join(log_dir, "photon.log.jsonl"))
     logger.log("driver_start", driver="serving", args=vars(args))
     with Timed(logger, "load_and_warmup"):
-        server = build_server(args)
-    compiled = server.service.session.compile_count
+        server, registry = build_server(args)
+    session = server.service.session
+    compiled = session.compile_count
+    watcher = None
+    if (registry is not None and args.watch_interval_s > 0
+            and not args.model_version):
+        from photon_ml_tpu.serve import RegistryWatcher
+
+        watcher = RegistryWatcher(
+            registry, session, interval_s=args.watch_interval_s,
+            on_swap=lambda v: logger.log("hot_swap", version=v,
+                                         source="watcher"),
+            on_error=lambda e: logger.log("watch_error", error=str(e)),
+        ).start()
     logger.log("serving_ready", host=server.host, port=server.port,
+               active_version=session.active_version,
                precompiled_executables=compiled)
-    print(f"serving {args.model_dir} on http://{server.host}:{server.port} "
+    print(f"serving {session.active_version} on "
+          f"http://{server.host}:{server.port} "
           f"({compiled} pre-compiled executables; POST /score, "
-          "GET /healthz, GET /metrics)", flush=True)
+          "POST /admin/reload, GET /healthz, GET /metrics)", flush=True)
+    stop = install_signal_handlers(server)
     try:
         server.serve_forever()
-    except KeyboardInterrupt:
+    except KeyboardInterrupt:  # pre-handler window / non-main-thread use
         pass
     finally:
-        server.close()
-        logger.log("driver_done",
+        if watcher is not None:
+            watcher.stop()
+        if stop["signal"] is not None:
+            logger.log("draining", signal=int(stop["signal"]),
+                       queue_depth=server.service.batcher.queue_depth)
+        server.close(drain_timeout_s=args.drain_timeout_s)
+        logger.log("driver_done", drained=True,
                    **server.service.metrics.snapshot())
         logger.close()
     return 0

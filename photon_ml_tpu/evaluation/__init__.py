@@ -2,6 +2,7 @@ from photon_ml_tpu.evaluation.evaluators import (
     Evaluator,
     EvaluationResults,
     get_evaluator,
+    is_regression,
     auc,
     rmse,
     logistic_loss_metric,

@@ -205,6 +205,24 @@ def precision_at_k(k: int):
     return fn
 
 
+def is_regression(evaluator: Evaluator, candidate: float, live: float,
+                  tolerance: float = 0.0) -> bool:
+    """True when ``candidate`` is worse than ``live`` by more than
+    ``tolerance`` in the metric's own units — the promotion gate's
+    refusal predicate (registry/gate.py). Fails safe on NaN: a candidate
+    that could not be evaluated regresses; a live side that could not be
+    evaluated cannot block the candidate."""
+    import math
+
+    if math.isnan(candidate):
+        return not math.isnan(live)
+    if math.isnan(live):
+        return False
+    delta = (live - candidate) if evaluator.higher_is_better else (
+        candidate - live)
+    return delta > tolerance
+
+
 _BASE = {
     "auc": Evaluator("auc", auc, higher_is_better=True,
                      grouped_fn=grouped_auc),

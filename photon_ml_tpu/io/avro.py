@@ -15,6 +15,7 @@ raw zlib per the spec).
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
@@ -23,7 +24,6 @@ import zlib
 from typing import Any, BinaryIO, Iterable, Iterator, List
 
 MAGIC = b"Obj\x01"
-DEFAULT_SYNC = os.urandom  # called per file
 
 PRIMITIVES = {"null", "boolean", "int", "long", "float", "double", "bytes", "string"}
 
@@ -307,7 +307,13 @@ def write_avro_file(
     schema = parse_schema(schema)
     if codec not in ("null", "deflate"):
         raise ValueError(f"unsupported codec '{codec}' (null|deflate)")
-    sync = os.urandom(16)
+    # Deterministic sync marker (schema digest) instead of os.urandom:
+    # readers never SCAN for the marker (blocks are length-prefixed; the
+    # 16 bytes after each block are compared, not searched), so the only
+    # property that matters is stability — and determinism makes two
+    # saves of the same model byte-identical, which the registry's
+    # per-artifact content fingerprints and delta diffing rely on.
+    sync = hashlib.md5(dump_schema(schema).encode()).digest()
     with open(path, "wb") as f:
         f.write(MAGIC)
         meta = {

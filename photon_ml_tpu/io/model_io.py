@@ -74,15 +74,21 @@ def save_game_model(
     crash in that window leaves the previous COMPLETE tree at
     '{path}.old-{pid}', which checkpoint discovery counts as its base
     name (game_training_driver._latest_checkpoint)."""
+    import shutil
+
     tmp = f"{directory}.tmp-{os.getpid()}"
     if os.path.isdir(tmp):
-        import shutil
-
         shutil.rmtree(tmp)
-    _save_game_model_tree(model, tmp, index_maps)
+    try:
+        _save_game_model_tree(model, tmp, index_maps)
+    except BaseException:
+        # an interrupted save must leave NOTHING a loader, the registry,
+        # or checkpoint discovery could ingest — not even the tmp tree
+        # (a crash that skips this unwind leaves only a '.tmp-' name,
+        # which every consumer already ignores)
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     if os.path.isdir(directory):  # overwrite: swap out the old tree
-        import shutil
-
         old = f"{directory}.old-{os.getpid()}"
         os.rename(directory, old)
         os.rename(tmp, directory)
@@ -96,11 +102,17 @@ def _save_game_model_tree(
     directory: str,
     index_maps: IndexMap | Dict[str, IndexMap],
 ) -> None:
+    from photon_ml_tpu.parallel import fault_injection
+
     if not isinstance(index_maps, dict):  # any IndexMap-like backend
         index_maps = {"global": index_maps}
     os.makedirs(directory, exist_ok=True)
     meta = {"task": model.task, "coordinates": []}
     for name, coord in model.coordinates.items():
+        # injection site: a crash mid-save (device loss during the d2h
+        # reads, SIGKILL) — the tier-1 crash-safety test arms this and
+        # asserts no half-written tree is ever visible at the final path
+        fault_injection.check("model_io.save_coordinate")
         imap = index_maps[coord.feature_shard]
         inverse = imap.inverse()
         if isinstance(coord, FixedEffectModel):
@@ -176,6 +188,9 @@ def _save_game_model_tree(
             meta["coordinates"].append(entry)
         # persist the shard's index map alongside the model
         imap.save(os.path.join(directory, f"index-map.{coord.feature_shard}.json"))
+    # last write wins: metadata.json is the completeness marker loaders
+    # look for, so it lands only after every coefficient file
+    fault_injection.check("model_io.save_metadata")
     with open(os.path.join(directory, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=2)
 

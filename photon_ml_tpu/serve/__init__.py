@@ -30,15 +30,18 @@ from photon_ml_tpu.serve.batcher import (
 )
 from photon_ml_tpu.serve.coeff_cache import (
     EntityCoefficientLRU,
+    LayeredCoefficientStore,
     ModelDirCoefficientStore,
 )
 from photon_ml_tpu.serve.metrics import Histogram, ServingMetrics
 from photon_ml_tpu.serve.session import ScoringSession
 from photon_ml_tpu.serve.server import ScoringService, ScoringServer
+from photon_ml_tpu.serve.watcher import RegistryWatcher
 
 __all__ = [
     "ScoringSession", "MicroBatcher", "QueueFullError",
     "BatchWatchdogTimeout", "EntityCoefficientLRU",
-    "ModelDirCoefficientStore", "Histogram", "ServingMetrics",
-    "ScoringService", "ScoringServer",
+    "LayeredCoefficientStore", "ModelDirCoefficientStore", "Histogram",
+    "ServingMetrics", "ScoringService", "ScoringServer",
+    "RegistryWatcher",
 ]

@@ -32,7 +32,8 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["CoeffEntry", "EntityCoefficientLRU", "ModelDirCoefficientStore"]
+__all__ = ["CoeffEntry", "EntityCoefficientLRU", "LayeredCoefficientStore",
+           "ModelDirCoefficientStore"]
 
 
 class CoeffEntry:
@@ -116,6 +117,34 @@ class ModelDirCoefficientStore:
         return None  # pragma: no cover - known_ids guarantees a record
 
 
+class LayeredCoefficientStore:
+    """Delta-chain resolution for per-entity coefficients: stores are
+    ordered topmost (newest delta layer) first, and an entity resolves
+    from the FIRST layer that knows it — a delta version's changed
+    entities shadow the parent's records while untouched entities fall
+    through to the parent chain (registry/delta.py). Same
+    ``load``/``known_ids`` surface as :class:`ModelDirCoefficientStore`,
+    so the LRU cannot tell a delta view from a full model."""
+
+    def __init__(self, stores: Sequence):
+        if not stores:
+            raise ValueError("layered store needs at least one layer")
+        self.stores = list(stores)
+
+    def known_ids(self) -> frozenset:
+        out: frozenset = frozenset()
+        for s in self.stores:
+            out = out | s.known_ids()
+        return out
+
+    def load(self, entity_id: str) -> Optional[CoeffEntry]:
+        key = str(entity_id)
+        for s in self.stores:
+            if key in s.known_ids():
+                return s.load(key)
+        return None
+
+
 class EntityCoefficientLRU:
     """Bounded LRU over :class:`CoeffEntry` payloads (negative entries
     included). ``loader`` is any ``entity_id -> CoeffEntry | None``
@@ -167,6 +196,33 @@ class EntityCoefficientLRU:
             if self._metrics is not None:
                 self._metrics.record_coeff(misses=1, evictions=evicted)
         return entry
+
+    def prefetch(self, entity_ids) -> int:
+        """Warm the cache with ``entity_ids`` WITHOUT touching the
+        hit/miss counters — the hot-swap path seeds the new version's
+        cache from the previous cache's resident set so the first
+        post-swap requests do not pay a cold-read storm. Evictions are
+        still counted (capacity is capacity). Returns the number of ids
+        actually loaded."""
+        loaded = 0
+        for eid in entity_ids:
+            key = str(eid)
+            with self._lock:
+                if key in self._data:
+                    continue
+            entry = self._loader(key)
+            loaded += 1
+            evicted = 0
+            with self._lock:
+                self._data[key] = entry
+                self._data.move_to_end(key)
+                while len(self._data) > self.capacity:
+                    self._data.popitem(last=False)
+                    evicted += 1
+                self.evictions += evicted
+                if self._metrics is not None and evicted:
+                    self._metrics.record_coeff(evictions=evicted)
+        return loaded
 
     def get_many(self, entity_ids) -> Dict[str, Optional[CoeffEntry]]:
         """Resolve a batch of ids (deduplicated; order-preserving dict)."""

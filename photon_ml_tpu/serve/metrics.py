@@ -84,7 +84,14 @@ class ServingMetrics:
       queue_depth — gauge, current admission-queue occupancy;
       batch_fill_ratio — gauge, rolling mean of rows/max_batch per batch;
       compile_cache_{hits,misses}_total, coeff_cache_{hits,misses,
-        evictions}_total — cache counters (hit rates derive from these).
+        evictions}_total — cache counters (hit rates derive from these);
+      swaps_total / swap_latency_ms / active_version_info — the model-
+        lifecycle series: hot-swap count, build-to-install latency, and
+        a version-labeled info gauge (value constant 1; the label
+        carries the active version, the standard prometheus idiom for
+        string-valued state);
+      gate_{pass,fail}_total — promotion-gate verdicts observed by this
+        process (the gate tool and the reload path record here).
     """
 
     def __init__(self):
@@ -106,6 +113,12 @@ class ServingMetrics:
         self.coeff_cache_hits = 0
         self.coeff_cache_misses = 0
         self.coeff_cache_evictions = 0
+        # model lifecycle (registry/ + ScoringSession.swap)
+        self.swaps_total = 0
+        self.swap_latency_ms = Histogram()
+        self.active_version = ""
+        self.gate_pass_total = 0
+        self.gate_fail_total = 0
 
     # -- recording sites ---------------------------------------------------
     def record_request(self, rows: int, latency_ms: float) -> None:
@@ -148,6 +161,23 @@ class ServingMetrics:
             self.coeff_cache_misses += misses
             self.coeff_cache_evictions += evictions
 
+    def set_active_version(self, version: str) -> None:
+        with self._lock:
+            self.active_version = str(version)
+
+    def record_swap(self, version: str, latency_ms: float) -> None:
+        with self._lock:
+            self.swaps_total += 1
+            self.active_version = str(version)
+            self.swap_latency_ms.observe(latency_ms)
+
+    def record_gate(self, passed: bool) -> None:
+        with self._lock:
+            if passed:
+                self.gate_pass_total += 1
+            else:
+                self.gate_fail_total += 1
+
     # -- views -------------------------------------------------------------
     @staticmethod
     def _rate(hits: int, misses: int) -> float:
@@ -179,6 +209,11 @@ class ServingMetrics:
                 "coeff_cache_evictions": self.coeff_cache_evictions,
                 "coeff_cache_hit_rate": self._rate(
                     self.coeff_cache_hits, self.coeff_cache_misses),
+                "swaps_total": self.swaps_total,
+                "swap_latency_p50_ms": self.swap_latency_ms.quantile(0.5),
+                "active_version": self.active_version,
+                "gate_pass_total": self.gate_pass_total,
+                "gate_fail_total": self.gate_fail_total,
             }
 
     def render(self) -> str:
@@ -220,4 +255,13 @@ class ServingMetrics:
                     self.coeff_cache_evictions)
             gauge("photon_serve_coeff_cache_hit_rate", self._rate(
                 self.coeff_cache_hits, self.coeff_cache_misses))
+            counter("photon_serve_swaps_total", self.swaps_total)
+            self.swap_latency_ms.render("photon_serve_swap_latency_ms", out)
+            out.append("# TYPE photon_serve_active_version_info gauge")
+            label = (self.active_version.replace("\\", "\\\\")
+                     .replace('"', '\\"'))
+            out.append(
+                f'photon_serve_active_version_info{{version="{label}"}} 1')
+            counter("photon_serve_gate_pass_total", self.gate_pass_total)
+            counter("photon_serve_gate_fail_total", self.gate_fail_total)
             return "\n".join(out) + "\n"

@@ -7,8 +7,9 @@ Two layers, deliberately separated:
   response and status code. The tier-1 tests exercise THIS layer
   in-process (no sockets, no ports, no flakes).
 * :class:`ScoringServer` — a ``http.server.ThreadingHTTPServer`` wrapper
-  exposing ``POST /score``, ``GET /healthz``, and ``GET /metrics``
-  (Prometheus text). One real-HTTP smoke test covers the wire.
+  exposing ``POST /score``, ``POST /admin/reload``, ``GET /healthz``,
+  and ``GET /metrics`` (Prometheus text). One real-HTTP smoke test
+  covers the wire.
 
 Status-code contract (the load-shedding contract callers program
 against; see docs/serving.md):
@@ -17,7 +18,15 @@ against; see docs/serving.md):
   429 shed — admission queue full, retry with backoff (explicit
       backpressure instead of unbounded queueing latency);
   503 scoring failed; 504 batch watchdog expired (stuck execution).
-"""
+
+``/admin/reload`` drives the zero-downtime hot swap (docs/lifecycle.md):
+an empty body follows the registry's ``LATEST``; ``{"version": "vNNNNNN"}``
+pins a version (rollback = reload an older one); ``{"modelDir": path}``
+swaps to a bare model directory when no registry is configured. Replies
+200 with the active version (``"swapped": false`` when already there),
+404 for an unknown version, 409 when the registry has no live version,
+and 503 when the swap itself failed (the previous model keeps serving —
+a failed swap never tears down the live state)."""
 
 from __future__ import annotations
 
@@ -42,13 +51,16 @@ class ScoringService:
 
     def __init__(self, session: ScoringSession,
                  batcher: Optional[MicroBatcher] = None,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 registry=None):
         self.session = session
         self.metrics: ServingMetrics = session.metrics
         self.batcher = batcher or MicroBatcher(
             session.score_rows, max_batch=session.max_batch,
             metrics=self.metrics)
         self.request_timeout_s = float(request_timeout_s)
+        self.registry = registry  # optional registry.ModelRegistry
+        self._reload_lock = threading.Lock()
 
     # -- endpoints ---------------------------------------------------------
     def handle_score(self, payload) -> Tuple[int, dict]:
@@ -95,16 +107,55 @@ class ScoringService:
         return 200, {
             "status": "ok",
             "model_dir": self.session.model_dir,
+            "active_version": self.session.active_version,
             "task": self.session.task,
             "queue_depth": self.batcher.queue_depth,
             "max_batch": self.batcher.max_batch,
         }
 
+    def handle_reload(self, payload) -> Tuple[int, dict]:
+        """Hot-swap the session (``POST /admin/reload``). Serialized by
+        a lock — two concurrent reloads would race the session's
+        prev-state rollback slot; requests keep flowing either way."""
+        payload = payload if isinstance(payload, dict) else {}
+        model_dir = payload.get("modelDir")
+        version = payload.get("version")
+        with self._reload_lock:
+            if model_dir:
+                source, version = model_dir, str(model_dir)
+            elif self.registry is not None:
+                try:
+                    version = version or self.registry.read_latest()
+                except Exception as e:
+                    return 503, {"error": f"registry unreadable: {e}"}
+                if version is None:
+                    return 409, {"error": "registry has no live version "
+                                          "(nothing promoted yet)"}
+                try:
+                    source = self.registry.open_version(version)
+                except Exception as e:
+                    return 404, {"error": f"unknown version "
+                                          f"{version!r}: {e}"}
+            else:
+                return 400, {"error": "no registry configured; pass "
+                                      '{"modelDir": ...}'}
+            if (version == self.session.active_version
+                    and not payload.get("force")):
+                return 200, {"activeVersion": self.session.active_version,
+                             "swapped": False}
+            try:
+                active = self.session.swap(source, version=version)
+            except Exception as e:
+                # the old state keeps serving; surface the failure
+                return 503, {"error": f"swap failed: {e}",
+                             "activeVersion": self.session.active_version}
+        return 200, {"activeVersion": active, "swapped": True}
+
     def handle_metrics(self) -> Tuple[int, str]:
         return 200, self.metrics.render()
 
-    def close(self) -> None:
-        self.batcher.close()
+    def close(self, drain_timeout_s: float = 5.0) -> None:
+        self.batcher.close(drain_timeout_s)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -136,7 +187,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
-        if self.path != "/score":
+        if self.path not in ("/score", "/admin/reload"):
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -145,7 +196,10 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": f"bad JSON: {e}"})
             return
-        status, body = self.service.handle_score(payload)
+        if self.path == "/admin/reload":
+            status, body = self.service.handle_reload(payload)
+        else:
+            status, body = self.service.handle_score(payload)
         self._reply(status, body)
 
 
@@ -184,7 +238,7 @@ class ScoringServer:
         self._serving = True
         self._httpd.serve_forever()
 
-    def close(self) -> None:
+    def close(self, drain_timeout_s: float = 5.0) -> None:
         # shutdown() handshakes with a RUNNING serve_forever loop and
         # blocks forever without one — only call it when a loop started
         if self._serving:
@@ -192,4 +246,4 @@ class ScoringServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(5.0)
-        self.service.close()
+        self.service.close(drain_timeout_s)

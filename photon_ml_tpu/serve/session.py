@@ -4,10 +4,11 @@ A :class:`ScoringSession` loads a saved GAME model ONCE and answers
 scoring batches for as long as the process lives:
 
 * **Fixed effects resident on device.** Each fixed coordinate's
-  coefficient vector is uploaded once at construction (through
-  ``utils/transfer_budget`` — sanctioned, budget-accounted) and captured
-  by the jit executables, so steady-state requests move only the batch's
-  padded index/value arrays.
+  coefficient vector is uploaded once per model version (through
+  ``utils/transfer_budget`` — sanctioned, budget-accounted) and PASSED
+  to the jit executables as an argument, so steady-state requests move
+  only the batch's padded index/value arrays — and a hot swap to a new
+  version reuses every compiled executable (see below).
 
 * **Shape-bucketed compile cache.** XLA executables are specialized to
   input shapes, so naive serving would recompile on every new batch size
@@ -17,7 +18,11 @@ scoring batches for as long as the process lives:
   bounded POWER-OF-TWO ladder of row counts (and one fixed nnz width per
   shard), pre-compiles the whole ladder at warmup, and counts
   hits/misses so a recompile in steady state is observable (the tier-1
-  suite asserts the miss counter stays flat).
+  suite asserts the miss counter stays flat). Executables are keyed by
+  ``(coefficient dim, rows, nnz)`` — NOT by model version — and take the
+  coefficient vector as a runtime argument, which is what makes
+  :meth:`swap` recompile-free: a new version with the same feature dims
+  re-donates fresh device coefficients to the existing executables.
 
 * **Random effects through the entity LRU.** Per-entity coefficients are
   fetched from :class:`~photon_ml_tpu.serve.coeff_cache
@@ -27,11 +32,26 @@ scoring batches for as long as the process lives:
   ``game.scoring.score_single_batch`` — one margin-math code path for
   offline and online scoring. Entities without a model contribute score
   0 (fixed-effect-only fallback), identical to ``score_game_model``.
+
+* **Zero-downtime hot swap** (:meth:`swap`). All per-version state —
+  loaded metadata, index maps, resident coefficient arrays, entity
+  caches — lives in ONE immutable ``_ModelState``; a swap builds the
+  next state off to the side (uploads, cache construction, optional
+  warm-from-previous prefetch) and installs it with a single reference
+  assignment, so an in-flight ``score_rows`` keeps its consistent
+  snapshot and the next request sees the new version. The previous
+  state is retained for :meth:`rollback` until the one after next.
+  Sources: a model directory path, or a registry
+  ``ResolvedVersion`` (a chain of model-dir layers, topmost first —
+  delta versions resolve per-entity lookups down the chain through
+  ``LayeredCoefficientStore``).
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
@@ -41,7 +61,6 @@ from photon_ml_tpu.game.data import HostSparse
 from photon_ml_tpu.game.scoring import score_single_batch
 from photon_ml_tpu.io.model_io import (
     load_fixed_effect_coordinate,
-    load_model_index_map,
     load_model_metadata,
 )
 from photon_ml_tpu.models import (
@@ -51,6 +70,7 @@ from photon_ml_tpu.models import (
 )
 from photon_ml_tpu.serve.coeff_cache import (
     EntityCoefficientLRU,
+    LayeredCoefficientStore,
     ModelDirCoefficientStore,
 )
 from photon_ml_tpu.serve.metrics import ServingMetrics
@@ -85,15 +105,46 @@ def bucketize(n: int, ladder: Sequence[int]) -> int:
     return b
 
 
+class _ModelState:
+    """Everything that changes when the served model changes — installed
+    and read as one reference, never mutated after construction."""
+
+    __slots__ = ("chain", "version", "task", "index_maps", "k_pad",
+                 "model", "coeff_caches", "resident")
+
+    def __init__(self, chain, version, task, index_maps, k_pad, model,
+                 coeff_caches, resident):
+        self.chain = chain
+        self.version = version
+        self.task = task
+        self.index_maps = index_maps
+        self.k_pad = k_pad
+        self.model = model
+        self.coeff_caches = coeff_caches
+        self.resident = resident
+
+
+def _layer_with(chain: Sequence[str], rel: str) -> Optional[str]:
+    for d in chain:
+        if os.path.exists(os.path.join(d, rel)):
+            return d
+    return None
+
+
 class ScoringSession:
     """One resident GAME model + its pre-compiled scoring executables.
 
     Thread-safety: ``score_rows`` is safe to call from any thread (the
-    compile cache takes a lock); the intended topology is a single
-    :class:`~photon_ml_tpu.serve.batcher.MicroBatcher` worker calling it.
+    compile cache takes a lock, per-version state is snapshotted once
+    per call); the intended topology is a single
+    :class:`~photon_ml_tpu.serve.batcher.MicroBatcher` worker calling
+    it, with :meth:`swap` arriving from an admin endpoint or the
+    registry watcher.
 
     Parameters:
-      model_dir: saved model directory (``io/model_io`` layout).
+      model_dir: saved model directory (``io/model_io`` layout) or a
+        registry ``ResolvedVersion`` (duck-typed: ``.chain`` +
+        ``.version``).
       dtype: scoring dtype ("float32"/"float64" or a jnp dtype); float64
         requires ``jax_enable_x64``.
       max_batch: top of the row-count bucket ladder; the micro-batcher's
@@ -108,61 +159,174 @@ class ScoringSession:
         tests that exercise lazy compilation pass False).
     """
 
-    def __init__(self, model_dir: str, *, dtype="float32",
+    def __init__(self, model_dir, *, dtype="float32",
                  max_batch: int = 64, pad_nnz: int = 64,
                  coeff_cache_entries: int = 4096,
                  metrics: Optional[ServingMetrics] = None,
                  warmup: bool = True):
-        self.model_dir = model_dir
         self.dtype = resolve_dtype(dtype) if isinstance(dtype, str) else dtype
         self.max_batch = int(max_batch)
         self.metrics = metrics or ServingMetrics()
         self.row_ladder = bucket_ladder(self.max_batch)
         self.fixed_eager_batches = 0
+        self._pad_nnz = int(pad_nnz)
+        self._coeff_cache_entries = int(coeff_cache_entries)
 
-        meta = load_model_metadata(model_dir)
-        self.task = meta["task"]
-        self._index_maps: Dict[str, object] = {}
-        self._k_pad: Dict[str, int] = {}
+        # -- shape-bucketed compile cache: survives swaps by design ----
+        self._compiled: Dict[tuple, object] = {}
+        self._compile_lock = threading.Lock()
+        self._swap_lock = threading.Lock()
+        self._prev_state: Optional[_ModelState] = None
+        self._state = self._build_state(model_dir)
+        self.metrics.set_active_version(self._state.version)
+        if warmup:
+            self.warmup()
+
+    # -- per-version state -------------------------------------------------
+    def _build_state(self, source, version: Optional[str] = None
+                     ) -> _ModelState:
+        """Load one model version into an installable state: metadata,
+        index maps, eager fixed-effect coordinates (uploaded to device
+        through ``transfer_budget``), and entity-coefficient caches
+        layered down a delta chain when the source is a resolved
+        registry version."""
+        chain = (list(source.chain) if hasattr(source, "chain")
+                 else [str(source)])
+        if version is None:
+            version = getattr(source, "version", None) or chain[0]
+        meta = load_model_metadata(chain[0])
+        task = meta["task"]
+        index_maps: Dict[str, object] = {}
+        k_pad: Dict[str, int] = {}
         coords: Dict[str, object] = {}
-        self._coeff_caches: Dict[str, EntityCoefficientLRU] = {}
+        coeff_caches: Dict[str, EntityCoefficientLRU] = {}
         for c in meta["coordinates"]:
             shard = c["feature_shard"]
-            if shard not in self._index_maps:
-                imap = load_model_index_map(model_dir, shard)
-                self._index_maps[shard] = imap
-                self._k_pad[shard] = max(1, min(int(pad_nnz), imap.size))
-            imap = self._index_maps[shard]
+            if shard not in index_maps:
+                from photon_ml_tpu.io.paldb import load_index_map
+
+                layer = _layer_with(chain, f"index-map.{shard}.json")
+                if layer is None:
+                    raise FileNotFoundError(
+                        f"index-map.{shard}.json missing from every "
+                        f"layer of {chain}")
+                imap = load_index_map(
+                    os.path.join(layer, f"index-map.{shard}.json"))
+                index_maps[shard] = imap
+                k_pad[shard] = max(1, min(self._pad_nnz, imap.size))
+            imap = index_maps[shard]
             if c["type"] == "fixed":
+                rel = os.path.join("fixed-effect", c["name"],
+                                   "coefficients.avro")
+                layer = _layer_with(chain, rel)
+                if layer is None:
+                    raise FileNotFoundError(
+                        f"{rel} missing from every layer of {chain}")
                 coords[c["name"]] = load_fixed_effect_coordinate(
-                    model_dir, c["name"], imap, self.task, shard)
+                    layer, c["name"], imap, task, shard)
             else:
                 # bucketless stub: the coordinate participates in the
                 # shared scoring loop, but its per-entity coefficients
                 # come from the LRU, never from resident buckets
                 coords[c["name"]] = RandomEffectModel(
-                    c["name"], [], self.task, shard,
+                    c["name"], [], task, shard,
                     entity_column=c.get("entity_column", ""))
-                store = ModelDirCoefficientStore(
-                    model_dir, c["name"], imap, c.get("projection"))
-                self._coeff_caches[c["name"]] = EntityCoefficientLRU(
-                    store.load, coeff_cache_entries, metrics=self.metrics)
-        self.model = GameModel(coords, self.task)
+                rel = os.path.join("random-effect", c["name"],
+                                   "coefficients.avro")
+                stores = [
+                    ModelDirCoefficientStore(d, c["name"], imap,
+                                             c.get("projection"))
+                    for d in chain
+                    if os.path.exists(os.path.join(d, rel))
+                ]
+                store = (stores[0] if len(stores) == 1
+                         else LayeredCoefficientStore(stores))
+                coeff_caches[c["name"]] = EntityCoefficientLRU(
+                    store.load, self._coeff_cache_entries,
+                    metrics=self.metrics)
+        model = GameModel(coords, task)
 
-        # -- device residency: one budget-accounted upload per fixed coord
-        self._resident: Dict[str, object] = {}
-        for name, coord in self.model.coordinates.items():
+        # -- device residency: one budget-accounted upload per fixed
+        # coordinate per VERSION (swaps re-upload; executables persist)
+        resident: Dict[str, object] = {}
+        for name, coord in model.coordinates.items():
             if isinstance(coord, FixedEffectModel):
                 w = np.asarray(coord.model.coefficients.means,
                                np.dtype(self.dtype))
-                self._resident[name] = transfer_budget.device_put(
+                resident[name] = transfer_budget.device_put(
                     w, what=f"serve.fixed[{name}]")
+        return _ModelState(chain, str(version), task, index_maps, k_pad,
+                           model, coeff_caches, resident)
 
-        # -- shape-bucketed compile cache ------------------------------
-        self._compiled: Dict[tuple, object] = {}
-        self._compile_lock = threading.Lock()
-        if warmup:
-            self.warmup()
+    # -- compatibility views over the active state ------------------------
+    @property
+    def model_dir(self) -> str:
+        return self._state.chain[0]
+
+    @property
+    def model(self) -> GameModel:
+        return self._state.model
+
+    @property
+    def task(self) -> str:
+        return self._state.task
+
+    @property
+    def active_version(self) -> str:
+        return self._state.version
+
+    @property
+    def _index_maps(self):
+        return self._state.index_maps
+
+    @property
+    def _k_pad(self):
+        return self._state.k_pad
+
+    @property
+    def _coeff_caches(self):
+        return self._state.coeff_caches
+
+    # -- hot swap ----------------------------------------------------------
+    def swap(self, source, *, version: Optional[str] = None,
+             warm_from_previous: bool = True) -> str:
+        """Atomically switch to another model version with zero downtime.
+
+        Builds the whole next state off to the side — new fixed-effect
+        coefficients uploaded through ``transfer_budget``, new entity
+        caches over the new version's (possibly layered) store,
+        optionally pre-warmed with the previous caches' resident hot set
+        — then installs it with one reference assignment. The compiled
+        executables are untouched: they are keyed by shape, not version,
+        so a swap between same-dimensioned models never recompiles (the
+        tier-1 suite pins the miss counter flat across a swap). The
+        previous state is retained until the next swap so
+        :meth:`rollback` is instant."""
+        t0 = time.perf_counter()
+        new = self._build_state(source, version)
+        if warm_from_previous:
+            for name, cache in new.coeff_caches.items():
+                old = self._state.coeff_caches.get(name)
+                if old is not None:
+                    cache.prefetch(old.cached_ids())
+        with self._swap_lock:
+            self._prev_state, self._state = self._state, new
+        self.metrics.record_swap(new.version,
+                                 (time.perf_counter() - t0) * 1e3)
+        return new.version
+
+    def rollback(self) -> str:
+        """Re-install the state the last swap replaced (its warmed
+        entity caches and device arrays were retained for exactly
+        this). Counts as a swap in the metrics."""
+        t0 = time.perf_counter()
+        with self._swap_lock:
+            if self._prev_state is None:
+                raise RuntimeError("no previous version to roll back to")
+            self._prev_state, self._state = self._state, self._prev_state
+            version = self._state.version
+        self.metrics.record_swap(version, (time.perf_counter() - t0) * 1e3)
+        return version
 
     # -- compile cache -----------------------------------------------------
     @property
@@ -171,31 +335,31 @@ class ScoringSession:
         misses); the no-steady-state-recompile tests watch this."""
         return self.metrics.compile_cache_misses
 
-    def _executable(self, name: str, B: int, k: int):
-        """The (coordinate, rows, nnz)-shaped executable, compiling on
-        first use. The jitted callable closes over the RESIDENT device
-        coefficients, so its only arguments are the batch's padded
-        arrays; jax's own jit cache is keyed by exactly (B, k) for it,
-        which keeps our hit/miss counters faithful to real compiles."""
+    def _executable(self, dim: int, B: int, k: int):
+        """The (coefficient dim, rows, nnz)-shaped executable, compiling
+        on first use. The jitted callable takes the RESIDENT device
+        coefficients as an argument — jax's own jit cache is keyed by
+        the argument shapes, so our hit/miss counters stay faithful to
+        real compiles AND a hot swap's new coefficient array (same
+        shape) reuses the executable."""
         import jax
 
-        key = (name, B, k)
+        key = (dim, B, k)
         with self._compile_lock:
             fn = self._compiled.get(key)
             if fn is not None:
                 self.metrics.record_compile(hit=True)
                 return fn
             self.metrics.record_compile(hit=False)
-            w_dev = self._resident[name]
-            dim = int(np.shape(w_dev)[0])
 
             @jax.jit
-            def run(indices, values):
+            def run(w, indices, values):
                 feats = SparseFeatures(indices, values, dim=dim)
-                return _margins(feats, w_dev)
+                return _margins(feats, w)
 
             dt = np.dtype(self.dtype)
-            run(jnp.zeros((B, k), jnp.int32), jnp.zeros((B, k), dt))
+            run(jnp.zeros((dim,), dt), jnp.zeros((B, k), jnp.int32),
+                jnp.zeros((B, k), dt))
             self._compiled[key] = run
             return run
 
@@ -203,13 +367,15 @@ class ScoringSession:
         """Pre-compile every (fixed coordinate, row-bucket) executable so
         steady-state traffic inside the ladder never waits on XLA.
         Returns the number of executables compiled."""
+        st = self._state
         before = self.metrics.compile_cache_misses
-        for name, coord in self.model.coordinates.items():
+        for name, coord in st.model.coordinates.items():
             if not isinstance(coord, FixedEffectModel):
                 continue
-            k = self._k_pad[coord.feature_shard]
+            k = st.k_pad[coord.feature_shard]
+            dim = int(np.shape(st.resident[name])[0])
             for B in self.row_ladder:
-                self._executable(name, B, k)
+                self._executable(dim, B, k)
         return self.metrics.compile_cache_misses - before
 
     # -- scoring -----------------------------------------------------------
@@ -225,31 +391,33 @@ class ScoringSession:
             val[:n, :kc] = 1.0
         return HostSparse(idx, val, sp.dim)
 
-    def _fixed_scorer(self, n: int):
+    def _fixed_scorer(self, n: int, st: _ModelState):
         """The ``fixed_scorer`` hook for ``score_single_batch``: route a
         fixed coordinate through the padded, device-resident executable
         (or the eager path for rows wider than the shard's pad width)."""
 
         def score(name, coord, sp: HostSparse):
-            k = self._k_pad[coord.feature_shard]
+            k = st.k_pad[coord.feature_shard]
             if sp.indices.shape[1] > k and _max_live_nnz(sp) > k:
                 from photon_ml_tpu.game.scoring import fixed_effect_margins
 
                 self.fixed_eager_batches += 1
                 return fixed_effect_margins(sp, coord, self.dtype)
             B = bucketize(max(n, 1), self.row_ladder)
+            w_dev = st.resident[name]
             padded = self._pad_shard(sp, B, k)
-            run = self._executable(name, B, k)
+            run = self._executable(int(np.shape(w_dev)[0]), B, k)
             idx_dev = transfer_budget.device_put(
                 padded.indices, what=f"serve.batch_idx[{name}]")
             val_dev = transfer_budget.device_put(
                 padded.values, what=f"serve.batch_val[{name}]")
-            return run(idx_dev, val_dev)[:n]
+            return run(w_dev, idx_dev, val_dev)[:n]
 
         return score
 
     def _re_views(self, name: str, coord: RandomEffectModel,
-                  entity_ids: np.ndarray, host: Dict[str, HostSparse]):
+                  entity_ids: np.ndarray, host: Dict[str, HostSparse],
+                  st: _ModelState):
         """(views, coeffs) for one random coordinate of one batch, from
         cached entity coefficients — the same structures
         ``build_model_score_views`` derives from a fully-loaded model."""
@@ -258,7 +426,7 @@ class ScoringSession:
             group_rows_by_slot,
         )
 
-        cache = self._coeff_caches[name]
+        cache = st.coeff_caches[name]
         resolved = cache.get_many(entity_ids)
         present = [eid for eid, entry in resolved.items()
                    if entry is not None]
@@ -286,6 +454,7 @@ class ScoringSession:
         ``offset`` — optional margin offset. Returns ``np.ndarray [n]``
         scores (plus a per-coordinate dict when requested), in row order.
         """
+        st = self._state  # one consistent snapshot across the batch
         n = len(rows)
         if n == 0:
             return ((np.zeros(0), {}) if per_coordinate else np.zeros(0))
@@ -294,20 +463,21 @@ class ScoringSession:
                 f"batch of {n} rows exceeds max_batch={self.max_batch}; "
                 "split it (the micro-batcher never sends oversized "
                 "batches)")
-        host = {shard: self._resolve_features(rows, shard)
-                for shard in self._index_maps}
+        host = {shard: self._resolve_features(rows, shard, st)
+                for shard in st.index_maps}
         offsets = np.asarray(
             [float(r.get("offset") or 0.0) for r in rows],
             np.dtype(self.dtype))
         score_views = {}
-        for name, coord in self.model.coordinates.items():
+        for name, coord in st.model.coordinates.items():
             if isinstance(coord, RandomEffectModel):
                 ids = self._entity_column_values(rows, coord, name)
-                score_views[name] = self._re_views(name, coord, ids, host)
+                score_views[name] = self._re_views(name, coord, ids, host,
+                                                   st)
         result = score_single_batch(
-            self.model, host, score_views, offsets=offsets,
+            st.model, host, score_views, offsets=offsets,
             dtype=self.dtype, per_coordinate=per_coordinate,
-            fixed_scorer=self._fixed_scorer(n))
+            fixed_scorer=self._fixed_scorer(n, st))
         if per_coordinate:
             total, parts = result
             return (np.asarray(total),
@@ -315,13 +485,14 @@ class ScoringSession:
         return np.asarray(result)
 
     # -- request parsing ---------------------------------------------------
-    def _resolve_features(self, rows: List[dict], shard: str) -> HostSparse:
+    def _resolve_features(self, rows: List[dict], shard: str,
+                          st: _ModelState) -> HostSparse:
         """Resolve request feature names through the shard's persisted
         index map — the same resolution (+ implicit intercept) the Avro
         data reader applies, so served rows see the exact training-time
         feature space. Unknown features are dropped (per-shard feature
         selection, as in the batch path)."""
-        imap = self._index_maps[shard]
+        imap = st.index_maps[shard]
         intercept = imap.intercept_index
         parsed: List[List[tuple]] = []
         for r in rows:
@@ -372,7 +543,7 @@ class ScoringSession:
             name: {"hits": c.hits, "misses": c.misses,
                    "evictions": c.evictions, "size": len(c),
                    "hit_rate": c.hit_rate}
-            for name, c in self._coeff_caches.items()
+            for name, c in self._state.coeff_caches.items()
         }
 
 

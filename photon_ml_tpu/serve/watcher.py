@@ -1,0 +1,84 @@
+"""Registry-polling watcher: follow ``LATEST`` and hot-swap the session.
+
+The push path is ``POST /admin/reload``; this is the pull path — a
+daemon thread that polls the registry's ``LATEST`` pointer and swaps the
+resident :class:`~photon_ml_tpu.serve.session.ScoringSession` when it
+moves, so a gate promotion on another machine reaches every serving
+process without an orchestrator fanning out reload calls.
+
+Concurrent-publish tolerance (the failure mode this must survive): the
+registry's atomic-rename discipline means a COMPLETE version appears in
+one step, but the watcher can still observe (a) no ``LATEST`` yet —
+``read_latest`` already retries ENOENT briefly and then reports None,
+(b) a ``.tmp-`` staging dir next to real versions — never listed as a
+version, (c) a crashed publisher that landed a version without moving
+``LATEST`` — the pointer still names the old live version, so nothing
+swaps. Any error opening or swapping to the new version is logged,
+counted, and RETRIED on the next tick — the previous model keeps
+serving; the watcher never tears down live state on a bad poll.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+__all__ = ["RegistryWatcher"]
+
+
+class RegistryWatcher:
+    """Poll ``registry.read_latest()`` every ``interval_s`` and swap the
+    session when it names a version other than the active one.
+    ``on_swap(version)`` / ``on_error(exc)`` are optional observation
+    hooks (the serving driver logs through them)."""
+
+    def __init__(self, registry, session, interval_s: float = 10.0,
+                 on_swap: Optional[Callable[[str], None]] = None,
+                 on_error: Optional[Callable[[Exception], None]] = None):
+        self.registry = registry
+        self.session = session
+        self.interval_s = float(interval_s)
+        self.on_swap = on_swap
+        self.on_error = on_error
+        self.errors = 0
+        self.checks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self) -> Optional[str]:
+        """One poll: returns the version swapped to, or None (no change,
+        no live version yet, or a tolerated transient error)."""
+        self.checks += 1
+        try:
+            latest = self.registry.read_latest()
+            if latest is None or latest == self.session.active_version:
+                return None
+            resolved = self.registry.open_version(latest)
+            self.session.swap(resolved, version=latest)
+        except Exception as e:
+            # mid-publish registry states and swap failures are
+            # transient by construction: keep serving, retry next tick
+            self.errors += 1
+            if self.on_error is not None:
+                self.on_error(e)
+            return None
+        if self.on_swap is not None:
+            self.on_swap(latest)
+        return latest
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.check_once()
+
+    def start(self) -> "RegistryWatcher":
+        if self._thread is not None:
+            raise RuntimeError("watcher already started")
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="photon-serve-watcher")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)

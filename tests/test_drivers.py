@@ -73,10 +73,20 @@ def test_training_and_scoring_drivers_end_to_end(game_fixture):
         "--feature-shards", str(game_fixture / "shards.json"),
         "--n-iterations", "2",
         "--save-all-models", "--checkpoint",
+        "--publish-to", str(game_fixture / "registry"),
         "--dtype", "float64",
     ])
     assert rc == 0
     assert (out / "best" / "metadata.json").exists()
+    # --publish-to: the best model landed in the registry as v000001 and
+    # (first publish into an empty registry) was promoted to LATEST
+    from photon_ml_tpu.registry import ModelRegistry
+
+    reg = ModelRegistry(str(game_fixture / "registry"))
+    assert reg.list_versions() == ["v000001"]
+    assert reg.read_latest() == "v000001"
+    assert "auc" in reg.manifest("v000001")["metrics"]
+    reg.verify("v000001")
     assert (out / "all" / "config-0" / "metadata.json").exists()
     assert (out / "all" / "config-1" / "metadata.json").exists()  # grid of 2
     assert (out / "checkpoints" / "config-0-iter-0" / "metadata.json").exists()

@@ -1,0 +1,355 @@
+"""Lifecycle acceptance: publish -> gate -> hot-swap into the live scorer.
+
+The tier-1 invariants from the lifecycle issue:
+
+* across a swap the session's compile-miss counter stays FLAT (the
+  shape-ladder executables survive: they are keyed by dims, and take the
+  coefficient vector as an argument);
+* swapping to a byte-identical version leaves a fixed request's scores
+  BITWISE stable;
+* swapping to a delta version changes exactly the affected entities'
+  scores, with float64 parity <= 1e-9 against BATCH scoring of the new
+  version (load_game_model over the materialized chain);
+* the gate refuses a metric-regressing candidate and LATEST still names
+  the old version afterwards.
+"""
+
+import json
+import os
+import shutil
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.conftest import serving_rows
+from tests.test_registry import perturb_model_dir
+
+from photon_ml_tpu.registry import (
+    ModelRegistry,
+    materialize,
+    publish_delta,
+    run_gate,
+)
+from photon_ml_tpu.serve import (
+    RegistryWatcher,
+    ScoringService,
+    ScoringServer,
+    ScoringSession,
+)
+
+
+@pytest.fixture
+def registry(saved_game_model, tmp_path):
+    model_dir, _ = saved_game_model
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.publish(model_dir, set_latest=True)
+    return reg
+
+
+def _batch_reference(model_dir, bundle, idx, uid=None):
+    from photon_ml_tpu.game.scoring import score_game_model
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    uid = bundle["uid"] if uid is None else uid
+    return np.asarray(score_game_model(
+        load_game_model(model_dir),
+        {"g": bundle["Xg"][idx], "u": bundle["Xu"][idx]},
+        {"userId": np.asarray([str(uid[i]) for i in idx])},
+        dtype=jnp.float64))
+
+
+def test_identical_swap_is_bitwise_stable_and_compile_flat(
+        saved_game_model, registry):
+    model_dir, bundle = saved_game_model
+    v2 = registry.publish(model_dir, parent="v000001", set_latest=True)
+    session = ScoringSession(registry.open_version("v000001"),
+                             dtype="float64", max_batch=32,
+                             coeff_cache_entries=16)
+    assert session.active_version == "v000001"
+    idx = list(range(24))
+    rows = serving_rows(bundle, idx)
+    before = session.score_rows(rows)
+    warm = session.compile_count
+
+    swapped_to = session.swap(registry.open_version(v2), version=v2)
+    assert swapped_to == v2 == session.active_version
+    after = session.score_rows(rows)
+
+    # identical model -> identical bits, and NO new executables
+    assert np.array_equal(np.asarray(before), np.asarray(after))
+    assert session.compile_count == warm
+    snap = session.metrics.snapshot()
+    assert snap["swaps_total"] == 1
+    assert snap["active_version"] == v2
+    assert f'version="{v2}"' in session.metrics.render()
+
+
+def test_delta_swap_updates_scores_with_batch_parity(
+        saved_game_model, registry, tmp_path):
+    model_dir, bundle = saved_game_model
+    uid = bundle["uid"]
+    changed_entity = str(uid[0])
+    new_dir = perturb_model_dir(model_dir, tmp_path / "retrained",
+                                [changed_entity], scale=1.5, offset=0.25)
+    v2 = publish_delta(registry, new_dir, set_latest=True)
+
+    session = ScoringSession(registry.open_version("v000001"),
+                             dtype="float64", max_batch=32,
+                             coeff_cache_entries=16)
+    idx = list(range(32))
+    rows = serving_rows(bundle, idx)
+    before = session.score_rows(rows)
+    warm = session.compile_count
+
+    session.swap(registry.open_version(v2), version=v2)
+    after = session.score_rows(rows)
+    assert session.compile_count == warm  # delta swap: still no compiles
+
+    touched = np.asarray([str(uid[i]) == changed_entity for i in idx])
+    assert touched.any() and not touched.all()
+    # exactly the changed entity's rows move
+    assert not np.any(np.isclose(after[touched], before[touched],
+                                 rtol=0, atol=1e-12))
+    np.testing.assert_array_equal(after[~touched], before[~touched])
+
+    # float64 parity <= 1e-9 against BATCH scoring of the new version
+    resolved = materialize(registry, v2)
+    ref = _batch_reference(resolved, bundle, idx)
+    np.testing.assert_allclose(after, ref, rtol=0, atol=1e-9)
+
+    # rollback restores the previous state (retained warm caches)
+    rolled = session.rollback()
+    assert rolled == "v000001"
+    np.testing.assert_array_equal(session.score_rows(rows), before)
+    assert session.compile_count == warm
+    assert session.metrics.snapshot()["swaps_total"] == 2
+
+
+def test_admin_reload_and_watcher(saved_game_model, registry, tmp_path):
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(registry.open_version("v000001"),
+                             dtype="float64", max_batch=16,
+                             coeff_cache_entries=16)
+    service = ScoringService(session, registry=registry)
+    try:
+        # already live -> no-op
+        status, body = service.handle_reload({})
+        assert status == 200 and body["swapped"] is False
+
+        new_dir = perturb_model_dir(model_dir, tmp_path / "m2",
+                                    [str(bundle["uid"][0])])
+        v2 = publish_delta(registry, new_dir, set_latest=True)
+        status, body = service.handle_reload({})
+        assert status == 200 and body["swapped"] is True
+        assert body["activeVersion"] == v2 == session.active_version
+
+        status, body = service.handle_reload({"version": "v000999"})
+        assert status == 404
+        assert session.active_version == v2  # failed reload left it alone
+
+        # explicit pin back to the parent == rollback via the endpoint
+        status, body = service.handle_reload({"version": "v000001"})
+        assert status == 200 and body["activeVersion"] == "v000001"
+
+        # watcher: LATEST moved -> swap on the next poll
+        registry.set_latest(v2)
+        watcher = RegistryWatcher(registry, session, interval_s=60.0)
+        assert watcher.check_once() == v2
+        assert session.active_version == v2
+        assert watcher.check_once() is None  # converged
+
+        # watcher tolerates a broken/mid-publish pointer and keeps serving
+        with open(registry.latest_path, "w") as f:
+            json.dump({"version": "v009999"}, f)
+        assert watcher.check_once() is None
+        assert watcher.errors == 1
+        assert session.active_version == v2
+        rows = serving_rows(bundle, [0, 1, 2])
+        assert len(session.score_rows(rows)) == 3
+    finally:
+        service.close()
+
+
+def test_reload_without_registry_and_model_dir_swap(saved_game_model):
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, dtype="float64", max_batch=8,
+                             warmup=False)
+    service = ScoringService(session)
+    try:
+        status, body = service.handle_reload({})
+        assert status == 400
+        # same dir without force: already active -> no-op
+        status, body = service.handle_reload({"modelDir": model_dir})
+        assert status == 200 and body["swapped"] is False
+        status, body = service.handle_reload({"modelDir": model_dir,
+                                              "force": True})
+        assert status == 200 and body["swapped"] is True
+    finally:
+        service.close()
+
+
+def test_admin_reload_over_http(saved_game_model, registry, tmp_path):
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(registry.open_version("v000001"),
+                             dtype="float64", max_batch=8,
+                             coeff_cache_entries=16)
+    service = ScoringService(session, registry=registry)
+    server = ScoringServer(service, port=0).start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        new_dir = perturb_model_dir(model_dir, tmp_path / "m2",
+                                    [str(bundle["uid"][3])])
+        v2 = publish_delta(registry, new_dir, set_latest=True)
+        req = urllib.request.Request(
+            url + "/admin/reload", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body == {"activeVersion": v2, "swapped": True}
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["active_version"] == v2
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert "photon_serve_swaps_total 1" in text
+        assert f'photon_serve_active_version_info{{version="{v2}"}} 1' in text
+    finally:
+        server.close()
+
+
+# -- promotion gate ---------------------------------------------------------
+@pytest.fixture(scope="module")
+def gated_models(tmp_path_factory):
+    """A PREDICTIVE trained model (labels follow the true margins, so
+    held-out AUC is well above 0.5), a held-out labeled Avro shard, and
+    a metric-regressing candidate (negated fixed effects)."""
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig, CoordinateDescent, make_game_dataset,
+    )
+    from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+    from photon_ml_tpu.io.data_reader import write_training_examples
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+
+    root = tmp_path_factory.mktemp("gate")
+    r = np.random.default_rng(5)
+    n, d_fix, d_re, n_entities = 400, 6, 3, 8
+    Xg = r.normal(size=(n, d_fix))
+    Xu = r.normal(size=(n, d_re))
+    uid = r.integers(0, n_entities, n)
+    w = r.normal(size=d_fix) * 1.5
+    U = r.normal(size=(n_entities, d_re))
+    margins = Xg @ w + np.einsum("ij,ij->i", Xu, U[uid])
+    y = (r.random(n) < 1.0 / (1.0 + np.exp(-margins))).astype(float)
+    tr = slice(0, 300)
+    ds = make_game_dataset({"g": Xg[tr], "u": Xu[tr]}, y[tr],
+                           entity_ids={"userId": uid[tr]})
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                          reg_weight=1.0),
+         CoordinateConfig("per-user", coordinate_type="random",
+                          feature_shard="u", entity_column="userId",
+                          reg_type="l2", reg_weight=1.0)],
+        task="logistic", dtype=jnp.float64)
+    model, _ = cd.run(ds)
+    model_dir = str(root / "model")
+    save_game_model(model, model_dir, {
+        "g": IndexMap({f"g{j}": j for j in range(d_fix)}),
+        "u": IndexMap({f"u{j}": j for j in range(d_re)}),
+    })
+
+    # held-out labeled shard in the training-example layout
+    def feature_rows():
+        for i in range(300, n):
+            row = [(f"g{j}", "", float(Xg[i, j])) for j in range(d_fix)]
+            row += [(f"u{j}", "", float(Xu[i, j])) for j in range(d_re)]
+            yield row
+
+    holdout = str(root / "holdout.avro")
+    write_training_examples(holdout, feature_rows(), y[300:],
+                            entity_ids={"userId": uid[300:]},
+                            uids=[str(i) for i in range(300, n)])
+
+    # regressing candidate: negated fixed-effect coefficients
+    bad_dir = str(root / "model-bad")
+    shutil.copytree(model_dir, bad_dir)
+    fe = os.path.join(bad_dir, "fixed-effect", "fixed",
+                      "coefficients.avro")
+    records, schema = read_avro_file(fe)
+    for rec in records:
+        for coef in rec["means"]:
+            coef["value"] = -coef["value"]
+    write_avro_file(fe, records, schema)
+    return {"model_dir": model_dir, "bad_dir": bad_dir,
+            "holdout": holdout}
+
+
+def test_gate_refuses_regression_and_keeps_latest(gated_models, tmp_path):
+    from photon_ml_tpu.serve import ServingMetrics
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(gated_models["model_dir"], set_latest=True)
+    v2 = reg.publish(gated_models["bad_dir"], parent=v1)
+    sink = ServingMetrics()
+    verdict = run_gate(reg, v2, [gated_models["holdout"]],
+                       evaluators=["auc"], tolerance=0.02,
+                       metrics_sink=sink)
+    assert not verdict.passed and not verdict.promoted
+    assert "auc" in verdict.regressions
+    assert verdict.candidate_metrics["auc"] < verdict.live_metrics["auc"]
+    assert reg.read_latest() == v1  # LATEST untouched by the refusal
+    assert sink.gate_fail_total == 1
+    # the refusal is on the record, in the candidate's manifest
+    gate = reg.manifest(v2)["gate"]
+    assert gate["passed"] is False and gate["promoted"] is False
+    assert gate["against"] == v1 and "auc" in gate["regressions"]
+
+
+def test_gate_promotes_non_regressing_delta(gated_models, tmp_path):
+    from photon_ml_tpu.io.avro import read_avro_file
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(gated_models["model_dir"], set_latest=True)
+    # a tiny delta (one entity nudged) does not move held-out AUC beyond
+    # a loose tolerance -> gate passes and promotes
+    records, _ = read_avro_file(os.path.join(
+        gated_models["model_dir"], "random-effect", "per-user",
+        "coefficients.avro"))
+    some_entity = str(records[0]["modelId"])
+    new_dir = perturb_model_dir(gated_models["model_dir"],
+                                tmp_path / "m2", [some_entity],
+                                scale=1.01, offset=0.0)
+    v2 = publish_delta(reg, new_dir)
+    assert reg.read_latest() == v1
+    verdict = run_gate(reg, v2, [gated_models["holdout"]],
+                       evaluators=["auc"], tolerance=0.05)
+    assert verdict.passed and verdict.promoted
+    assert reg.read_latest() == v2
+    gate = reg.manifest(v2)["gate"]
+    assert gate["passed"] and gate["promoted"]
+    # default evaluator resolution (task -> auc) also works
+    v3 = publish_delta(reg, new_dir, parent=v2)
+    verdict = run_gate(reg, v3, [gated_models["holdout"]],
+                       tolerance=0.05)
+    assert set(verdict.candidate_metrics) == {"auc"}
+
+
+def test_publish_driver_gate_exit_codes(gated_models, tmp_path, capsys):
+    from photon_ml_tpu.cli.model_publish_driver import main as publish_main
+
+    root = str(tmp_path / "reg")
+    assert publish_main(["--registry", root, "--model-dir",
+                         gated_models["model_dir"], "--set-latest"]) == 0
+    # regressing candidate through the CLI: published, refused, exit 3
+    rc = publish_main(["--registry", root, "--model-dir",
+                       gated_models["bad_dir"],
+                       "--gate-data", gated_models["holdout"],
+                       "--evaluators", "auc", "--tolerance", "0.02"])
+    assert rc == 3
+    reg = ModelRegistry(root)
+    assert reg.read_latest() == "v000001"
+    assert reg.list_versions() == ["v000001", "v000002"]
+    capsys.readouterr()

@@ -1,0 +1,188 @@
+"""io/model_io round-trip coverage: sketched records, empty random-
+effect shards, byte-stable saves, and crash-safe (interrupted) saves."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    GeneralizedLinearModel,
+    RandomEffectBucket,
+    RandomEffectModel,
+)
+from photon_ml_tpu.parallel import fault_injection
+from photon_ml_tpu.parallel.fault_injection import Fault, InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    fault_injection.clear()
+
+
+def _index_maps(d_fix=4, d_re=3):
+    return {
+        "g": IndexMap({f"g{j}": j for j in range(d_fix)}),
+        "u": IndexMap({f"u{j}": j for j in range(d_re)}),
+    }
+
+
+def _fixed(w, shard="g", task="logistic"):
+    return FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(jnp.asarray(np.asarray(w, np.float64))), task),
+        shard)
+
+
+def test_sketched_random_effect_roundtrip(tmp_path):
+    """Sketched coefficients survive save->load: slot values, sketch
+    dim/seed, and entity order-insensitive identity."""
+    from photon_ml_tpu.game.data import SketchProjection
+
+    rng = np.random.default_rng(0)
+    dim = 5
+    sketch = SketchProjection(dim, seed=7)
+    eids = ["alice", "bob", "carol"]
+    coefs = rng.normal(size=(3, dim))
+    coefs[1, 2] = 0.0  # a zero slot must stay zero, not vanish
+    bucket = RandomEffectBucket(
+        eids, coefs, np.full((3, dim), -1, np.int32), None, sketch=sketch)
+    model = GameModel({
+        "fixed": _fixed([0.5, -1.0, 0.0, 2.0]),
+        "per-user": RandomEffectModel("per-user", [bucket], "logistic",
+                                      "u", entity_column="userId"),
+    }, "logistic")
+    path = str(tmp_path / "model")
+    save_game_model(model, path, _index_maps())
+    loaded = load_game_model(path)
+    re = loaded.coordinates["per-user"]
+    assert len(re.buckets) == 1
+    got = re.buckets[0]
+    assert got.sketch is not None
+    assert (got.sketch.dim, got.sketch.seed) == (dim, 7)
+    by_id = {e: got.coefficients[i] for i, e in enumerate(got.entity_ids)}
+    for i, e in enumerate(eids):
+        np.testing.assert_allclose(by_id[e], coefs[i], atol=0)
+
+
+def test_empty_random_effect_shard_roundtrip(tmp_path):
+    """A random effect with NO entities (a brand-new coordinate, or a
+    filtered shard) round-trips to an empty coordinate that scores as
+    fixed-effects-only."""
+    from photon_ml_tpu.game.scoring import score_game_model
+
+    model = GameModel({
+        "fixed": _fixed([1.0, 2.0, -0.5, 0.0]),
+        "per-user": RandomEffectModel("per-user", [], "logistic", "u",
+                                      entity_column="userId"),
+    }, "logistic")
+    path = str(tmp_path / "model")
+    save_game_model(model, path, _index_maps())
+    loaded = load_game_model(path)
+    assert loaded.coordinates["per-user"].buckets == []
+    X = np.eye(3, 4)
+    scores = np.asarray(score_game_model(
+        loaded, {"g": X, "u": np.zeros((3, 3))},
+        {"userId": np.asarray(["a", "b", "c"])}, dtype=jnp.float64))
+    np.testing.assert_allclose(scores, [1.0, 2.0, -0.5], atol=1e-12)
+
+
+def _tree_digests(root):
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            full = os.path.join(dirpath, name)
+            with open(full, "rb") as f:
+                out[os.path.relpath(full, root)] = hashlib.sha256(
+                    f.read()).hexdigest()
+    return out
+
+
+def test_two_saves_are_byte_identical(tmp_path):
+    """Fingerprint stability: saving the same model twice produces
+    byte-identical trees (deterministic Avro sync markers + stable
+    record order) — the registry's content fingerprints and the delta
+    differ depend on this."""
+    rng = np.random.default_rng(1)
+    proj = np.asarray([[0, 1, -1], [1, 2, -1]], np.int32)
+    bucket = RandomEffectBucket(["e1", "e2"], rng.normal(size=(2, 3)),
+                                proj, None)
+    model = GameModel({
+        "fixed": _fixed(rng.normal(size=4)),
+        "per-user": RandomEffectModel("per-user", [bucket], "logistic",
+                                      "u", entity_column="userId"),
+    }, "logistic")
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    save_game_model(model, a, _index_maps())
+    save_game_model(model, b, _index_maps())
+    da, db = _tree_digests(a), _tree_digests(b)
+    assert da == db and set(da) >= {"metadata.json",
+                                    os.path.join("fixed-effect", "fixed",
+                                                 "coefficients.avro")}
+
+
+def test_interrupted_save_leaves_nothing_ingestible(tmp_path):
+    """Crash-safety: a save that dies mid-tree leaves NO model at the
+    target path, nothing the registry would publish, and (on overwrite)
+    the previous complete model intact."""
+    from photon_ml_tpu.registry import ModelRegistry, RegistryError
+
+    model = GameModel({"fixed": _fixed([1.0, 0.0, 0.0, 2.0])}, "logistic")
+    target = str(tmp_path / "model")
+
+    fault_injection.install([Fault(site="model_io.save_metadata",
+                                   kind="raise")])
+    with pytest.raises(InjectedFault):
+        save_game_model(model, target, _index_maps())
+    fault_injection.clear()
+    assert not os.path.exists(target)
+    assert os.listdir(str(tmp_path)) == []  # tmp tree unwound too
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(RegistryError, match="metadata.json"):
+        reg.publish(target)
+
+    # overwrite case: the interrupted save must not damage the old model
+    save_game_model(model, target, _index_maps())
+    before = _tree_digests(target)
+    model2 = GameModel({"fixed": _fixed([9.0, 9.0, 9.0, 9.0])}, "logistic")
+    fault_injection.install([Fault(site="model_io.save_coordinate",
+                                   kind="raise")])
+    with pytest.raises(InjectedFault):
+        save_game_model(model2, target, _index_maps())
+    fault_injection.clear()
+    assert _tree_digests(target) == before
+    load_game_model(target)  # still a complete, loadable model
+
+
+def test_variances_and_metadata_roundtrip(tmp_path):
+    """Means + variances survive the trip; metadata pins coordinate
+    order and entity columns."""
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=4)
+    var = np.abs(rng.normal(size=4)) + 0.1
+    model = GameModel({
+        "fixed": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(jnp.asarray(w), jnp.asarray(var)), "squared"),
+            "g"),
+    }, "squared")
+    path = str(tmp_path / "model")
+    save_game_model(model, path, _index_maps())
+    loaded = load_game_model(path)
+    coef = loaded.coordinates["fixed"].model.coefficients
+    np.testing.assert_allclose(np.asarray(coef.means), w, atol=0)
+    np.testing.assert_allclose(np.asarray(coef.variances), var, atol=0)
+    from photon_ml_tpu.io.model_io import load_model_metadata
+
+    meta = load_model_metadata(path)
+    assert meta["task"] == "squared"
+    assert [c["name"] for c in meta["coordinates"]] == ["fixed"]

@@ -1,0 +1,268 @@
+"""Model registry: atomic versioned publish, fingerprinted manifests,
+delta publish/materialize, retention GC, and concurrent-publish races
+(driven through the PR-1 fault-injection harness)."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.parallel import fault_injection
+from photon_ml_tpu.parallel.fault_injection import Fault, InjectedFault
+from photon_ml_tpu.registry import (
+    ModelRegistry,
+    RegistryError,
+    compute_delta,
+    materialize,
+    publish_delta,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    fault_injection.clear()
+
+
+def perturb_model_dir(model_dir, dst, entities, scale=1.25, offset=0.5,
+                      coordinate="per-user"):
+    """Copy a saved model dir and perturb the named entities' random-
+    effect records (the shape of an incremental retrain)."""
+    from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+
+    shutil.copytree(model_dir, str(dst))
+    path = os.path.join(str(dst), "random-effect", coordinate,
+                        "coefficients.avro")
+    records, schema = read_avro_file(path)
+    changed = 0
+    for rec in records:
+        if str(rec["modelId"]) in {str(e) for e in entities}:
+            for coef in rec["means"]:
+                coef["value"] = coef["value"] * scale + offset
+            changed += 1
+    assert changed == len(entities)
+    write_avro_file(path, records, schema)
+    return str(dst)
+
+
+def test_publish_list_latest_and_verify(saved_game_model, tmp_path):
+    model_dir, _bundle = saved_game_model
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    assert reg.list_versions() == []
+    assert reg.read_latest() is None  # ENOENT retries then honest None
+
+    v1 = reg.publish(model_dir)
+    assert v1 == "v000001"
+    assert reg.list_versions() == [v1]
+    assert reg.read_latest() is None  # LATEST moves only on set_latest
+    reg.set_latest(v1)
+    assert reg.read_latest() == v1
+
+    man = reg.manifest(v1)
+    assert man["version"] == v1 and man["parent"] is None
+    assert not man["delta"]
+    # the published payload is the complete model tree
+    assert os.path.exists(os.path.join(reg.model_dir(v1), "metadata.json"))
+    reg.verify(v1)  # fingerprints match
+
+    v2 = reg.publish(model_dir, parent=v1, metrics={"auc": 0.7})
+    assert v2 == "v000002"
+    assert reg.manifest(v2)["metrics"] == {"auc": 0.7}
+    assert reg.read_latest() == v1  # still the old live version
+
+    with pytest.raises(RegistryError):
+        reg.set_latest("v000099")
+    with pytest.raises(RegistryError):
+        reg.publish(model_dir, parent="v000099")
+
+
+def test_fingerprint_tamper_detected(saved_game_model, tmp_path):
+    from photon_ml_tpu.parallel.resilience import ResumeMismatch
+
+    model_dir, _ = saved_game_model
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(model_dir)
+    target = os.path.join(reg.model_dir(v1), "metadata.json")
+    with open(target, "a") as f:
+        f.write("\n")
+    with pytest.raises(ResumeMismatch, match="metadata.json"):
+        reg.verify(v1)
+
+
+def test_corrupt_latest_pointer_raises(saved_game_model, tmp_path):
+    model_dir, _ = saved_game_model
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(model_dir, set_latest=True)
+    with open(reg.latest_path, "w") as f:
+        f.write("{not json")
+    with pytest.raises(RegistryError, match="LATEST"):
+        reg.read_latest(retries=2, delay_s=0.0)
+
+
+def test_delta_publish_and_materialize(saved_game_model, tmp_path):
+    from photon_ml_tpu.game.scoring import score_game_model
+    from photon_ml_tpu.io.avro import read_avro_file
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    import jax.numpy as jnp
+
+    model_dir, bundle = saved_game_model
+    changed = [str(bundle["uid"][0]), str(bundle["uid"][50])]
+    changed = sorted(set(changed))
+    new_dir = perturb_model_dir(model_dir, tmp_path / "new", changed)
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(model_dir, set_latest=True)
+    v2 = publish_delta(reg, new_dir, metrics={"note": 1.0})
+    man = reg.manifest(v2)
+    assert man["delta"] and man["parent"] == v1
+    assert man["delta_summary"]["changed_entities"]["per-user"] == len(changed)
+    # the delta payload holds ONLY the changed records
+    delta_records, _ = read_avro_file(os.path.join(
+        reg.model_dir(v2), "random-effect", "per-user",
+        "coefficients.avro"))
+    assert sorted(str(r["modelId"]) for r in delta_records) == changed
+    # no fixed-effect payload (unchanged)
+    assert not os.path.exists(os.path.join(reg.model_dir(v2),
+                                           "fixed-effect"))
+    # and is strictly smaller than the parent's
+    full_size = os.path.getsize(os.path.join(
+        reg.model_dir(v1), "random-effect", "per-user",
+        "coefficients.avro"))
+    delta_size = os.path.getsize(os.path.join(
+        reg.model_dir(v2), "random-effect", "per-user",
+        "coefficients.avro"))
+    assert delta_size < full_size
+
+    # materialized(v2) scores == the new model dir's scores
+    resolved = materialize(reg, v2)
+    assert resolved != reg.model_dir(v2)
+    idx = list(range(40))
+    feats = {"g": bundle["Xg"][idx], "u": bundle["Xu"][idx]}
+    ids = {"userId": np.asarray([str(bundle["uid"][i]) for i in idx])}
+    got = np.asarray(score_game_model(load_game_model(resolved), feats,
+                                      ids, dtype=jnp.float64))
+    want = np.asarray(score_game_model(load_game_model(new_dir), feats,
+                                       ids, dtype=jnp.float64))
+    np.testing.assert_allclose(got, want, atol=1e-12)
+    # second materialize call reuses the cache
+    assert materialize(reg, v2) == resolved
+
+
+def test_delta_refuses_structural_changes(saved_game_model, tmp_path):
+    model_dir, _ = saved_game_model
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish(model_dir, set_latest=True)
+
+    # changed index map -> refuse
+    bad = tmp_path / "bad-imap"
+    shutil.copytree(model_dir, str(bad))
+    imap_path = os.path.join(str(bad), "index-map.u.json")
+    with open(imap_path) as f:
+        payload = json.load(f)
+    with open(imap_path, "w") as f:
+        json.dump(payload, f, indent=1)  # same map, different bytes
+    with pytest.raises(ValueError, match="index map"):
+        publish_delta(reg, str(bad))
+
+    # dropped entity -> refuse (deltas are additive)
+    from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+
+    dropped = tmp_path / "bad-drop"
+    shutil.copytree(model_dir, str(dropped))
+    path = os.path.join(str(dropped), "random-effect", "per-user",
+                        "coefficients.avro")
+    records, schema = read_avro_file(path)
+    write_avro_file(path, records[:-1], schema)
+    with pytest.raises(ValueError, match="additive"):
+        publish_delta(reg, str(dropped))
+
+
+def test_gc_keeps_live_chain(saved_game_model, tmp_path):
+    model_dir, bundle = saved_game_model
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(model_dir, set_latest=True)
+    new_dir = perturb_model_dir(model_dir, tmp_path / "m2",
+                                [str(bundle["uid"][0])])
+    v2 = publish_delta(reg, new_dir, set_latest=True)
+    v3 = reg.publish(model_dir)
+    v4 = reg.publish(model_dir)
+    # live is v2, a delta whose parent is v1: gc(keep=1) keeps the
+    # newest (v4) AND the whole live chain (v2 + parent v1)
+    removed = reg.gc(keep=1)
+    assert removed == [v3]
+    assert reg.list_versions() == [v1, v2, v4]
+    # the live delta still materializes after GC
+    assert os.path.exists(os.path.join(materialize(reg, v2),
+                                       "metadata.json"))
+    # rollback target retained: repoint LATEST at the parent
+    reg.set_latest(v1)
+    assert reg.read_latest() == v1
+
+
+def test_concurrent_publish_crash_windows(saved_game_model, tmp_path):
+    """A publisher crashing in either atomic-rename window leaves a
+    registry every reader and a subsequent publisher can use."""
+    model_dir, _ = saved_game_model
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v1 = reg.publish(model_dir, set_latest=True)
+
+    # window A: payload staged, version NOT renamed in -> a .tmp- dir
+    fault_injection.install([Fault(site="registry.publish_prepared",
+                                   kind="raise")])
+    with pytest.raises(InjectedFault):
+        reg.publish(model_dir)
+    fault_injection.clear()
+    # simulate the crash flavor (no exception unwind): re-stage the dir
+    stale = os.path.join(reg.versions_root, ".tmp-99999-1")
+    shutil.copytree(model_dir, os.path.join(stale, "model"))
+    assert reg.list_versions() == [v1]  # staging dirs never listed
+    assert reg.read_latest() == v1
+    assert reg.gc(keep=10) == []  # GC ignores staging dirs
+    assert os.path.isdir(stale)
+
+    # a subsequent publish lands the next number cleanly
+    v2 = reg.publish(model_dir)
+    assert v2 == "v000002"
+    reg.verify(v2)
+
+    # window B: version renamed in, crash before LATEST moved
+    fault_injection.install([Fault(site="registry.published",
+                                   kind="raise")])
+    with pytest.raises(InjectedFault):
+        reg.publish(model_dir, set_latest=True)
+    fault_injection.clear()
+    assert reg.list_versions() == [v1, v2, "v000003"]
+    assert reg.read_latest() == v1  # pointer still the old live version
+    reg.verify("v000003")  # the landed version is complete and intact
+
+    # stale-staging sweep: only with clean_staging and past the grace
+    reg.gc(keep=10, clean_staging=True, staging_grace_s=0.0)
+    assert not os.path.isdir(stale)
+
+
+def test_publish_driver_cli(saved_game_model, tmp_path, capsys):
+    from photon_ml_tpu.cli.model_publish_driver import main as publish_main
+
+    model_dir, bundle = saved_game_model
+    root = str(tmp_path / "reg")
+    assert publish_main(["--registry", root, "--model-dir", model_dir,
+                         "--set-latest"]) == 0
+    new_dir = perturb_model_dir(model_dir, tmp_path / "m2",
+                                [str(bundle["uid"][0])])
+    assert publish_main(["--registry", root, "--model-dir", new_dir,
+                         "--delta", "--set-latest"]) == 0
+    assert publish_main(["--registry", root, "--list"]) == 0
+    out = [json.loads(line) for line in
+           capsys.readouterr().out.strip().splitlines() if line]
+    listed = [r for r in out if "version" in r]
+    assert [r["version"] for r in listed] == ["v000001", "v000002"]
+    assert listed[1]["delta"] and listed[1]["live"]
+    reg = ModelRegistry(root)
+    assert reg.read_latest() == "v000002"
+    assert publish_main(["--registry", root, "--rollback-to",
+                         "v000001"]) == 0
+    assert reg.read_latest() == "v000001"
+    assert publish_main(["--registry", root]) == 2  # nothing to do

@@ -183,7 +183,8 @@ def test_serving_driver_build(saved_game_model):
         "--model-dir", model_dir, "--port", "0", "--max-batch", "8",
         "--watchdog-s", "0",  # <= 0 disables the watchdog
     ])
-    server = build_server(args)
+    server, registry = build_server(args)
+    assert registry is None  # --model-dir mode has no registry
     try:
         assert server.port > 0
         assert server.service.batcher.watchdog_s is None
