@@ -327,6 +327,7 @@ def serving_main() -> None:
     nothing here times XLA). Writes ``BENCH_serving.json`` next to this
     file and prints the same JSON line."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
     import tempfile
 
     import jax
@@ -365,8 +366,10 @@ def serving_main() -> None:
                           reg_type="l2", reg_weight=1.0)],
         task="logistic")
     model, _ = cd.run(ds)
-    model_dir = os.path.join(tempfile.mkdtemp(prefix="bench-serving-"),
-                             "model")
+    # the whole run works out of one temp tree, removed on exit (the swap
+    # mode always cleaned up; serving used to leak its tree)
+    root = tempfile.mkdtemp(prefix="bench-serving-")
+    model_dir = os.path.join(root, "model")
     save_game_model(model, model_dir, {
         "g": IndexMap({f"g{j}": j for j in range(d_fix)}),
         "u": IndexMap({f"u{j}": j for j in range(d_re)}),
@@ -432,6 +435,7 @@ def serving_main() -> None:
     with open(os.path.join(here, "BENCH_serving.json"), "w") as f:
         json.dump(record, f, indent=2)
     print(json.dumps(record))
+    shutil.rmtree(root, ignore_errors=True)
 
 
 def swap_main() -> None:
@@ -559,6 +563,168 @@ def swap_main() -> None:
     shutil.rmtree(root, ignore_errors=True)
 
 
+def stream_main() -> None:
+    """``python bench.py stream`` — out-of-core streamed training: decode
+    cost and pipeline stalls, cold vs warm chunk cache.
+
+    Builds a synthetic Avro shard on disk, then streams it through
+    ``streaming_value_and_grad`` (CPU, float64) three ways: the COLD first
+    pass over a decode-once chunk cache (pays Avro decode + feature
+    resolution + packed-memmap spill), WARM cache-hit passes (memmap reads
+    only), and NO-CACHE passes (re-decode every pass — the pre-cache
+    behavior of the out-of-core path). Reports example-passes/s for each,
+    the warm/cold speedup, per-phase stall fractions (decode-wait /
+    transfer / compute-stall, ``StreamStats``), a float64 coefficient
+    parity check of a cached ``fit_streaming`` against the no-cache fit
+    (must agree to <= 1e-9 — the cache must be bit-faithful), and the
+    compiled-executable count across passes (must stay flat: every chunk
+    shares one fixed-shape kernel, warm or cold). Writes
+    ``BENCH_stream.json`` next to this file and prints the same JSON.
+
+    Sized by ``BENCH_STREAM_ROWS`` (default 24000) and
+    ``BENCH_STREAM_FIT_ITERS`` (default 6) so the CI smoke
+    (``scripts/ci_bench_smoke.sh``) finishes in seconds."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+
+    import jax
+
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
+    jax.config.update("jax_enable_x64", True)  # the 1e-9 parity gate is f64
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.io.chunk_cache import ChunkCacheSource
+    from photon_ml_tpu.io.data_reader import write_training_examples
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.parallel.data_parallel import compiled_kernel_count
+    from photon_ml_tpu.parallel.streaming import (
+        HostChunk,
+        StreamStats,
+        fit_streaming,
+        streaming_value_and_grad,
+    )
+
+    rng = np.random.default_rng(0)
+    n = int(os.environ.get("BENCH_STREAM_ROWS", 24000))
+    fit_iters = int(os.environ.get("BENCH_STREAM_FIT_ITERS", 6))
+    vocab, max_k, chunk_rows = 96, 12, 1024
+    rows = []
+    for _ in range(n):
+        k = int(rng.integers(3, max_k + 1))
+        cols = rng.choice(vocab, size=k, replace=False)
+        rows.append([(f"feature_{c:04d}", "", float(rng.normal()))
+                     for c in cols])
+    labels = rng.integers(0, 2, n).astype(float)
+    weights = rng.uniform(0.5, 2.0, n)
+    offsets = rng.normal(0, 0.1, n)
+    root = tempfile.mkdtemp(prefix="bench-stream-")
+    try:
+        path = os.path.join(root, "train.avro")
+        write_training_examples(path, rows, labels, offsets=offsets,
+                                weights=weights, block_size=512)
+        imap = IndexMap({f"feature_{c:04d}": c for c in range(vocab)},
+                        add_intercept=True)
+        src = AvroChunkSource(path, imap, chunk_rows=chunk_rows)
+        cache = ChunkCacheSource(src, os.path.join(root, "cache"))
+        obj = make_objective("logistic")
+        dim = src.dim
+        w = jnp.zeros((dim,), jnp.float64)
+
+        # compile OUTSIDE the timed passes (same fixed shapes as every
+        # real chunk, all-zero weights so the kernel output is inert):
+        # cold-vs-warm must compare decode+spill vs memmap, not XLA
+        warm_chunk = HostChunk(
+            indices=np.zeros((chunk_rows, src.pad_nnz), np.int32),
+            values=np.zeros((chunk_rows, src.pad_nnz), np.float32),
+            labels=np.zeros(chunk_rows), offsets=np.zeros(chunk_rows),
+            weights=np.zeros(chunk_rows))
+        streaming_value_and_grad(obj, [warm_chunk], dim,
+                                 dtype=jnp.float64)(w, 0.5)
+
+        def timed_pass(chunks, stats):
+            fg = streaming_value_and_grad(obj, chunks, dim,
+                                          dtype=jnp.float64, stats=stats)
+            t0 = time.perf_counter()
+            f, g = fg(w, 0.5)
+            float(f)  # scalar fetch: the pass has actually completed
+            return time.perf_counter() - t0
+
+        stats_cold, stats_warm, stats_raw = (StreamStats(), StreamStats(),
+                                             StreamStats())
+        cold_s = timed_pass(cache, stats_cold)
+        assert cache.cold_passes == 1 and cache.warm_passes == 0
+        compiles_after_cold = compiled_kernel_count(obj)
+        warm_walls = [timed_pass(cache, stats_warm) for _ in range(3)]
+        warm_s, warm_total_s = min(warm_walls), sum(warm_walls)
+        assert cache.warm_passes == 3, cache.warm_passes
+        compiles_after_warm = compiled_kernel_count(obj)
+        raw_s = min(timed_pass(src, stats_raw) for _ in range(2))
+
+        # cached fit vs no-cache fit: float64, exact iteration count
+        cfg = OptimizerConfig(max_iters=fit_iters, tolerance=0.0)
+        r_raw = fit_streaming(obj, src, dim, l2=0.5, config=cfg,
+                              dtype=jnp.float64)
+        compiles_before_cached_fit = compiled_kernel_count(obj)
+        r_cached = fit_streaming(obj, cache, dim, l2=0.5, config=cfg,
+                                 dtype=jnp.float64)
+        compiles_after_cached_fit = compiled_kernel_count(obj)
+        coeff_diff = float(np.max(np.abs(np.asarray(r_raw.w)
+                                         - np.asarray(r_cached.w))))
+
+        def frac(stats, wall):
+            # transfer-thread seconds over TOTAL wall of the measured
+            # passes; decode_wait/transfer live on the transfer thread, so
+            # their sum can approach (not exceed) 1.0 of overlapped wall
+            return {"decode_wait": round(stats.decode_s / wall, 4),
+                    "transfer": round(stats.transfer_s / wall, 4),
+                    "compute_stall": round(stats.stall_s / wall, 4)}
+
+        record = {
+            "metric": "streamed_ooc_warm_pass_example_passes_per_sec",
+            "value": round(n / warm_s, 1),
+            "unit": (f"example-passes/sec, warm chunk-cache pass "
+                     f"({jax.devices()[0].platform}, n={n}, "
+                     f"chunk_rows={chunk_rows}, pad_nnz={src.pad_nnz}, "
+                     "f64; cold/no-cache rates + stall fractions in "
+                     "fields)"),
+            "cold_pass_example_passes_per_sec": round(n / cold_s, 1),
+            "warm_pass_example_passes_per_sec": round(n / warm_s, 1),
+            "no_cache_pass_example_passes_per_sec": round(n / raw_s, 1),
+            "speedup_warm_vs_cold": round(cold_s / warm_s, 3),
+            "speedup_warm_vs_no_cache": round(raw_s / warm_s, 3),
+            "stall_fractions": {"cold": frac(stats_cold, cold_s),
+                                "warm": frac(stats_warm, warm_total_s)},
+            "cache_bytes": cache.bytes_written,
+            "fit_iters": fit_iters,
+            "cached_fit_coeff_max_abs_diff": coeff_diff,
+            "compiles_after_cold_pass": compiles_after_cold,
+            "compiles_after_warm_passes": compiles_after_warm,
+            "compiles_during_cached_fit": (compiles_after_cached_fit
+                                           - compiles_before_cached_fit),
+        }
+        ok = (record["speedup_warm_vs_cold"] >= 2.0
+              and coeff_diff <= 1e-9
+              and compiles_after_warm == compiles_after_cold
+              and record["compiles_during_cached_fit"] == 0)
+        record["acceptance_ok"] = ok
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_stream.json"), "w") as f:
+            json.dump(record, f, indent=2)
+        print(json.dumps(record))
+        if not ok:
+            print("stream bench acceptance FAILED (speedup >= 2x, parity "
+                  "<= 1e-9, flat compile count)", file=sys.stderr)
+            sys.exit(5)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _baseline() -> "tuple[float, str] | None":
     """The honest comparator for ``vs_baseline``.
 
@@ -614,5 +780,7 @@ if __name__ == "__main__":
         serving_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "swap":
         swap_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "stream":
+        stream_main()
     else:
         main()

@@ -120,6 +120,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "summarization pass")
     p.add_argument("--chunk-rows", type=int, default=1 << 16,
                    help="rows per streamed chunk (--streaming)")
+    p.add_argument("--chunk-cache-dir", default=None,
+                   help="with --out-of-core-shards: decode-once packed "
+                        "chunk cache root (io/chunk_cache.py; one subdir "
+                        "per shard) — the first streamed pass spills "
+                        "decoded chunks into packed memmaps, every later "
+                        "pass (and every CD iteration) streams them back "
+                        "decode-free; CD residual offsets still update "
+                        "through the scalar overlay. Invalidated when "
+                        "source files / chunk geometry / index map "
+                        "change; multi-process runs need per-process dirs")
+    p.add_argument("--chunk-cache-gb", type=float, default=None,
+                   help="per-shard disk budget for --chunk-cache-dir; a "
+                        "shard that doesn't fit falls through to "
+                        "re-decode with a logged warning")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="streamed transfer-ring depth: chunks staged on "
+                        "device ahead of compute (default 2 / "
+                        "PHOTON_PREFETCH_DEPTH; 0 = synchronous)")
     p.add_argument("--tuning-mode", default="none",
                    choices=["none", "random", "bayesian"],
                    help="auto-tune reg weights after the grid (SURVEY.md §4.5)")
@@ -218,6 +236,15 @@ def main(argv: Sequence[str] | None = None) -> int:
              for cfg in configs]
             for configs in grid
         ]
+    if args.prefetch_depth is not None:
+        import dataclasses as _dc
+
+        grid = [
+            [_dc.replace(cfg, prefetch_depth=args.prefetch_depth)
+             if cfg.coordinate_type == "fixed" else cfg
+             for cfg in configs]
+            for configs in grid
+        ]
     shards = sorted({cfg.feature_shard for cfg in grid[0]})
     entity_columns = _entity_columns(grid)
 
@@ -283,6 +310,11 @@ def main(argv: Sequence[str] | None = None) -> int:
                 index_maps[s] = base_map
 
     ooc_shards = set(args.out_of_core_shards or ())
+    if args.chunk_cache_dir and not ooc_shards:
+        raise SystemExit("--chunk-cache-dir requires --out-of-core-shards "
+                         "(only disk-backed shards re-decode per pass)")
+    if args.chunk_cache_gb is not None and not args.chunk_cache_dir:
+        raise SystemExit("--chunk-cache-gb requires --chunk-cache-dir")
     if ooc_shards:
         # every check here is argv-only: fail BEFORE the (potentially
         # hours-long at the scale this feature targets) dataset reads
@@ -340,6 +372,21 @@ def main(argv: Sequence[str] | None = None) -> int:
                                     process_part=part)
                 for s_ in ooc_shards
             }
+            if args.chunk_cache_dir:
+                # decode-once: the first streamed pass over each shard
+                # (summarization or the first fit pass) pays the Avro
+                # decode; every later pass — including every CD
+                # iteration's 2 sparse passes — streams packed memmaps
+                from photon_ml_tpu.io.chunk_cache import ChunkCacheSource
+
+                cache_bytes = (None if args.chunk_cache_gb is None
+                               else int(args.chunk_cache_gb * 1e9))
+                train.feature_sources = {
+                    s_: ChunkCacheSource(
+                        src_, os.path.join(args.chunk_cache_dir, s_),
+                        max_bytes=cache_bytes)
+                    for s_, src_ in train.feature_sources.items()
+                }
     validation = None
     if args.validation_data:
         with Timed(logger, "read_validation_data"):

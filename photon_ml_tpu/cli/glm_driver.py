@@ -127,6 +127,23 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "--out-of-core (default: one measuring decode pass)")
     p.add_argument("--chunk-rows", type=int, default=1 << 16,
                    help="rows per streamed chunk (--streaming)")
+    p.add_argument("--chunk-cache-dir", default=None,
+                   help="with --out-of-core: decode-once packed chunk "
+                        "cache directory (io/chunk_cache.py) — the first "
+                        "optimizer pass spills decoded chunks into packed "
+                        "memmaps there, every later pass streams them "
+                        "back decode-free. Invalidated automatically when "
+                        "the source files, chunk geometry, or index map "
+                        "change; multi-process runs need per-process dirs")
+    p.add_argument("--chunk-cache-gb", type=float, default=None,
+                   help="disk budget for --chunk-cache-dir; a dataset "
+                        "that doesn't fit falls through to re-decode "
+                        "with a logged warning (default: unbounded)")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="streamed transfer-ring depth: how many chunks "
+                        "the transfer thread stages on device ahead of "
+                        "compute (default 2 / PHOTON_PREFETCH_DEPTH; 0 = "
+                        "synchronous)")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
     p.add_argument("--coordinator-address", default=None,
                    help="multi-host: coordinator host:port for "
@@ -213,6 +230,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         optimizer = "owlqn"
 
     out_of_core = args.out_of_core
+    if args.chunk_cache_dir and not out_of_core:
+        raise SystemExit("--chunk-cache-dir requires --out-of-core (the "
+                         "in-RAM streaming path never re-decodes)")
+    if args.chunk_cache_gb is not None and not args.chunk_cache_dir:
+        raise SystemExit("--chunk-cache-gb requires --chunk-cache-dir")
     if out_of_core:
         if args.input_format != "avro":
             raise SystemExit("--out-of-core requires --input-format avro")
@@ -254,6 +276,13 @@ def main(argv: Sequence[str] | None = None) -> int:
                 pad_nnz=args.pad_nnz, dtype=resolve_dtype(args.dtype),
                 process_part=((jax.process_index(), jax.process_count())
                               if distributed else None))
+            if args.chunk_cache_dir:
+                from photon_ml_tpu.io.chunk_cache import ChunkCacheSource
+
+                src = ChunkCacheSource(
+                    src, args.chunk_cache_dir,
+                    max_bytes=(None if args.chunk_cache_gb is None
+                               else int(args.chunk_cache_gb * 1e9)))
             host_feats = labels = offsets = weights = None
             intercept_index = index_map.intercept_index
         else:
@@ -470,6 +499,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                         objective, chunks, dim, w0=w, l2=reg.l2_weight(lam),
                         l1=reg.l1_weight(lam), optimizer=optimizer,
                         config=opt_config, dtype=dtype, mesh=stream_mesh,
+                        prefetch_depth=args.prefetch_depth,
                     )
                 else:
                     res = fit_distributed(
@@ -490,6 +520,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                         if np.isfinite(v)
                     ],
                 }
+                if res.stream_stats is not None:
+                    # streamed fits: decode-wait / transfer / compute-stall
+                    # seconds for this lambda's whole pass sequence
+                    diag["stream"] = res.stream_stats
                 metrics = {}
                 if validation_batch is not None and evaluators:
                     scores = np.asarray(objective.margins(res.w, validation_batch))
@@ -508,6 +542,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                         variances = streaming_coefficient_variances(
                             objective, chunks, dim, res.w,
                             l2=reg.l2_weight(lam), dtype=dtype, mesh=stream_mesh,
+                            prefetch_depth=args.prefetch_depth,
                         )
                     else:
                         variances = objective.coefficient_variances(

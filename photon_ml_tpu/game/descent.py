@@ -101,6 +101,9 @@ class CoordinateConfig:
     # (parallel/streaming.py); sparse_grad is ignored (per-chunk autodiff)
     streaming: bool = False
     chunk_rows: int = 1 << 16
+    # streamed transfer-ring depth (parallel/streaming.iter_device_chunks):
+    # None = the module default / PHOTON_PREFETCH_DEPTH
+    prefetch_depth: Optional[int] = None
     active_cap: Optional[int] = None  # random-effect only
     num_buckets: int = 4  # random-effect entity size buckets
     # random-effect projector: "subspace" (exact per-entity maps) or
@@ -152,6 +155,10 @@ class CoordinateConfig:
             raise ValueError(
                 "compute_variance='full' needs the d x d Hessian in device "
                 "memory; not available with streaming=True (use 'diagonal')")
+        if self.prefetch_depth is not None and self.prefetch_depth < 0:
+            raise ValueError(
+                f"coordinate '{self.name}': prefetch_depth must be >= 0, "
+                f"got {self.prefetch_depth}")
 
 
 @dataclasses.dataclass
@@ -322,6 +329,7 @@ class _FixedState:
                     self.obj, chunks, self.dim, w0=w0, l2=float(l2),
                     l1=float(l1), optimizer=optimizer, config=cfg_opt,
                     dtype=dtype, mesh=self._stream_mesh,
+                    prefetch_depth=cfg.prefetch_depth,
                 )
 
             self._batch_parts = None
@@ -512,7 +520,7 @@ class _FixedState:
             return fit_streaming(
                 self.obj, overlay, dim, w0=w0, l2=float(l2), l1=float(l1),
                 optimizer=optimizer, config=cfg_opt, dtype=self.dtype,
-                mesh=self._stream_mesh,
+                mesh=self._stream_mesh, prefetch_depth=cfg.prefetch_depth,
             )
 
         self._last_chunks = ScalarOverlaySource(source, labels=labels,
@@ -547,6 +555,7 @@ class _FixedState:
                 self.variances = np.asarray(streaming_coefficient_variances(
                     self.obj, self._last_chunks, self.dim, res.w, self.l2,
                     dtype=self.dtype, mesh=self._stream_mesh,
+                    prefetch_depth=self.cfg.prefetch_depth,
                 ))
             else:
                 feats, labels, weights = self._batch_parts
@@ -562,23 +571,41 @@ class _FixedState:
     def train_scores(self, w_model: jax.Array) -> jax.Array:
         """This coordinate's margins over every training row (the
         CoordinateDataScores role). Streaming mode computes them in one
-        streamed pass, so no device-resident feature copy exists."""
+        streamed pass — the transfer ring stages the next chunks' feature
+        uploads (budget-accounted) while the current chunk's margins
+        compute, and the device->host fetch of chunk i-1 overlaps chunk
+        i's dispatch — so no device-resident feature copy ever exists."""
         if not self.streaming:
             return _margins(self.full_features, w_model)
         from photon_ml_tpu.parallel.multihost import (
             allgather_spans,
             allgather_varspans,
         )
+        from photon_ml_tpu.parallel.streaming import iter_device_chunks
+        from photon_ml_tpu.utils import transfer_budget
 
         w_model = jnp.asarray(w_model, self.dtype)
-        outs = []
-        for c in self._score_chunks:
-            feats = SparseFeatures(
-                jnp.asarray(c.indices),
+
+        def to_feats(c):
+            # features only: scoring never needs the 24B/row scalars
+            return SparseFeatures(
+                transfer_budget.device_put(np.asarray(c.indices, np.int32),
+                                           what="score chunk"),
                 (None if c.values is None
-                 else jnp.asarray(c.values, self.dtype)),
+                 else transfer_budget.device_put(
+                     np.asarray(c.values, self.dtype), what="score chunk")),
                 dim=self.dim)
-            outs.append(np.asarray(_margins_jit(feats, w_model)))
+
+        outs = []
+        pending = None
+        for _c, feats in iter_device_chunks(self._score_chunks, to_feats,
+                                            self.cfg.prefetch_depth):
+            res = _margins_jit(feats, w_model)
+            if pending is not None:
+                outs.append(np.asarray(pending))
+            pending = res
+        if pending is not None:
+            outs.append(np.asarray(pending))
         s0, s1 = self._score_span
         local = np.concatenate(outs)[: s1 - s0]
         # out-of-core block parts are contiguous but not span_of-aligned:
@@ -775,6 +802,11 @@ class CoordinateDescent:
                                 loss=float(res.value), converged=bool(res.converged),
                                 optimizer_iterations=int(res.iterations),
                             )
+                            if res.stream_stats is not None:
+                                # streamed fixed effects: per-fit pipeline
+                                # stall breakdown (decode-wait / transfer /
+                                # compute-stall seconds) rides the history
+                                record["stream"] = res.stream_stats
                             w_model = st.model_space_w()
                             scores[cfg.name] = st.train_scores(w_model)
                             if validation is not None:

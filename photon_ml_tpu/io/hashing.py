@@ -85,6 +85,12 @@ class HashingIndexMap:
             out[self._intercept] = INTERCEPT_KEY
         return out
 
+    def digest(self) -> str:
+        """Feature-space fingerprint (chunk-cache invalidation key). The
+        hash function is fixed, so (dim, intercept slot) determines every
+        resolution."""
+        return f"fnv1a64:{self._hash_dim}:{self._intercept}"
+
     def save(self, path: str) -> None:
         import json
 

@@ -42,6 +42,18 @@ class IndexMap:
     def inverse(self) -> Dict[int, str]:
         return {v: k for k, v in self.forward.items()}
 
+    def digest(self) -> str:
+        """Content fingerprint of the feature space (key -> index mapping
+        and intercept placement). Cache layers key decoded artifacts on
+        this: two maps with the same digest resolve every feature
+        identically, so a cached decode is reusable; any remap must miss."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for key, idx in sorted(self.forward.items()):
+            h.update(f"{key}\x00{idx}\x01".encode())
+        return h.hexdigest()
+
     def save(self, path: str) -> None:
         with open(path, "w") as f:
             json.dump({"features": self.forward}, f)

@@ -115,6 +115,18 @@ class PersistentIndexMap:
         (e.g. per-shard filtering); bulk lookups should use lookup_batch."""
         return dict(self.items())
 
+    def digest(self) -> str:
+        """Feature-space fingerprint (chunk-cache invalidation key): the
+        store file's content hash. O(file) once per job — the store is
+        immutable after build, so callers may cache the result."""
+        import hashlib
+
+        h = hashlib.sha256()
+        with open(self.path, "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                h.update(block)
+        return h.hexdigest()
+
     def save(self, path: str) -> None:
         """Copy the store file (saving alongside models, as drivers do)."""
         if os.path.abspath(path) != os.path.abspath(self.path):

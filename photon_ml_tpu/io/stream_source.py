@@ -35,12 +35,15 @@ from __future__ import annotations
 import contextlib
 import ctypes
 import dataclasses
+import logging
 import os
 import queue
 import threading
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+_log = logging.getLogger("photon_ml_tpu")
 
 from photon_ml_tpu.io.avro import (
     _expand,
@@ -343,6 +346,10 @@ class AvroChunkSource:
         # producer-side instrumentation (tests assert boundedness)
         self.chunks_produced = 0
         self.passes = 0
+        # producer threads that outlived the end-of-pass join (a wedged
+        # decoder); each increment comes with a logged warning so leaked
+        # threads are visible instead of silently accumulating
+        self.producer_join_timeouts = 0
         if pad_nnz is None:
             pad_nnz = self._measure_pad_nnz()
         self.pad_nnz = int(pad_nnz)
@@ -585,6 +592,10 @@ class AvroChunkSource:
                          offsets=off.astype(self._dtype),
                          weights=wt.astype(self._dtype))
 
+    # end-of-pass producer join timeout (seconds); a class attribute so
+    # tests can shrink it without monkeypatching the iterator internals
+    _join_timeout = 30.0
+
     @staticmethod
     def _put_or_stop(q: queue.Queue, stop: threading.Event, item) -> bool:
         """Stop-aware bounded put — used for chunks, the end-of-pass
@@ -663,7 +674,17 @@ class AvroChunkSource:
                 yield item
         finally:
             stop.set()
-            t.join(timeout=30)
+            t.join(timeout=self._join_timeout)
+            if t.is_alive():
+                # a wedged decoder (native call stuck outside the GIL, NFS
+                # read hung, ...) cannot be killed from here — count and
+                # name it loudly rather than leaking the thread invisibly
+                self.producer_join_timeouts += 1
+                _log.warning(
+                    "AvroChunkSource: producer thread %r still alive %.0fs "
+                    "after the pass ended (wedged decoder?); leaking it as "
+                    "a daemon (join timeouts so far: %d)",
+                    t.name, self._join_timeout, self.producer_join_timeouts)
         if emitted != len(self):
             raise RuntimeError(
                 f"chunk source produced {emitted} chunks, expected "

@@ -43,6 +43,11 @@ class OptimizationResult(NamedTuple):
     converged: jax.Array  # bool scalar
     loss_history: jax.Array  # [max_iters] padded with NaN past `iterations`
     grad_norm_history: jax.Array  # [max_iters] same padding
+    # streamed fits only: host-side pipeline stall accounting for the whole
+    # fit (parallel/streaming.StreamStats.as_dict() — decode-wait /
+    # transfer / compute-stall seconds, chunk and pass counts). None for
+    # in-memory fits; never touched inside jit.
+    stream_stats: "dict | None" = None
 
 
 def converged_check(f_prev, f, g_norm, g0_norm, tol, f_scale=None):

@@ -153,15 +153,31 @@ def _runner_cache_for(objective) -> dict:
     return runners
 
 
-def cached_jit(objective, key, make_fn):
+def cached_jit(objective, key, make_fn, **jit_kwargs):
     """Get-or-create a jitted kernel in the objective's runner cache (the
-    streaming chunk kernels share the fit runners' cache policy)."""
+    streaming chunk kernels share the fit runners' cache policy).
+    ``jit_kwargs`` (e.g. ``donate_argnums``) apply only when the kernel is
+    first built, so every caller of one key must pass the same ones."""
     cache = _runner_cache_for(objective)
     fn = cache.get(key)
     if fn is None:
-        fn = jax.jit(make_fn())
+        fn = jax.jit(make_fn(), **jit_kwargs)
         cache[key] = fn
     return fn
+
+
+def compiled_kernel_count(objective) -> int:
+    """Total compiled-executable count across the objective's cached
+    kernels (bench/test instrumentation: a count that stays flat across
+    streamed passes proves the fixed-shape chunk contract held — no chunk
+    retraced a kernel)."""
+    total = 0
+    for entry in _runner_cache_for(objective).values():
+        for fn in (entry if isinstance(entry, (tuple, list)) else (entry,)):
+            size = getattr(fn, "_cache_size", None)
+            if callable(size):
+                total += int(size())
+    return total
 
 
 def _eff_coeffs(norm, w):

@@ -9,8 +9,12 @@ in host RAM as fixed-shape chunks, each optimizer iteration streams chunks
 through the device accumulating (loss, gradient) partials with a jitted
 per-chunk kernel (one compilation, static shapes), and the L-BFGS direction
 / update math stays on device via the same jitted two-loop recursion the
-in-memory optimizer uses. Transfers overlap compute via one-chunk lookahead
-(JAX async dispatch).
+in-memory optimizer uses. Transfers overlap compute via a depth-K device
+prefetch ring (:func:`iter_device_chunks`): a dedicated transfer thread
+stages the next K chunks' host->device uploads while this thread dispatches
+compute, and per-pass stall accounting (decode-wait / transfer /
+compute-stall seconds, :class:`StreamStats`) rides the fit result so an
+epoch-rate gap is attributable to a pipeline stage, not guessed at.
 
 Cost model: the default margin-space L-BFGS pays exactly two sparse
 passes per iteration (direction margins + accepted-point gradient) with
@@ -21,8 +25,14 @@ one full pass per evaluation.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
+import logging
+import os
+import queue
+import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -32,12 +42,171 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.compat import shard_map
 from photon_ml_tpu.ops.objective import GLMObjective
-from photon_ml_tpu.parallel.resilience import CollectiveGuard
+from photon_ml_tpu.parallel import fault_injection
+from photon_ml_tpu.parallel.resilience import (
+    CollectiveGuard,
+    current_transport,
+    use_transport,
+)
 from photon_ml_tpu.parallel.data_parallel import cached_jit
 from photon_ml_tpu.optimize.common import OptimizationResult, OptimizerConfig
 from photon_ml_tpu.optimize.lbfgs import two_loop_direction
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures
 from photon_ml_tpu.utils import transfer_budget
+
+_log = logging.getLogger("photon_ml_tpu")
+
+# Device-side prefetch depth of the streamed transfer ring: how many chunks
+# the transfer thread may stage on device ahead of the chunk the consumer
+# is dispatching. Depth 2 covers decode/transfer jitter without holding
+# more than ~4 chunks of HBM (staged + in-flight + current); raise it when
+# decode latency is spiky (cold page cache), lower to 0 for a synchronous
+# single-thread loop (debugging).
+DEFAULT_PREFETCH_DEPTH = 2
+
+
+def resolve_prefetch_depth(depth: Optional[int] = None) -> int:
+    """Explicit depth, else ``PHOTON_PREFETCH_DEPTH``, else the default."""
+    if depth is None:
+        env = os.environ.get("PHOTON_PREFETCH_DEPTH", "")
+        depth = int(env) if env else DEFAULT_PREFETCH_DEPTH
+    return max(int(depth), 0)
+
+
+@dataclasses.dataclass
+class StreamStats:
+    """Host-side pipeline stall accounting for streamed passes.
+
+    ``decode_s``: transfer-thread seconds blocked waiting on the chunk
+    source (disk decode or the source's own producer queue);
+    ``transfer_s``: seconds issuing budget-accounted host->device puts;
+    ``stall_s``: consumer seconds blocked on an empty ring — the compute
+    dispatcher starved of staged data. All three accumulate across every
+    pass of a fit; ``passes``/``chunks`` normalize them."""
+
+    decode_s: float = 0.0
+    transfer_s: float = 0.0
+    stall_s: float = 0.0
+    chunks: int = 0
+    passes: int = 0
+
+    def as_dict(self) -> dict:
+        return {"decode_s": round(self.decode_s, 6),
+                "transfer_s": round(self.transfer_s, 6),
+                "stall_s": round(self.stall_s, 6),
+                "chunks": self.chunks, "passes": self.passes}
+
+
+def _ring_put(q: queue.Queue, stop: threading.Event, item) -> bool:
+    """Stop-aware bounded put (chunks, sentinel and errors alike) so an
+    abandoned consumer can never wedge the transfer thread — same contract
+    as ``AvroChunkSource._put_or_stop``."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.2)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def iter_device_chunks(chunks, to_device: Callable, depth: Optional[int] = None,
+                       stats: Optional[StreamStats] = None):
+    """Yield ``(host_chunk, device_batch)`` with the device batches staged
+    ``depth`` chunks ahead by a dedicated transfer thread.
+
+    This generalizes the old one-chunk lookahead: the transfer thread pulls
+    from the (possibly disk-backed) chunk source and issues the
+    budget-accounted uploads, so decode AND transfer of chunks i+1..i+K
+    overlap the consumer's compute dispatch of chunk i. Exceptions from
+    either the source or the upload are re-raised in the consumer (inside
+    its CollectiveGuard, preserving coordinated-abort semantics), and the
+    consumer's ambient process context (fault-injection identity, simulated
+    transport) is propagated into the transfer thread so per-process fault
+    plans still address decode faults deterministically.
+
+    ``depth=0`` is a synchronous single-thread fallback (JAX async dispatch
+    still overlaps transfer with compute one chunk at a time)."""
+    depth = resolve_prefetch_depth(depth)
+    if stats is not None:
+        stats.passes += 1
+    if depth == 0:
+        t_wait = time.perf_counter()
+        for chunk in chunks:
+            now = time.perf_counter()
+            dev = to_device(chunk)
+            if stats is not None:
+                stats.decode_s += now - t_wait
+                stats.transfer_s += time.perf_counter() - now
+                stats.chunks += 1
+            yield chunk, dev
+            t_wait = time.perf_counter()
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    tp = current_transport()
+    try:
+        fault_proc = tp.process_index()
+    except Exception:
+        fault_proc = None
+
+    def produce():
+        it = iter(chunks)
+        ctx = (fault_injection.process_context(fault_proc)
+               if fault_proc is not None else contextlib.nullcontext())
+        try:
+            with use_transport(tp), ctx:
+                t_wait = time.perf_counter()
+                while True:
+                    try:
+                        chunk = next(it)
+                    except StopIteration:
+                        break
+                    now = time.perf_counter()
+                    if stop.is_set():
+                        return
+                    dev = to_device(chunk)
+                    if stats is not None:
+                        stats.decode_s += now - t_wait
+                        stats.transfer_s += time.perf_counter() - now
+                    if not _ring_put(q, stop, (chunk, dev)):
+                        return
+                    t_wait = time.perf_counter()
+                _ring_put(q, stop, None)  # end-of-pass sentinel
+        except BaseException as e:  # surfaced in the consumer
+            _ring_put(q, stop, e)
+        finally:
+            # deterministically close a generator-backed source so ITS
+            # producer thread (AvroChunkSource) winds down with this pass
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name="stream-transfer")
+    t.start()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            item = q.get()
+            if stats is not None:
+                stats.stall_s += time.perf_counter() - t0
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            if stats is not None:
+                stats.chunks += 1
+            yield item
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        if t.is_alive():
+            _log.warning(
+                "transfer thread %s still alive 30s after the pass ended "
+                "(wedged source or upload); leaking it as a daemon",
+                t.name)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -262,11 +431,13 @@ def streaming_value_and_grad(
     dtype=jnp.float32,
     mesh: Optional[Mesh] = None,
     axis: str = "data",
+    prefetch_depth: Optional[int] = None,
+    stats: Optional[StreamStats] = None,
 ) -> Callable:
     """Returns fg(w, l2) -> (value, grad) computed in ONE streamed pass over
-    the chunks: per-chunk partials accumulate on device, the next chunk's
-    host->device transfer overlaps the current chunk's compute (async
-    dispatch + one-chunk lookahead). L2 is added once at the end.
+    the chunks: per-chunk partials accumulate on device, the transfer
+    thread stages the next ``prefetch_depth`` chunks while the current one
+    computes (:func:`iter_device_chunks`). L2 is added once at the end.
 
     Distributed (``mesh``): the per-chunk kernel is COLLECTIVE-FREE — each
     device accumulates its own Kahan partial under ``shard_map``; one
@@ -308,9 +479,11 @@ def streaming_value_and_grad(
 
     # dim is baked into the kernel closure (the batch rebuild), so it must
     # be part of the cache key: same objective at a different width must
-    # not reuse a kernel with a stale dim
+    # not reuse a kernel with a stale dim. The Kahan accumulators are
+    # DONATED: each chunk's call reuses the previous (loss, grad, comp)
+    # buffers in place instead of allocating a fresh [S, d] pair per chunk.
     chunk_fg_k = cached_jit(objective, ("stream_fg", mesh, axis, dim),
-                            _make_chunk_fg)
+                            _make_chunk_fg, donate_argnums=(6, 7, 8, 9))
     reduce_k = cached_jit(objective, ("stream_fg_reduce", mesh, axis, dim),
                           _make_reduce)
 
@@ -325,15 +498,11 @@ def streaming_value_and_grad(
         # converted into PeerFailure on EVERY process at the pass boundary
         # instead of wedging its peers inside _cross_process_sum
         with CollectiveGuard("stream.fg"):
-            # one-chunk lookahead: transfer chunk i+1 while chunk i computes
-            pending = None
-            for chunk in chunks:
-                dev = _chunk_to_device(chunk, dim, dtype, sharding)
-                if pending is not None:
-                    acc = chunk_fg_k(w, *_batch_args(pending), *acc)
-                pending = dev
-            if pending is not None:
-                acc = chunk_fg_k(w, *_batch_args(pending), *acc)
+            for _hc, dev in iter_device_chunks(
+                    chunks,
+                    lambda c: _chunk_to_device(c, dim, dtype, sharding),
+                    prefetch_depth, stats):
+                acc = chunk_fg_k(w, *_batch_args(dev), *acc)
             # ONE cross-shard reduction per pass; its output is consumed by
             # the host right away, so at most one collective is in flight
             f_acc, g_acc = reduce_k(*acc)
@@ -352,6 +521,8 @@ def streaming_hvp(
     dtype=jnp.float32,
     mesh: Optional[Mesh] = None,
     axis: str = "data",
+    prefetch_depth: Optional[int] = None,
+    stats: Optional[StreamStats] = None,
 ) -> Callable:
     """Returns hvp(w, v, l2) computed in one streamed pass — the cost model
     of the reference's HessianVectorAggregator treeAggregate per CG step
@@ -376,7 +547,7 @@ def streaming_hvp(
                                 acc_ndims=(2, 2))
 
     chunk_hvp_k = cached_jit(objective, ("stream_hvp", mesh, axis, dim),
-                             _make_chunk_hvp)
+                             _make_chunk_hvp, donate_argnums=(6, 7))
     reduce_k = cached_jit(objective, ("stream_hvp_reduce", mesh, axis, dim),
                           _make_kahan_reduce)
 
@@ -386,8 +557,10 @@ def streaming_hvp(
         acc = _sharded_zeros((S, dim), dtype, mesh, axis)
         comp = _sharded_zeros((S, dim), dtype, mesh, axis)
         with CollectiveGuard("stream.hvp"):  # see streaming_value_and_grad
-            for chunk in chunks:
-                dev = _chunk_to_device(chunk, dim, dtype, sharding)
+            for _hc, dev in iter_device_chunks(
+                    chunks,
+                    lambda c: _chunk_to_device(c, dim, dtype, sharding),
+                    prefetch_depth, stats):
                 acc, comp = chunk_hvp_k((w, v), *_batch_args(dev), acc,
                                         comp)
             total = reduce_k(acc, comp)
@@ -406,13 +579,16 @@ def streaming_coefficient_variances(
     dtype=jnp.float32,
     mesh: Optional[Mesh] = None,
     axis: str = "data",
+    prefetch_depth: Optional[int] = None,
+    stats: Optional[StreamStats] = None,
 ) -> jax.Array:
     """Diagonal-inverse-Hessian coefficient variances over a streamed pass
     (the in-memory ``GLMObjective.coefficient_variances``, chunked). The
     data term accumulates per chunk (l2=0 adds nothing); the regularization
     diagonal is added once at the end."""
     diag = streaming_hessian_diagonal(objective, chunks, dim, w, l2,
-                                      dtype, mesh, axis)
+                                      dtype, mesh, axis, prefetch_depth,
+                                      stats)
     return 1.0 / jnp.maximum(diag, jnp.finfo(dtype).tiny)
 
 
@@ -425,6 +601,8 @@ def streaming_hessian_diagonal(
     dtype=jnp.float32,
     mesh: Optional[Mesh] = None,
     axis: str = "data",
+    prefetch_depth: Optional[int] = None,
+    stats: Optional[StreamStats] = None,
 ) -> jax.Array:
     """Exact Hessian diagonal over one streamed (Kahan-compensated) pass —
     shared by coefficient variances and TRON's Jacobi preconditioner.
@@ -446,7 +624,7 @@ def streaming_hessian_diagonal(
                                 acc_ndims=(2, 2))
 
     chunk_diag_k = cached_jit(objective, ("stream_diag", mesh, axis, dim),
-                              _make_chunk_diag)
+                              _make_chunk_diag, donate_argnums=(6, 7))
     reduce_k = cached_jit(objective, ("stream_diag_reduce", mesh, axis, dim),
                           _make_kahan_reduce)
 
@@ -454,8 +632,9 @@ def streaming_hessian_diagonal(
     acc = _sharded_zeros((S, dim), dtype, mesh, axis)
     comp = _sharded_zeros((S, dim), dtype, mesh, axis)
     with CollectiveGuard("stream.diag"):  # see streaming_value_and_grad
-        for chunk in chunks:
-            dev = _chunk_to_device(chunk, dim, dtype, sharding)
+        for _hc, dev in iter_device_chunks(
+                chunks, lambda c: _chunk_to_device(c, dim, dtype, sharding),
+                prefetch_depth, stats):
             acc, comp = chunk_diag_k(w, *_batch_args(dev), acc, comp)
         total = reduce_k(acc, comp)
     total = _cross_process_sum(total)
@@ -478,6 +657,7 @@ def fit_streaming(
     optimizer: str = "lbfgs",
     l1=0.0,
     progress_callback: Optional[Callable] = None,
+    prefetch_depth: Optional[int] = None,
 ) -> OptimizationResult:
     """Streamed (larger-than-HBM) full-batch fit.
 
@@ -509,24 +689,31 @@ def fit_streaming(
         optimizer = "lbfgs"
     if np.asarray(l1).item() > 0 and optimizer != "owlqn":
         optimizer = "owlqn"
+    stats = StreamStats()
     if optimizer == "tron":
-        return _fit_streaming_tron(objective, chunks, dim, w0, l2, config,
-                                   dtype, mesh, axis, progress_callback)
+        res = _fit_streaming_tron(objective, chunks, dim, w0, l2, config,
+                                  dtype, mesh, axis, progress_callback,
+                                  prefetch_depth, stats)
+        return _finish_stream_result(res, stats, "tron")
     if optimizer == "owlqn":
-        return _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1,
-                                    config, dtype, mesh, axis,
-                                    progress_callback)
+        res = _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1,
+                                   config, dtype, mesh, axis,
+                                   progress_callback, prefetch_depth, stats)
+        return _finish_stream_result(res, stats, "owlqn")
     if optimizer == "lbfgs":
-        return _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2,
-                                           config, dtype, mesh, axis,
-                                           progress_callback)
+        res = _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2,
+                                          config, dtype, mesh, axis,
+                                          progress_callback, prefetch_depth,
+                                          stats)
+        return _finish_stream_result(res, stats, "lbfgs")
     if optimizer != "lbfgs_blackbox":
         raise ValueError(f"unknown streaming optimizer '{optimizer}'")
     m = config.history
     if w0 is None:
         w0 = jnp.zeros((dim,), dtype)
     w = jnp.asarray(w0, dtype)
-    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis)
+    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis,
+                                  prefetch_depth, stats)
 
     direction, store_pair = _lbfgs_stream_kernels(objective, mesh, axis, m)
 
@@ -607,12 +794,24 @@ def fit_streaming(
     else:
         it = config.max_iters
 
-    return OptimizationResult(
+    return _finish_stream_result(OptimizationResult(
         w=w, value=f, grad_norm=jnp.linalg.norm(g),
         iterations=jnp.asarray(it), converged=jnp.asarray(converged),
         loss_history=jnp.asarray(loss_hist),
         grad_norm_history=jnp.asarray(gnorm_hist),
-    )
+    ), stats, "lbfgs_blackbox")
+
+
+def _finish_stream_result(res: OptimizationResult, stats: StreamStats,
+                          optimizer: str) -> OptimizationResult:
+    """Attach the fit-wide pipeline stall accounting to the result and log
+    the one-line breakdown measurement harnesses grep for."""
+    _log.info(
+        "streamed %s fit: %d passes / %d chunk transfers; decode-wait "
+        "%.3fs, transfer %.3fs, compute-stall %.3fs",
+        optimizer, stats.passes, stats.chunks, stats.decode_s,
+        stats.transfer_s, stats.stall_s)
+    return res._replace(stream_stats=stats.as_dict())
 
 
 def _lbfgs_stream_kernels(objective, mesh, axis, m):
@@ -638,8 +837,9 @@ def _lbfgs_stream_kernels(objective, mesh, axis, m):
 
 
 def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
-                                dtype, mesh, axis,
-                                progress_callback=None) -> OptimizationResult:
+                                dtype, mesh, axis, progress_callback=None,
+                                prefetch_depth=None,
+                                stats=None) -> OptimizationResult:
     """Streamed L-BFGS with margin-space line search (the default).
 
     The black-box streamed loop pays one FULL sparse pass (index gather +
@@ -673,7 +873,8 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
         w0 = jnp.zeros((dim,), dtype)
     w = jnp.asarray(w0, dtype)
     sharding = NamedSharding(mesh, P(axis)) if mesh is not None else None
-    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis)
+    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis,
+                                  prefetch_depth, stats)
 
     margin_k = cached_jit(
         objective, ("stream_margin", mesh, axis),
@@ -728,7 +929,7 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
 
     trial_k = cached_jit(objective,
                          ("stream_trial_delta_ladder", mesh, axis, L),
-                         _make_trial)
+                         _make_trial, donate_argnums=(5, 6))
     trial_reduce_k = cached_jit(
         objective, ("stream_trial_reduce", mesh, axis, L),
         _make_kahan_reduce)
@@ -760,21 +961,23 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
 
     def margins_of(vec, out):
         """One streamed gather pass: per-chunk margins of ``vec`` (offsets
-        included), stored to host numpy in ``out``. One-chunk lookahead:
-        chunk i+1's transfer+compute dispatch before chunk i's
-        device->host fetch blocks, mirroring fg's overlap."""
+        included), stored to host numpy in ``out``. The transfer ring
+        stages chunk i+1..i+K while chunk i's margins compute, and the
+        device->host fetch of chunk i-1 overlaps chunk i's dispatch."""
         # guarded even though this pass itself has no collective: in SPMD
         # lockstep the peers run this same pass, and a process failing
         # here would otherwise strand them at the NEXT phase's barrier
         # until the watchdog instead of aborting promptly
         with CollectiveGuard("stream.margins"):
             pending = None
-            for i, chunk in enumerate(chunks):
+            for i, (chunk, dev) in enumerate(iter_device_chunks(
+                    chunks,
+                    lambda c: _chunk_to_device(c, dim, dtype, sharding),
+                    prefetch_depth, stats)):
                 if labels_h[i] is None:
                     labels_h[i] = chunk.labels
                     weights_h[i] = chunk.weights
                     offsets_h[i] = chunk.offsets
-                dev = _chunk_to_device(chunk, dim, dtype, sharding)
                 res = margin_k(vec, dev)
                 if pending is not None:
                     out[pending[0]] = np.asarray(pending[1])
@@ -922,7 +1125,8 @@ _SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
 
 
 def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
-                        axis, progress_callback=None) -> OptimizationResult:
+                        axis, progress_callback=None, prefetch_depth=None,
+                        stats=None) -> OptimizationResult:
     """Host-loop TRON mirroring ``optimize.tron``: Steihaug CG inner loop
     where every Hessian-vector product is one streamed pass over the data —
     the reference's one-treeAggregate-per-CG-step cost model (SURVEY.md
@@ -930,8 +1134,10 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
     if w0 is None:
         w0 = jnp.zeros((dim,), dtype)
     w = jnp.asarray(w0, dtype)
-    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis)
-    hvp = streaming_hvp(objective, chunks, dim, dtype, mesh, axis)
+    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis,
+                                  prefetch_depth, stats)
+    hvp = streaming_hvp(objective, chunks, dim, dtype, mesh, axis,
+                        prefetch_depth, stats)
     max_cg = max(dim, 20)
     eps = float(jnp.finfo(dtype).eps)
 
@@ -986,7 +1192,8 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
         gnorm = float(jnp.linalg.norm(g))
         if m_diag is None:  # recomputed only after an ACCEPTED step
             md = streaming_hessian_diagonal(objective, chunks, dim, w, l2,
-                                            dtype, mesh, axis)
+                                            dtype, mesh, axis,
+                                            prefetch_depth, stats)
             # same relative positivity floor as optimize.tron
             m_diag = jnp.maximum(md, eps * jnp.maximum(float(jnp.max(md)),
                                                        1.0))
@@ -1048,7 +1255,8 @@ def _fit_streaming_tron(objective, chunks, dim, w0, l2, config, dtype, mesh,
 
 
 def _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1, config, dtype,
-                         mesh, axis, progress_callback=None
+                         mesh, axis, progress_callback=None,
+                         prefetch_depth=None, stats=None
                          ) -> OptimizationResult:
     """Host-loop OWL-QN mirroring ``optimize.owlqn`` (Andrew & Gao 2007):
     pseudo-gradient from the streamed smooth gradient, L-BFGS direction on
@@ -1060,7 +1268,8 @@ def _fit_streaming_owlqn(objective, chunks, dim, w0, l2, l1, config, dtype,
     if w0 is None:
         w0 = jnp.zeros((dim,), dtype)
     w = jnp.asarray(w0, dtype)
-    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis)
+    fg = streaming_value_and_grad(objective, chunks, dim, dtype, mesh, axis,
+                                  prefetch_depth, stats)
     mask = jnp.ones((dim,), dtype)
     if objective.intercept_index >= 0 and not objective.regularize_intercept:
         mask = mask.at[objective.intercept_index].set(0.0)

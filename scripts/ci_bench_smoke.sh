@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Non-tier-1 bench smoke: run `bench.py stream` on a tiny synthetic shard
+# (CPU, seconds) so the streamed-throughput bench mode cannot rot between
+# hardware rounds. Runs alongside — never instead of — scripts/ci_tier1.sh.
+# The mode self-checks its acceptance invariants (warm >= 2x cold, f64
+# cache parity <= 1e-9, flat compile count) and exits non-zero on failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu \
+BENCH_STREAM_ROWS="${BENCH_STREAM_ROWS:-8000}" \
+BENCH_STREAM_FIT_ITERS="${BENCH_STREAM_FIT_ITERS:-3}" \
+timeout -k 10 600 python bench.py stream

@@ -1,0 +1,360 @@
+"""Decode-once chunk cache (io/chunk_cache.py): warm passes must be
+bit-faithful to the decoded source, invalidation must be airtight (touched
+files, changed chunk geometry, changed index map), interrupted writes must
+never publish a partial cache, and a blown disk budget must fall through
+to plain re-decode — the cache is a transparent accelerator, never a new
+failure mode."""
+
+import json
+import logging
+import os
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from photon_ml_tpu.io.chunk_cache import ChunkCacheSource
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.io.index_map import IndexMap
+from photon_ml_tpu.io.stream_source import AvroChunkSource, ScalarOverlaySource
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.parallel import fault_injection
+from photon_ml_tpu.parallel.streaming import fit_streaming
+
+
+def _write_dataset(tmp_path, rng, n=240, vocab=40, max_k=6, name="train",
+                   block_size=2048):
+    rows = []
+    for _ in range(n):
+        k = int(rng.integers(1, max_k + 1))
+        cols = rng.choice(vocab, size=k, replace=False)
+        rows.append([(f"f{c}", "", float(rng.normal())) for c in cols])
+    labels = rng.integers(0, 2, n).astype(float)
+    weights = rng.uniform(0.5, 2.0, n)
+    offsets = rng.normal(0, 0.1, n)
+    path = str(tmp_path / f"{name}.avro")
+    write_training_examples(path, rows, labels, offsets=offsets,
+                            weights=weights, block_size=block_size)
+    imap = IndexMap({f"f{c}": c for c in range(vocab)}, add_intercept=True)
+    return path, imap
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _chunk_fields_equal(a, b):
+    for f in ("indices", "values", "labels", "offsets", "weights"):
+        fa, fb = getattr(a, f), getattr(b, f)
+        if fa is None or fb is None:
+            assert fa is None and fb is None
+        else:
+            np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+def test_warm_chunks_bit_identical_to_source(tmp_path, rng):
+    path, imap = _write_dataset(tmp_path, rng)
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    cache = ChunkCacheSource(src, str(tmp_path / "cache"))
+    ref = list(src)
+    cold = list(cache)
+    warm = list(cache)
+    warm2 = list(cache)
+    assert cache.cold_passes == 1 and cache.warm_passes == 2
+    # decode ran exactly twice: the reference pass + the single cold pass
+    assert src.passes == 2
+    assert len(ref) == len(cold) == len(warm) == len(cache)
+    for a, b, c, d in zip(ref, cold, warm, warm2):
+        _chunk_fields_equal(a, b)
+        _chunk_fields_equal(a, c)
+        _chunk_fields_equal(a, d)
+    assert cache.bytes_written > 0
+    # committed layout: META + one packed file per field, no staging left
+    names = sorted(os.listdir(cache.cache_path))
+    assert "META.json" in names
+    assert not any(n.startswith(".tmp-")
+                   for n in os.listdir(cache.cache_dir))
+
+
+def test_cache_survives_reconstruction_and_fits_identically(tmp_path, rng):
+    """A second job over the same inputs opens the committed cache warm
+    (no cold pass at all), and a cached f64 fit matches the no-cache
+    streamed fit to <= 1e-9 — the acceptance contract."""
+    path, imap = _write_dataset(tmp_path, rng)
+    cache_dir = str(tmp_path / "cache")
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    list(ChunkCacheSource(src, cache_dir))  # job 1: cold pass commits
+
+    src2 = AvroChunkSource(path, imap, chunk_rows=64)
+    cache2 = ChunkCacheSource(src2, cache_dir)
+    obj = make_objective("logistic")
+    cfg = OptimizerConfig(max_iters=8, tolerance=0.0)
+    r_cached = fit_streaming(obj, cache2, cache2.dim, l2=0.5, config=cfg,
+                             dtype=jnp.float64)
+    assert cache2.cold_passes == 0 and cache2.warm_passes > 0
+    assert src2.passes == 0  # decode-once: the warm job never decodes
+
+    src3 = AvroChunkSource(path, imap, chunk_rows=64)
+    r_raw = fit_streaming(obj, src3, src3.dim, l2=0.5, config=cfg,
+                          dtype=jnp.float64)
+    diff = np.max(np.abs(np.asarray(r_cached.w) - np.asarray(r_raw.w)))
+    assert diff <= 1e-9, diff
+
+
+@pytest.mark.parametrize("staleness", ["touch", "chunk_rows", "index_map"])
+def test_stale_fingerprint_forces_redecode(tmp_path, rng, staleness):
+    path, imap = _write_dataset(tmp_path, rng)
+    cache_dir = str(tmp_path / "cache")
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    cache = ChunkCacheSource(src, cache_dir)
+    list(cache)
+    assert cache.cold_passes == 1
+
+    chunk_rows = 64
+    if staleness == "touch":
+        st = os.stat(path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    elif staleness == "chunk_rows":
+        chunk_rows = 32
+    else:
+        imap = IndexMap({f"f{c}": c + 1 if c else 0 for c in range(40)},
+                        add_intercept=True)
+    src2 = AvroChunkSource(path, imap, chunk_rows=chunk_rows,
+                           pad_nnz=src.pad_nnz)
+    cache2 = ChunkCacheSource(src2, cache_dir)
+    chunks = list(cache2)
+    # the stale cache was neither opened nor kept: this was a cold pass
+    assert cache2.cold_passes == 1 and cache2.warm_passes == 0
+    assert src2.passes == 1
+    assert len(chunks) == len(src2)
+    # ... and the old committed dir was swept (only the fresh one remains)
+    committed = [d for d in os.listdir(cache_dir)
+                 if d.startswith("chunks-")]
+    assert committed == [os.path.basename(cache2.cache_path)]
+
+
+@pytest.mark.parametrize("site,at", [("chunk_cache.spill", 2),
+                                     ("chunk_cache.commit", 0)])
+def test_interrupted_cache_write_leaves_no_partial_cache(tmp_path, rng,
+                                                         site, at):
+    """A crash mid-spill or right before the atomic rename must leave NO
+    visible cache — the next pass re-decodes cold and commits cleanly."""
+    path, imap = _write_dataset(tmp_path, rng)
+    cache_dir = str(tmp_path / "cache")
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    cache = ChunkCacheSource(src, cache_dir)
+    fault_injection.install([fault_injection.Fault(site=site, at=at)])
+    try:
+        with pytest.raises(fault_injection.InjectedFault):
+            list(cache)
+    finally:
+        fault_injection.clear()
+    assert not any(d.startswith("chunks-") for d in os.listdir(cache_dir))
+    # staging from THIS live process is cleaned by the generator unwind
+    assert not any(d.startswith(".tmp-") for d in os.listdir(cache_dir))
+
+    ref = list(src)
+    again = list(cache)
+    assert cache.warm_passes == 0  # both passes above were interrupted/cold
+    warm = list(cache)
+    assert cache.warm_passes == 1
+    for a, b, c in zip(ref, again, warm):
+        _chunk_fields_equal(a, b)
+        _chunk_fields_equal(a, c)
+
+
+def test_disk_budget_overflow_falls_through_with_warning(tmp_path, rng,
+                                                         caplog):
+    path, imap = _write_dataset(tmp_path, rng)
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    cache = ChunkCacheSource(src, str(tmp_path / "cache"), max_bytes=128)
+    ref = list(src)
+    with caplog.at_level(logging.WARNING, logger="photon_ml_tpu"):
+        got = list(cache)
+    assert any("disk budget" in r.message for r in caplog.records)
+    assert not cache.enabled
+    for a, b in zip(ref, got):
+        _chunk_fields_equal(a, b)
+    # later passes re-decode (fall-through), never a partial cache
+    list(cache)
+    assert cache.fallthrough_passes == 1 and cache.warm_passes == 0
+    assert not any(d.startswith("chunks-")
+                   for d in os.listdir(str(tmp_path / "cache")))
+
+
+def test_corrupt_committed_cache_is_removed_and_redecoded(tmp_path, rng):
+    path, imap = _write_dataset(tmp_path, rng)
+    cache_dir = str(tmp_path / "cache")
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    cache = ChunkCacheSource(src, cache_dir)
+    ref = list(cache)
+    # truncate one packed field file behind the cache's back
+    victim = os.path.join(cache.cache_path, "labels.bin")
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 2)
+    cache2 = ChunkCacheSource(AvroChunkSource(path, imap, chunk_rows=64),
+                              cache_dir)
+    got = list(cache2)
+    assert cache2.cold_passes == 1  # corrupt cache refused, re-decoded
+    for a, b in zip(ref, got):
+        _chunk_fields_equal(a, b)
+    list(cache2)
+    assert cache2.warm_passes == 1  # and the fresh commit serves warm
+
+
+def test_scalar_overlay_on_warm_cache_updates_offsets(tmp_path, rng):
+    """The CD residual-offset path: per-pass scalars must overlay cached
+    (memmap-backed) chunks without touching the decoder."""
+    path, imap = _write_dataset(tmp_path, rng, n=200)
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    cache = ChunkCacheSource(src, str(tmp_path / "cache"))
+    list(cache)  # commit
+    n = src.rows
+    for k in range(3):  # a fresh overlay per "CD step"
+        offs = np.arange(n, dtype=float) + 100 * k
+        ov = ScalarOverlaySource(cache, offsets=offs)
+        got = np.concatenate([c.offsets[c.weights > 0] for c in ov])
+        np.testing.assert_allclose(got, offs)
+    assert src.passes == 1  # every overlay pass was a cache hit
+
+
+def test_game_cd_out_of_core_cached_matches_uncached(tmp_path, rng):
+    """End to end: a GAME CD whose fixed effect streams through the chunk
+    cache must reproduce the uncached out-of-core run exactly (the cache
+    serves the same bytes, so even f32 trajectories are bit-equal)."""
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        GameDataset,
+    )
+    from photon_ml_tpu.io.data_reader import read_training_examples
+
+    n = 192
+    path, imap = _write_dataset(tmp_path, rng, n=n, block_size=64)
+    feats, labels, offsets, weights, _, _ = read_training_examples(
+        path, {"global": imap})
+    users = rng.integers(0, 8, n).astype(str)
+    hs = feats["global"]
+    configs = [
+        CoordinateConfig("fixed", "fixed", feature_shard="global",
+                         streaming=True, chunk_rows=64, max_iters=8,
+                         reg_type="l2", reg_weight=0.5, prefetch_depth=3),
+        CoordinateConfig("per-user", "random", feature_shard="re",
+                         entity_column="userId", max_iters=8,
+                         reg_type="l2", reg_weight=1.0),
+    ]
+
+    def run(source):
+        ds = GameDataset({"re": hs}, labels, weights, offsets,
+                         {"userId": users},
+                         feature_sources={"global": source})
+        return CoordinateDescent(configs, n_iterations=2).run(ds)
+
+    src_a = AvroChunkSource(path, imap, chunk_rows=64)
+    model_raw, hist_raw = run(src_a)
+    src_b = AvroChunkSource(path, imap, chunk_rows=64)
+    cache = ChunkCacheSource(src_b, str(tmp_path / "cache"))
+    model_cached, hist_cached = run(cache)
+
+    assert cache.cold_passes == 1 and cache.warm_passes > 0
+    # every pass after the first was decode-free
+    assert src_b.passes == 1 < src_a.passes
+    w_raw = np.asarray(model_raw.coordinates["fixed"]
+                       .model.coefficients.means)
+    w_cached = np.asarray(model_cached.coordinates["fixed"]
+                          .model.coefficients.means)
+    np.testing.assert_array_equal(w_cached, w_raw)
+    for a, b in zip(hist_raw, hist_cached):
+        if "loss" in a:
+            assert b["loss"] == a["loss"]
+    # the streamed fixed effect recorded its stall breakdown
+    streamed = [h for h in hist_cached if h["coordinate"] == "fixed"]
+    assert all("stream" in h for h in streamed)
+
+
+def test_glm_driver_chunk_cache_flags(tmp_path, rng):
+    """Driver leg: --out-of-core --chunk-cache-dir --prefetch-depth runs,
+    commits a cache, and a rerun serves it warm; the cache flags refuse
+    in-RAM runs."""
+    from photon_ml_tpu.cli import glm_driver
+
+    path, imap_ = _write_dataset(tmp_path, rng, n=150)
+    imap_path = str(tmp_path / "imap.json")
+    imap_.save(imap_path)
+    cache_dir = str(tmp_path / "cache")
+    out1, out2 = str(tmp_path / "out1"), str(tmp_path / "out2")
+    argv = ["--train-data", path, "--input-format", "avro",
+            "--out-of-core", "--index-map", imap_path,
+            "--chunk-rows", "64", "--max-iters", "4",
+            "--chunk-cache-dir", cache_dir, "--chunk-cache-gb", "1",
+            "--prefetch-depth", "3", "--reg-weights", "1.0"]
+    assert glm_driver.main(argv + ["--output-dir", out1]) == 0
+    committed = [d for d in os.listdir(cache_dir)
+                 if d.startswith("chunks-")]
+    assert len(committed) == 1
+    meta = json.load(open(os.path.join(cache_dir, committed[0],
+                                       "META.json")))
+    assert meta["n_chunks"] == 3  # 150 rows / 64
+    # rerun: same fingerprint, cache reused (mtime preserved, same map)
+    assert glm_driver.main(argv + ["--output-dir", out2]) == 0
+    assert [d for d in os.listdir(cache_dir)
+            if d.startswith("chunks-")] == committed
+    # per-lambda log records carry the stream stall breakdown
+    with open(os.path.join(out1, "photon.log.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    trained = [r for r in recs if r.get("event") == "lambda_trained"]
+    assert trained and all("stream" in r for r in trained)
+
+    with pytest.raises(SystemExit, match="chunk-cache-dir requires"):
+        glm_driver.main(["--train-data", path, "--output-dir", out1,
+                         "--chunk-cache-dir", cache_dir])
+
+
+def test_fingerprint_requires_introspectable_source(tmp_path, rng):
+    """A source the cache cannot fingerprint is refused loudly unless the
+    caller provides the invalidation key; with one, plain chunk lists
+    cache fine (the test-harness path)."""
+    from photon_ml_tpu.game.data import HostSparse
+    from photon_ml_tpu.parallel.streaming import make_host_chunks
+
+    idx = rng.integers(0, 16, (96, 3)).astype(np.int32)
+    vals = rng.normal(size=(96, 3))
+    chunks, _ = make_host_chunks(HostSparse(idx, vals, 16),
+                                 rng.integers(0, 2, 96).astype(float),
+                                 chunk_rows=32)
+    with pytest.raises(ValueError, match="fingerprint"):
+        ChunkCacheSource(chunks, str(tmp_path / "cache"))
+    cache = ChunkCacheSource(chunks, str(tmp_path / "cache"),
+                             fingerprint={"test": "key"})
+    cold, warm = list(cache), list(cache)
+    assert cache.cold_passes == 1 and cache.warm_passes == 1
+    for a, b in zip(cold, warm):
+        _chunk_fields_equal(a, b)
+
+
+def test_producer_join_timeout_is_detected(tmp_path, rng, monkeypatch,
+                                           caplog):
+    """Satellite: a wedged producer thread surviving the end-of-pass join
+    must be counted and warned about, never leaked invisibly."""
+    path, imap = _write_dataset(tmp_path, rng, n=80)
+    src = AvroChunkSource(path, imap, chunk_rows=32)
+    first_chunk = next(iter(src))
+
+    def wedged(q, stop, fault_proc=None):
+        q.put(first_chunk)
+        time.sleep(1.5)  # a decoder stuck outside the stop event
+
+    monkeypatch.setattr(src, "_produce", wedged)
+    monkeypatch.setattr(AvroChunkSource, "_join_timeout", 0.05)
+    it = iter(src)
+    next(it)
+    with caplog.at_level(logging.WARNING, logger="photon_ml_tpu"):
+        it.close()
+    assert src.producer_join_timeouts == 1
+    assert any("avro-chunk-producer" in r.getMessage()
+               for r in caplog.records)

@@ -564,3 +564,138 @@ def test_streamed_fit_with_normalization_matches_in_memory(sparse_problem):
                                rtol=1e-8)
     np.testing.assert_allclose(np.asarray(res_s.w), np.asarray(res_m.w),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_streamed_f32_kahan_matches_in_memory_f64_reference(rng):
+    """Satellite contract: the f32 STREAMED (loss, grad) over many chunks
+    must track the f64 IN-MEMORY objective — the end-to-end form of the
+    compensated-accumulation guarantee (streamed-vs-streamed drift is
+    covered above; this pins the absolute anchor so a bug that biases
+    both streamed dtypes identically cannot hide)."""
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.parallel.streaming import (
+        make_host_chunks, streaming_value_and_grad,
+    )
+    from photon_ml_tpu.game.data import HostSparse
+
+    n, k, dim, chunk_rows = 1 << 14, 6, 48, 64  # 256 chunks
+    indices = rng.integers(0, dim, (n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k))
+    labels = rng.integers(0, 2, n).astype(np.float64)
+    weights = rng.uniform(0.5, 2.0, n)
+    offsets = rng.normal(size=n) * 0.1
+    chunks, _ = make_host_chunks(HostSparse(indices, vals, dim), labels,
+                                 offsets, weights, chunk_rows=chunk_rows)
+    assert len(chunks) == 256
+
+    obj = make_objective("logistic")
+    w = rng.normal(size=dim) * 0.1
+    fg32 = streaming_value_and_grad(obj, chunks, dim, dtype=jnp.float32)
+    f32_, g32 = fg32(jnp.asarray(w, jnp.float32), 0.3)
+
+    from photon_ml_tpu.types import SparseFeatures
+
+    batch = make_batch(
+        SparseFeatures(jnp.asarray(indices), jnp.asarray(vals), dim=dim),
+        labels, offsets, weights, dtype=jnp.float64)
+    f64_, g64 = obj.value_and_grad(jnp.asarray(w), batch, 0.3)
+
+    rel_f = abs(float(f32_) - float(f64_)) / abs(float(f64_))
+    assert rel_f < 2e-6, rel_f
+    g32 = np.asarray(g32, np.float64)
+    g64 = np.asarray(g64)
+    rel_g = float(np.max(np.abs(g32 - g64)
+                         / np.maximum(np.abs(g64), 1e-3 * np.abs(g64).max())))
+    assert rel_g < 5e-5, rel_g
+
+
+def test_streamed_accumulation_chunk_order_invariant(rng):
+    """Permuting the chunk order must not move the compensated f32 totals
+    beyond a few ulps: the Kahan fold keeps the streamed pass effectively
+    associative, so block-share reassignment (multi-process part splits)
+    cannot shift results."""
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.parallel.streaming import (
+        make_host_chunks, streaming_value_and_grad,
+    )
+    from photon_ml_tpu.game.data import HostSparse
+
+    n, k, dim, chunk_rows = 1 << 13, 6, 32, 64  # 128 chunks
+    indices = rng.integers(0, dim, (n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k))
+    labels = rng.integers(0, 2, n).astype(np.float64)
+    chunks, _ = make_host_chunks(HostSparse(indices, vals, dim), labels,
+                                 chunk_rows=chunk_rows)
+    perm = list(np.random.default_rng(3).permutation(len(chunks)))
+    shuffled = [chunks[i] for i in perm]
+
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=dim) * 0.1, jnp.float32)
+    f_a, g_a = streaming_value_and_grad(obj, chunks, dim,
+                                        dtype=jnp.float32)(w, 0.3)
+    f_b, g_b = streaming_value_and_grad(obj, shuffled, dim,
+                                        dtype=jnp.float32)(w, 0.3)
+    np.testing.assert_allclose(float(f_a), float(f_b), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_a), np.asarray(g_b),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_prefetch_depth_does_not_change_results(rng):
+    """The transfer ring is a latency optimization only: depth 0
+    (synchronous), 1 and 4 must produce bit-identical streamed totals."""
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.parallel.streaming import (
+        make_host_chunks, streaming_value_and_grad,
+    )
+    from photon_ml_tpu.game.data import HostSparse
+
+    n, k, dim = 2000, 5, 24
+    indices = rng.integers(0, dim, (n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k))
+    labels = rng.integers(0, 2, n).astype(np.float64)
+    chunks, _ = make_host_chunks(HostSparse(indices, vals, dim), labels,
+                                 chunk_rows=128)
+    obj = make_objective("logistic")
+    w = jnp.asarray(rng.normal(size=dim), jnp.float64)
+    outs = []
+    for depth in (0, 1, 4):
+        fg = streaming_value_and_grad(obj, chunks, dim, dtype=jnp.float64,
+                                      prefetch_depth=depth)
+        f, g = fg(w, 0.2)
+        outs.append((float(f), np.asarray(g)))
+    for f, g in outs[1:]:
+        assert f == outs[0][0]
+        np.testing.assert_array_equal(g, outs[0][1])
+
+
+def test_stream_stats_attached_to_fit_result(rng):
+    """Streamed fits must carry the pipeline stall breakdown; in-memory
+    fits must not (None)."""
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.parallel.streaming import make_host_chunks
+    from photon_ml_tpu.game.data import HostSparse
+
+    n, k, dim = 1500, 4, 16
+    indices = rng.integers(0, dim, (n, k)).astype(np.int32)
+    vals = rng.normal(size=(n, k))
+    labels = rng.integers(0, 2, n).astype(np.float64)
+    chunks, _ = make_host_chunks(HostSparse(indices, vals, dim), labels,
+                                 chunk_rows=256)
+    obj = make_objective("logistic")
+    res = fit_streaming(obj, chunks, dim, l2=0.5,
+                        config=OptimizerConfig(max_iters=3, tolerance=0.0),
+                        dtype=jnp.float64)
+    assert res.stream_stats is not None
+    assert res.stream_stats["passes"] >= 2  # initial fg + per-iter passes
+    assert res.stream_stats["chunks"] >= res.stream_stats["passes"]
+    for key in ("decode_s", "transfer_s", "stall_s"):
+        assert res.stream_stats[key] >= 0.0
+
+    from photon_ml_tpu.types import SparseFeatures
+
+    batch = make_batch(
+        SparseFeatures(jnp.asarray(indices), jnp.asarray(vals), dim=dim),
+        labels, dtype=jnp.float64)
+    mem = fit_distributed(obj, batch, make_mesh(), jnp.zeros(dim), l2=0.5,
+                          config=OptimizerConfig(max_iters=3))
+    assert mem.stream_stats is None
