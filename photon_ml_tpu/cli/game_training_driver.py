@@ -48,6 +48,31 @@ from photon_ml_tpu.types import make_batch
 from photon_ml_tpu.utils import PhotonLogger, Timed, resolve_dtype
 
 
+def _positive_int(value: str) -> int:
+    n = int(value)
+    if n <= 0:
+        raise argparse.ArgumentTypeError(
+            f"expected a positive integer, got {value!r}")
+    return n
+
+
+def _finite_nonneg_float(value: str) -> float:
+    x = float(value)
+    if not np.isfinite(x) or x < 0:
+        raise argparse.ArgumentTypeError(
+            f"expected a finite float >= 0, got {value!r}")
+    return x
+
+
+def _tol_schedule(value: str):
+    from photon_ml_tpu.optimize import parse_tolerance_schedule
+
+    try:
+        return parse_tolerance_schedule(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="GAME training driver (TPU-native)")
     p.add_argument("--train-data", required=True, nargs="+",
@@ -60,6 +85,35 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="path to coordinate-config JSON, or inline JSON")
     p.add_argument("--evaluators", nargs="*", default=None)
     p.add_argument("--n-iterations", type=int, default=1)
+    p.add_argument("--cd-tolerance", type=_finite_nonneg_float, default=0.0,
+                   help="sweep-level early exit: stop once every "
+                        "coordinate's score vector moved by at most this "
+                        "(max-abs) over a whole sweep; 0 disables (exactly "
+                        "--n-iterations sweeps run). Must be finite — "
+                        "nan/inf would silently disable or always trigger "
+                        "the test")
+    p.add_argument("--re-active-set", action="store_true", default=None,
+                   help="active-set coordinate descent for random effects "
+                        "(the CoordinateConfig default): converged "
+                        "entities whose coefficients stopped moving are "
+                        "frozen and later sweeps solve only the "
+                        "unconverged frontier")
+    p.add_argument("--no-re-active-set", dest="re_active_set",
+                   action="store_false",
+                   help="re-solve every entity every sweep (the exact "
+                        "fixed-sweep schedule)")
+    p.add_argument("--re-refresh-every", type=_positive_int, default=None,
+                   help="with the active set: every K-th sweep is a full "
+                        "refresh that re-solves frozen entities too, "
+                        "re-activating any that drifted because other "
+                        "coordinates moved (must be positive)")
+    p.add_argument("--solver-tol-schedule", type=_tol_schedule, default=None,
+                   metavar="START:DECAY",
+                   help="inexact-CD inner-solve tolerance schedule: sweep "
+                        "k solves to max(coordinate tolerance, START * "
+                        "DECAY^k) — loose early sweeps, geometrically "
+                        "tightening to the configured tolerance (e.g. "
+                        "1e-3:0.1; 'off' disables)")
     p.add_argument("--index-map", default=None,
                    help="prebuilt index map (JSON, native store, or hashing "
                         "config; else built from data)")
@@ -242,6 +296,20 @@ def main(argv: Sequence[str] | None = None) -> int:
         grid = [
             [_dc.replace(cfg, prefetch_depth=args.prefetch_depth)
              if cfg.coordinate_type == "fixed" else cfg
+             for cfg in configs]
+            for configs in grid
+        ]
+    re_overrides = {
+        k: v for k, v in (("active_set", args.re_active_set),
+                          ("refresh_every", args.re_refresh_every))
+        if v is not None
+    }
+    if re_overrides:  # apply to every random coordinate across the grid
+        import dataclasses as _dc
+
+        grid = [
+            [_dc.replace(cfg, **re_overrides)
+             if cfg.coordinate_type == "random" else cfg
              for cfg in configs]
             for configs in grid
         ]
@@ -487,7 +555,8 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     estimator = GameEstimator(
         task=task, n_iterations=args.n_iterations, evaluators=evaluators,
-        dtype=dtype,
+        dtype=dtype, cd_tolerance=args.cd_tolerance,
+        solver_tol_schedule=args.solver_tol_schedule,
     )
     ckpt = None
     if args.checkpoint and is_lead:

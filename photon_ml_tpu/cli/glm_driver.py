@@ -63,6 +63,15 @@ from photon_ml_tpu.utils import (PhotonLogger, Timed, is_device_loss,
                                  resolve_dtype)
 
 
+def _tol_schedule(value: str):
+    from photon_ml_tpu.optimize import parse_tolerance_schedule
+
+    try:
+        return parse_tolerance_schedule(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="Classic GLM training driver "
                                             "(staged pipeline, TPU-native)")
@@ -81,6 +90,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--elastic-net-alpha", type=float, default=0.5)
     p.add_argument("--max-iters", type=int, default=100)
     p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--solver-tol-schedule", type=_tol_schedule, default=None,
+                   metavar="START:DECAY",
+                   help="inexact path-following over the lambda grid: the "
+                        "i-th lambda solves to max(--tolerance, START * "
+                        "DECAY^i) — early grid points only warm-start the "
+                        "chain, so a loose solve there buys wall-clock "
+                        "without moving the tight final fits (e.g. "
+                        "1e-3:0.1; 'off' disables)")
     p.add_argument("--normalization", default="none",
                    choices=[t.value for t in NormalizationType])
     p.add_argument("--add-intercept", action="store_true", default=True)
@@ -483,11 +500,21 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     try:
         with Timed(logger, "training"), profile_trace(args.profile_dir):
-            for lam in args.reg_weights[len(results):]:
+            start_idx = len(results)
+            for li, lam in enumerate(args.reg_weights[start_idx:],
+                                     start=start_idx):
                 # per-lambda injection point: kill-and-rerun tests drive
                 # the device-loss resume path through here without
                 # monkeypatching the fit internals
                 fault_injection.check("glm.lambda")
+                run_config = opt_config
+                if args.solver_tol_schedule is not None:
+                    import dataclasses as _dc
+
+                    run_config = _dc.replace(
+                        opt_config,
+                        tolerance=args.solver_tol_schedule.at(
+                            li, args.tolerance))
                 if streaming:
                     from photon_ml_tpu.parallel.streaming import fit_streaming
 
@@ -498,19 +525,21 @@ def main(argv: Sequence[str] | None = None) -> int:
                     res = fit_streaming(
                         objective, chunks, dim, w0=w, l2=reg.l2_weight(lam),
                         l1=reg.l1_weight(lam), optimizer=optimizer,
-                        config=opt_config, dtype=dtype, mesh=stream_mesh,
+                        config=run_config, dtype=dtype, mesh=stream_mesh,
                         prefetch_depth=args.prefetch_depth,
                     )
                 else:
                     res = fit_distributed(
                         objective, batch, mesh, w,
                         l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
-                        optimizer=optimizer, config=opt_config,
+                        optimizer=optimizer, config=run_config,
                         precomputed_csc=grid_csc,
                     )
                 w = res.w  # warm start the next lambda
                 diag = {
                     "reg_weight": lam,
+                    **({"solver_tolerance": run_config.tolerance}
+                       if args.solver_tol_schedule is not None else {}),
                     "loss": float(res.value),
                     "grad_norm": float(res.grad_norm),
                     "iterations": int(res.iterations),

@@ -46,6 +46,8 @@ class GameEstimator:
         mesh=None,
         dtype=jnp.float32,
         verbose: bool = False,
+        cd_tolerance: float = 0.0,
+        solver_tol_schedule=None,
     ):
         self.task = task
         self.n_iterations = n_iterations
@@ -53,6 +55,10 @@ class GameEstimator:
         self.mesh = mesh
         self.dtype = dtype
         self.verbose = verbose
+        # sweep-level early exit + inexact inner-solve schedule, passed
+        # straight to CoordinateDescent (game/descent.py)
+        self.cd_tolerance = cd_tolerance
+        self.solver_tol_schedule = solver_tol_schedule
 
     def fit(
         self,
@@ -83,6 +89,8 @@ class GameEstimator:
                 mesh=self.mesh, evaluators=self.evaluator_names,
                 dtype=self.dtype, verbose=self.verbose,
                 dataset_cache=dataset_cache,
+                cd_tolerance=self.cd_tolerance,
+                solver_tol_schedule=self.solver_tol_schedule,
             )
             ckpt = None
             if checkpoint_callback is not None:

@@ -25,6 +25,7 @@ on raw features match the normalized-training margins exactly.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -106,6 +107,22 @@ class CoordinateConfig:
     prefetch_depth: Optional[int] = None
     active_cap: Optional[int] = None  # random-effect only
     num_buckets: int = 4  # random-effect entity size buckets
+    # Active-set coordinate descent (random-effect only): entities whose
+    # solver converged are FROZEN; a later sweep re-solves an entity only
+    # if its residual offsets drifted by more than active_tol (max-abs over
+    # its rows, relative to max(1, |offsets|)) since its last solve — an
+    # unchanged-offset re-solve of a converged entity is a no-op by
+    # construction (the bucket solvers return the pre-step point on the
+    # converging iteration), so the skip is exact to within the drift
+    # tolerance, and the per-sweep work tracks the unconverged frontier.
+    # Every refresh_every-th sweep is a full refresh that re-solves every
+    # entity regardless (belt-and-braces re-activation). active_tol=None
+    # defaults to a few ulps of the working dtype — near-exact skipping;
+    # set it looser (e.g. 1e-6) to trade a bounded approximation for
+    # bigger savings on slowly-converging runs.
+    active_set: bool = True
+    refresh_every: int = 4
+    active_tol: Optional[float] = None
     # random-effect projector: "subspace" (exact per-entity maps) or
     # "random" (shared count-sketch of width projection_dim)
     projection: str = "subspace"
@@ -159,6 +176,15 @@ class CoordinateConfig:
             raise ValueError(
                 f"coordinate '{self.name}': prefetch_depth must be >= 0, "
                 f"got {self.prefetch_depth}")
+        if self.refresh_every < 1:
+            raise ValueError(
+                f"coordinate '{self.name}': refresh_every must be >= 1, "
+                f"got {self.refresh_every}")
+        if self.active_tol is not None and not (
+                np.isfinite(self.active_tol) and self.active_tol >= 0):
+            raise ValueError(
+                f"coordinate '{self.name}': active_tol must be finite and "
+                f">= 0, got {self.active_tol}")
 
 
 @dataclasses.dataclass
@@ -214,6 +240,58 @@ def _device_features(sp: HostSparse, dtype) -> SparseFeatures:
 # one shared jitted margin kernel (streamed scoring reuses the compilation
 # across chunks and CD iterations)
 _margins_jit = jax.jit(_margins)
+
+_log = logging.getLogger(__name__)
+
+
+class _ResidualTotal:
+    """Running residual total ``base + sum(coordinate scores)``.
+
+    The CD loop previously recomputed ``base + sum(scores.values())`` inside
+    the per-coordinate loop — O(C) device adds per coordinate step, O(C^2)
+    per sweep. This keeps one running vector updated with a subtract/add on
+    the changed coordinate; ``resync`` (called once per sweep) re-derives it
+    from scratch so low-precision drift from the running updates cannot
+    accumulate across sweeps."""
+
+    def __init__(self, base):
+        self.base = base
+        self.total = base
+
+    def resync(self, scores: Dict[str, jax.Array]) -> None:
+        self.total = self.base + sum(scores.values())
+
+    def excluding(self, name: str, scores: Dict[str, jax.Array]):
+        """Residual offsets for one coordinate: everything but its own
+        scores."""
+        return self.total - scores[name]
+
+    def replace(self, old_scores, new_scores) -> None:
+        self.total = self.total - old_scores + new_scores
+
+
+def _drift_active_masks(buckets, frozen, offs_np: np.ndarray,
+                        snap: np.ndarray, tol: float) -> List[np.ndarray]:
+    """Per-bucket ACTIVE masks for a non-refresh sweep: an entity must be
+    re-solved when it never converged (``~frozen``) or when its residual
+    offsets drifted — max-abs change over its rows since its last solve
+    exceeds ``tol * max(1, |snapshot|_inf over its rows)``. Host numpy over
+    the already-materialized bucket index arrays: O(rows) per sweep, no
+    device work."""
+    d_all = np.abs(offs_np - snap)
+    masks: List[np.ndarray] = []
+    for b, bucket in enumerate(buckets):
+        E = bucket.num_entities
+        if E == 0:
+            masks.append(np.zeros(0, bool))
+            continue
+        sidx = bucket.sample_idx
+        valid = sidx >= 0
+        safe = np.maximum(sidx, 0)
+        drift = np.max(d_all[safe] * valid, axis=1)
+        scale = np.maximum(1.0, np.max(np.abs(snap)[safe] * valid, axis=1))
+        masks.append(~frozen[b] | (drift > tol * scale))
+    return masks
 
 
 class _FixedState:
@@ -322,18 +400,20 @@ class _FixedState:
                     out.append(_dc.replace(c, offsets=seg))
                 return out
 
-            def _fit(w0, offs, l2, l1):
-                chunks = _with_offsets(np.asarray(offs))
-                self._last_chunks = chunks
-                return fit_streaming(
-                    self.obj, chunks, self.dim, w0=w0, l2=float(l2),
-                    l1=float(l1), optimizer=optimizer, config=cfg_opt,
-                    dtype=dtype, mesh=self._stream_mesh,
-                    prefetch_depth=cfg.prefetch_depth,
-                )
+            def _make_fit(run_cfg):
+                def _fit(w0, offs, l2, l1):
+                    chunks = _with_offsets(np.asarray(offs))
+                    self._last_chunks = chunks
+                    return fit_streaming(
+                        self.obj, chunks, self.dim, w0=w0, l2=float(l2),
+                        l1=float(l1), optimizer=optimizer, config=run_cfg,
+                        dtype=dtype, mesh=self._stream_mesh,
+                        prefetch_depth=cfg.prefetch_depth,
+                    )
+                return _fit
 
             self._batch_parts = None
-            self._fit_jit = _fit
+            self._install_fit(_make_fit, cfg_opt, needs_jit=False)
             return
 
         feats = SparseFeatures(
@@ -383,37 +463,43 @@ class _FixedState:
                     LabeledBatch(feats, labels, jnp.zeros_like(labels), weights)
                 )
 
-                def _fit(w0, offs, l2, l1):
-                    batch = LabeledBatch(feats, labels, offs, weights)
-                    fg = lambda w: fg_csc(w, batch, csc, l2)
-                    if optimizer == "owlqn":
-                        return opt(fg, w0, l1, cfg_opt, l1_mask=l1_mask)
-                    if optimizer == "tron":
-                        return opt(fg, w0, cfg_opt,
-                                   hvp=lambda w, v: hvp_csc(w, v, batch, csc, l2))
-                    return opt(fg, w0, cfg_opt)
+                def _make_fit(run_cfg):
+                    def _fit(w0, offs, l2, l1):
+                        batch = LabeledBatch(feats, labels, offs, weights)
+                        fg = lambda w: fg_csc(w, batch, csc, l2)
+                        if optimizer == "owlqn":
+                            return opt(fg, w0, l1, run_cfg, l1_mask=l1_mask)
+                        if optimizer == "tron":
+                            return opt(fg, w0, run_cfg,
+                                       hvp=lambda w, v: hvp_csc(w, v, batch, csc, l2))
+                        return opt(fg, w0, run_cfg)
+                    return _fit
             else:
                 fg_dist = distributed_value_and_grad(self.obj, mesh)
                 hvp_dist = distributed_hvp(self.obj, mesh) if optimizer == "tron" else None
 
-                def _fit(w0, offs, l2, l1):
-                    batch = LabeledBatch(feats, labels, offs, weights)
-                    fg = lambda w: fg_dist(w, batch, l2)
-                    if optimizer == "owlqn":
-                        return opt(fg, w0, l1, cfg_opt, l1_mask=l1_mask)
-                    if optimizer == "tron":
-                        return opt(fg, w0, cfg_opt,
-                                   hvp=lambda w, v: hvp_dist(w, v, batch, l2))
-                    return opt(fg, w0, cfg_opt)
+                def _make_fit(run_cfg):
+                    def _fit(w0, offs, l2, l1):
+                        batch = LabeledBatch(feats, labels, offs, weights)
+                        fg = lambda w: fg_dist(w, batch, l2)
+                        if optimizer == "owlqn":
+                            return opt(fg, w0, l1, run_cfg, l1_mask=l1_mask)
+                        if optimizer == "tron":
+                            return opt(fg, w0, run_cfg,
+                                       hvp=lambda w, v: hvp_dist(w, v, batch, l2))
+                        return opt(fg, w0, run_cfg)
+                    return _fit
         else:
             self._offset_sharding = None
 
-            def _fit(w0, offs, l2, l1):
-                batch = LabeledBatch(feats, labels, offs, weights)
-                fg = lambda w: self.obj.value_and_grad(w, batch, l2)
-                if optimizer == "owlqn":
-                    return opt(fg, w0, l1, cfg_opt, l1_mask=l1_mask)
-                return opt(fg, w0, cfg_opt)
+            def _make_fit(run_cfg):
+                def _fit(w0, offs, l2, l1):
+                    batch = LabeledBatch(feats, labels, offs, weights)
+                    fg = lambda w: self.obj.value_and_grad(w, batch, l2)
+                    if optimizer == "owlqn":
+                        return opt(fg, w0, l1, run_cfg, l1_mask=l1_mask)
+                    return opt(fg, w0, run_cfg)
+                return _fit
 
         # scoring features: when training uses every row un-padded, the
         # training copy IS the scoring copy — aliasing avoids the 2x
@@ -423,7 +509,7 @@ class _FixedState:
         else:
             self.full_features = _device_features(sp, dtype)
         self._batch_parts = (feats, labels, weights)
-        self._fit_jit = jax.jit(_fit)
+        self._install_fit(_make_fit, cfg_opt, needs_jit=True)
 
     def _init_out_of_core(self, cfg: CoordinateConfig, data: GameDataset,
                           source, task: str, mesh: Optional[Mesh]) -> None:
@@ -512,22 +598,47 @@ class _FixedState:
         weights = data.weights[lo:hi]
         dim = self.dim
 
-        def _fit(w0, offs, l2, l1):
-            overlay = ScalarOverlaySource(
-                source, labels=labels, weights=weights,
-                offsets=np.asarray(offs)[lo:hi])
-            self._last_chunks = overlay
-            return fit_streaming(
-                self.obj, overlay, dim, w0=w0, l2=float(l2), l1=float(l1),
-                optimizer=optimizer, config=cfg_opt, dtype=self.dtype,
-                mesh=self._stream_mesh, prefetch_depth=cfg.prefetch_depth,
-            )
+        def _make_fit(run_cfg):
+            def _fit(w0, offs, l2, l1):
+                overlay = ScalarOverlaySource(
+                    source, labels=labels, weights=weights,
+                    offsets=np.asarray(offs)[lo:hi])
+                self._last_chunks = overlay
+                return fit_streaming(
+                    self.obj, overlay, dim, w0=w0, l2=float(l2),
+                    l1=float(l1), optimizer=optimizer, config=run_cfg,
+                    dtype=self.dtype, mesh=self._stream_mesh,
+                    prefetch_depth=cfg.prefetch_depth,
+                )
+            return _fit
 
         self._last_chunks = ScalarOverlaySource(source, labels=labels,
                                                 weights=weights)
-        self._fit_jit = _fit
+        self._install_fit(_make_fit, cfg_opt, needs_jit=False)
 
-    def fit(self, offsets_full: jax.Array):
+    def _install_fit(self, make_fit, base_config, needs_jit: bool) -> None:
+        """Register the per-OptimizerConfig fit builder. The built (and,
+        for in-memory paths, jitted) fit functions are memoized per config
+        so an inexact-CD tolerance schedule pays one compile per distinct
+        tolerance — a bounded set, since the schedule clamps at the final
+        tolerance (optimize.ToleranceSchedule)."""
+        self._make_fit = make_fit
+        self._base_opt_config = base_config
+        self._fit_needs_jit = needs_jit
+        self._fit_cache: dict = {}
+
+    def _fit_for(self, opt_config):
+        run_cfg = (self._base_opt_config if opt_config is None
+                   else opt_config)
+        fn = self._fit_cache.get(run_cfg)
+        if fn is None:
+            fn = self._make_fit(run_cfg)
+            if self._fit_needs_jit:
+                fn = jax.jit(fn)
+            self._fit_cache[run_cfg] = fn
+        return fn
+
+    def fit(self, offsets_full: jax.Array, opt_config=None):
         offs = jnp.take(offsets_full, self.train_rows, axis=0).astype(self.dtype)
         if self._offset_pad:
             offs = jnp.concatenate(
@@ -538,8 +649,9 @@ class _FixedState:
         w0 = self.w if self.w is not None else jnp.zeros(
             (self.dim,), self.dtype
         )
-        res = self._fit_jit(w0, offs, jnp.asarray(self.l2, self.dtype),
-                            jnp.asarray(self.l1, self.dtype))
+        res = self._fit_for(opt_config)(
+            w0, offs, jnp.asarray(self.l2, self.dtype),
+            jnp.asarray(self.l1, self.dtype))
         self.w = res.w
         if self.cfg.compute_variance:
             if self.streaming:
@@ -648,6 +760,13 @@ class _RandomState:
                 cache[key] = (data, self.train_data, self.train_view)
         self.coeffs: Optional[List[np.ndarray]] = None
         self.variances = None
+        # active-set tracking across sweeps: per-bucket boolean masks of
+        # FROZEN entities (solver reported converged at their last solve);
+        # None until the first full solve
+        self.frozen: Optional[List[np.ndarray]] = None
+        # residual offsets as of each row's owning entity's last solve —
+        # the drift reference for re-activation (length-n host vector)
+        self.offs_snap: Optional[np.ndarray] = None
 
 
 class CoordinateDescent:
@@ -663,10 +782,15 @@ class CoordinateDescent:
         dtype=jnp.float32,
         verbose: bool = False,
         dataset_cache: Optional[dict] = None,
+        cd_tolerance: float = 0.0,
+        solver_tol_schedule=None,
     ):
         names = [c.name for c in configs]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate coordinate names: {names}")
+        if not np.isfinite(cd_tolerance) or cd_tolerance < 0:
+            raise ValueError(f"cd_tolerance must be finite and >= 0, got "
+                             f"{cd_tolerance}")
         self.configs = list(configs)
         self.task = task
         self.n_iterations = n_iterations
@@ -674,6 +798,14 @@ class CoordinateDescent:
         self.evaluator_names = list(evaluators)
         self.dtype = dtype
         self.verbose = verbose
+        # Sweep-level early exit: stop when EVERY coordinate's score vector
+        # moved by at most cd_tolerance (max-abs) over a whole sweep. 0
+        # disables the test — exactly n_iterations sweeps run, as before.
+        self.cd_tolerance = float(cd_tolerance)
+        # optimize.ToleranceSchedule (or None): inexact inner solves —
+        # loose solver tolerance on early sweeps, tightening geometrically
+        # to each coordinate's configured tolerance
+        self.solver_tol_schedule = solver_tol_schedule
         # Shared across CoordinateDescent instances by GameEstimator so the
         # expensive per-entity bucketing is built once per dataset, not once
         # per grid point (the reference builds coordinate datasets once and
@@ -779,13 +911,33 @@ class CoordinateDescent:
             val_weights_dev = jnp.asarray(validation.weights, dtype)
             val_offsets_dev = jnp.asarray(validation.offsets, dtype)
 
+        # Running residual totals (train + validation): maintained by
+        # subtract/add on the changed coordinate and resynced once per
+        # sweep — the per-coordinate `base + sum(scores.values())` re-sum
+        # made every sweep O(C^2) in the coordinate count.
+        rt = _ResidualTotal(base)
+        vt = (_ResidualTotal(val_offsets_dev)
+              if validation is not None and evaluators else None)
+        _eps = float(jnp.finfo(dtype).eps)
+        stop_reason = "max_iterations"
         for it in range(self.n_iterations):
+            rt.resync(scores)
+            if vt is not None:
+                vt.resync(val_scores)
+            sweep_deltas: Dict[str, float] = {}
             for cfg in self.configs:
                 st = states[cfg.name]
                 t0 = time.time()
-                total = base + sum(scores.values())
-                offs = total - scores[cfg.name]
+                offs = rt.excluding(cfg.name, scores)
                 record = {"iteration": it, "coordinate": cfg.name}
+                run_cfg = None
+                if self.solver_tol_schedule is not None:
+                    run_cfg = dataclasses.replace(
+                        cfg.opt_config(),
+                        tolerance=self.solver_tol_schedule.at(
+                            it, cfg.tolerance))
+                    record["solver_tolerance"] = run_cfg.tolerance
+                score_delta = 0.0
                 # A CD sweep boundary is a collective phase boundary in
                 # multi-controller runs (streamed-pass reductions, score
                 # allgathers, device-eval psums): the guard converts any
@@ -797,7 +949,7 @@ class CoordinateDescent:
                     fault_injection.check("cd.step")
                     if cfg.name not in locked:
                         if cfg.coordinate_type == "fixed":
-                            res = st.fit(offs)
+                            res = st.fit(offs, opt_config=run_cfg)
                             record.update(
                                 loss=float(res.value), converged=bool(res.converged),
                                 optimizer_iterations=int(res.iterations),
@@ -808,59 +960,64 @@ class CoordinateDescent:
                                 # compute-stall seconds) rides the history
                                 record["stream"] = res.stream_stats
                             w_model = st.model_space_w()
-                            scores[cfg.name] = st.train_scores(w_model)
+                            new_scores = st.train_scores(w_model)
+                            score_delta = float(jnp.max(jnp.abs(
+                                new_scores - scores[cfg.name]))) if n else 0.0
+                            rt.replace(scores[cfg.name], new_scores)
+                            scores[cfg.name] = new_scores
                             if validation is not None:
-                                val_scores[cfg.name] = _margins(
-                                    val_feats[cfg.name], w_model
-                                )
+                                new_v = _margins(val_feats[cfg.name], w_model)
+                                if vt is not None:
+                                    vt.replace(val_scores[cfg.name], new_v)
+                                val_scores[cfg.name] = new_v
                         else:
-                            reg = cfg.reg_context()
-                            fit = train_random_effect(
-                                st.train_data, offs, task=self.task,
-                                l2=reg.l2_weight(cfg.reg_weight),
-                                l1=reg.l1_weight(cfg.reg_weight),
-                                optimizer=cfg.optimizer, config=cfg.opt_config(),
-                                w0=st.coeffs, mesh=entity_mesh,
-                                compute_variance=cfg.compute_variance, dtype=dtype,
-                                normalization=cfg.normalization,
-                            )
-                            st.coeffs = fit.coefficients
-                            st.variances = fit.variances
-                            record.update(
-                                converged_fraction=fit.converged_fraction,
-                                mean_optimizer_iterations=fit.mean_iterations,
-                            )
-                            scores[cfg.name] = score_random_effect(
-                                st.train_view, st.coeffs, n, dtype
-                            )
-                            if validation is not None:
-                                val_scores[cfg.name] = score_random_effect(
-                                    val_states[cfg.name], st.coeffs, val_n, dtype
-                                )
-                    record["seconds"] = time.time() - t0
-                    if validation is not None and evaluators:
-                        v_total_dev = val_offsets_dev + sum(val_scores.values())
+                            score_delta = self._random_step(
+                                cfg, st, it, offs, run_cfg, scores,
+                                val_scores, val_states, rt, vt, n, val_n,
+                                validation, entity_mesh, _eps, record)
+                    record["solve_seconds"] = time.time() - t0
+                    t_eval = time.time()
+                    if vt is not None:
                         v_total_host = None
                         for ev in evaluators:
                             fn = device_evals.get(ev.name)
                             if fn is not None:
                                 record[ev.name] = float(
-                                    fn(v_total_dev, val_labels_dev,
+                                    fn(vt.total, val_labels_dev,
                                        val_weights_dev))
                             else:  # grouped / precision@k: host path
                                 if v_total_host is None:
-                                    v_total_host = np.asarray(v_total_dev)
+                                    v_total_host = np.asarray(vt.total)
                                 record[ev.name] = ev.evaluate(
                                     v_total_host, validation.labels,
                                     validation.weights, validation.group_ids,
                                 )
-                if self.verbose:
-                    print(f"[CD] {record}")
+                    record["eval_seconds"] = time.time() - t_eval
+                    record["seconds"] = time.time() - t0
+                    record["score_delta"] = score_delta
+                    sweep_deltas[cfg.name] = score_delta
+                _log.log(logging.INFO if self.verbose else logging.DEBUG,
+                         "[CD] %s", record)
                 history.append(record)
             if checkpoint_callback is not None:
                 # coarse-grained per-outer-iteration checkpoint (the
                 # reference's per-stage HDFS writes — SURVEY.md §5.4)
                 checkpoint_callback(it, self._build_model(states))
+            if (self.cd_tolerance > 0 and sweep_deltas and
+                    all(d <= self.cd_tolerance for d in
+                        sweep_deltas.values())):
+                # every coordinate's score vector is stationary to within
+                # cd_tolerance: the remaining sweeps would re-derive the
+                # same model (frozen coordinates skip their streamed /
+                # solver passes entirely from here on)
+                stop_reason = "cd_tolerance"
+                _log.log(logging.INFO if self.verbose else logging.DEBUG,
+                         "[CD] early exit after sweep %d: max score delta "
+                         "%.3g <= cd_tolerance %.3g", it,
+                         max(sweep_deltas.values()), self.cd_tolerance)
+                break
+        if history:
+            history[-1]["stop_reason"] = stop_reason
 
         # Definitive final metrics: exact host f64 evaluators (per-iteration
         # device values above are monitoring; model selection reads
@@ -877,6 +1034,89 @@ class CoordinateDescent:
         return model, history
 
     # -- helpers ---------------------------------------------------------
+    def _random_step(self, cfg, st, it, offs, run_cfg, scores, val_scores,
+                     val_states, rt, vt, n, val_n, validation, entity_mesh,
+                     eps, record) -> float:
+        """One random-effect coordinate step with active-set freezing and
+        incremental rescoring. Returns the coordinate's score delta."""
+        refresh = (st.coeffs is None or st.frozen is None
+                   or st.offs_snap is None or not cfg.active_set
+                   or it % cfg.refresh_every == 0)
+        active = None
+        offs_np = None
+        if not refresh:
+            offs_np = np.asarray(offs)
+            tol = (cfg.active_tol if cfg.active_tol is not None else 0.0)
+            # floor at a few ulps of the working dtype: comparing offsets
+            # for bit-stability at a tolerance below the arithmetic noise
+            # would never skip anything
+            tol = max(float(tol), 8.0 * eps)
+            active = _drift_active_masks(st.train_data.buckets, st.frozen,
+                                         offs_np, st.offs_snap, tol)
+            if sum(int(a.sum()) for a in active) == 0:
+                # every entity frozen with stationary offsets: the
+                # coordinate is skipped outright — no solve, no rescore,
+                # zero device work this sweep
+                record.update(converged_fraction=1.0,
+                              mean_optimizer_iterations=0.0,
+                              entities_solved=0, refresh=False)
+                return 0.0
+        reg = cfg.reg_context()
+        fit = train_random_effect(
+            st.train_data, offs, task=self.task,
+            l2=reg.l2_weight(cfg.reg_weight),
+            l1=reg.l1_weight(cfg.reg_weight),
+            optimizer=cfg.optimizer,
+            config=run_cfg if run_cfg is not None else cfg.opt_config(),
+            w0=st.coeffs, mesh=entity_mesh,
+            compute_variance=cfg.compute_variance, dtype=self.dtype,
+            normalization=cfg.normalization,
+            active=active, prev_variances=st.variances,
+        )
+        if cfg.active_set:
+            st.frozen = [np.asarray(c) for c in fit.converged]
+            if offs_np is None:
+                offs_np = np.asarray(offs)
+            if active is None or st.offs_snap is None:
+                st.offs_snap = np.array(offs_np, copy=True)
+            else:
+                # re-solved entities get a fresh drift reference; frozen
+                # ones keep the offsets they last solved against
+                for b, bucket in enumerate(st.train_data.buckets):
+                    if bucket.num_entities == 0 or not active[b].any():
+                        continue
+                    rows = bucket.sample_idx[active[b]]
+                    rows = rows[rows >= 0]
+                    st.offs_snap[rows] = offs_np[rows]
+        st.coeffs = fit.coefficients
+        st.variances = fit.variances
+        record.update(
+            converged_fraction=fit.converged_fraction,
+            mean_optimizer_iterations=fit.mean_iterations,
+            entities_solved=fit.entities_solved,
+            refresh=bool(refresh),
+        )
+        # incremental rescoring after a partial solve: only rows owned by
+        # re-solved entities are recomputed and scatter-overwritten into
+        # the previous score vector
+        new_scores = score_random_effect(
+            st.train_view, st.coeffs, n, self.dtype,
+            prev=None if active is None else scores[cfg.name],
+            changed=active)
+        delta = (float(jnp.max(jnp.abs(new_scores - scores[cfg.name])))
+                 if n else 0.0)
+        rt.replace(scores[cfg.name], new_scores)
+        scores[cfg.name] = new_scores
+        if validation is not None and cfg.name in val_states:
+            new_v = score_random_effect(
+                val_states[cfg.name], st.coeffs, val_n, self.dtype,
+                prev=None if active is None else val_scores[cfg.name],
+                changed=active)
+            if vt is not None:
+                vt.replace(val_scores[cfg.name], new_v)
+            val_scores[cfg.name] = new_v
+        return delta
+
     def _build_model(self, states) -> GameModel:
         coords = {}
         for cfg in self.configs:

@@ -36,7 +36,14 @@ class RandomEffectFitResult:
     coefficients: List[np.ndarray]  # per bucket [E, D]
     variances: Optional[List[np.ndarray]]
     converged_fraction: float
-    mean_iterations: float
+    mean_iterations: float  # over the entities actually solved this call
+    # per-entity detail (one array per bucket): the active-set CD loop uses
+    # these to decide which entities to freeze between sweeps. Entities not
+    # re-solved this call (active-set frozen) report converged=True and
+    # iterations=0 — their objective was not touched.
+    converged: Optional[List[np.ndarray]] = None  # bool [E] per bucket
+    iterations: Optional[List[np.ndarray]] = None  # int32 [E] per bucket
+    entities_solved: int = 0
 
 
 def _newton_dense_solver(local_dim: int, task: str,
@@ -148,11 +155,6 @@ def _newton_dense_solver(local_dim: int, task: str,
                               jnp.take_along_axis(
                                   f_tries, first_ok[None, :], axis=0)[0],
                               f)
-            # a rejected step must be MASKED, not zero-multiplied: with a
-            # singular H (rank-deficient entity, l2=0) the solve returns
-            # NaN and 0 * NaN would poison W permanently
-            W_new = jnp.where((active & any_ok)[:, None],
-                              W - a_sel[:, None] * step, W)
             gnorm = jnp.linalg.norm(g, axis=1)
             # converged_check semantics, batched: |f_prev - f| <= tol *
             # max(|f_prev|, 1) OR gnorm <= tol * max(||g0||, 1). The
@@ -165,9 +167,23 @@ def _newton_dense_solver(local_dim: int, task: str,
             conv = active & (eff_tol > 0) & (
                 (any_ok & (delta <= eff_tol * jnp.maximum(jnp.abs(f), 1.0)))
                 | (gnorm <= eff_tol * jnp.maximum(g0n, 1.0)))
+            # a rejected step must be MASKED, not zero-multiplied: with a
+            # singular H (rank-deficient entity, l2=0) the solve returns
+            # NaN and 0 * NaN would poison W permanently. An entity that
+            # converges on its FIRST iteration also keeps its incoming
+            # point (conv & first): it was already at its stopping point,
+            # and taking the probed sub-tolerance step would make a
+            # warm-started re-solve of a converged entity drift by one
+            # noise-level step every CD sweep — defeating active-set
+            # freezing (a frozen entity must be a true no-op re-solve;
+            # same policy as optimize/lbfgs.py).
+            first = iters == 0
+            keep = conv & first
+            W_new = jnp.where((active & any_ok & ~keep)[:, None],
+                              W - a_sel[:, None] * step, W)
             iters_new = iters + active.astype(iters.dtype)
             active_new = active & ~conv & any_ok & (iters_new < max_iters)
-            f_out = jnp.where(active, f_new, f)
+            f_out = jnp.where(active & ~keep, f_new, f)
             return (W_new, f_out, active_new, conv_seen | conv, iters_new)
 
         state = match_vma_tree(
@@ -242,13 +258,33 @@ def _solver_for_bucket(local_dim: int, task: str, optimizer: str,
     return jax.vmap(solve_one, in_axes=(0,) * 8 + (None, None))
 
 
+# Every jitted bucket solver ever built (both cached builders below append
+# exactly once per cache key). ``re_solver_compile_count`` sums their
+# per-shape executable counts — the bench/test invariant that the active-set
+# path's power-of-two sub-bucket ladder stops compiling once warmed.
+_SOLVER_REGISTRY: List = []
+
+
+def re_solver_compile_count() -> int:
+    """Total compiled executables across all random-effect bucket solvers
+    (every distinct entity-block shape is one executable)."""
+    total = 0
+    for fn in _SOLVER_REGISTRY:
+        size = getattr(fn, "_cache_size", None)
+        if callable(size):
+            total += int(size())
+    return total
+
+
 @functools.lru_cache(maxsize=256)
 def _jitted_solver(local_dim, task, optimizer, config, compute_variance,
                    norm_mode=0):
     """Cache the jitted per-bucket solver so repeated coordinate-descent
     steps with identical shapes reuse one XLA compilation."""
-    return jax.jit(_solver_for_bucket(local_dim, task, optimizer, config,
-                                      compute_variance, norm_mode))
+    fn = jax.jit(_solver_for_bucket(local_dim, task, optimizer, config,
+                                    compute_variance, norm_mode))
+    _SOLVER_REGISTRY.append(fn)
+    return fn
 
 
 @functools.lru_cache(maxsize=256)
@@ -265,7 +301,9 @@ def _jitted_sharded_solver(local_dim, task, optimizer, config, compute_variance,
         out_specs=(P(axis), P(axis), P(axis), P(axis)),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    fn = jax.jit(sharded)
+    _SOLVER_REGISTRY.append(fn)
+    return fn
 
 
 def _local_normalization(buckets, norm: NormalizationContext):
@@ -363,6 +401,20 @@ def _pad_entities(a: jax.Array, width: int) -> jax.Array:
     return jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
 
 
+def _active_width(n_active: int, block: int, n_dev: int) -> int:
+    """Padded width for an active-set sub-bucket: the next power of two
+    (rounded up to the device count), capped at the full block width. The
+    power-of-two ladder bounds the number of distinct solver shapes at
+    log2(block) — after the first couple of shrinking sweeps every width
+    has been compiled and the compile counter stays flat."""
+    w = 1 << max(n_active - 1, 0).bit_length()
+    # floor the ladder at 32: solving 9 vs 32 entities costs the same under
+    # vmap, and every distinct width below the floor would be one more XLA
+    # compile for no win
+    w = -(-max(w, 32) // n_dev) * n_dev
+    return min(w, block)
+
+
 # "auto" only picks the dense-Newton solver up to this per-entity dim:
 # its [block, d, d] Hessians are 16k x d^2 x 4 B per block (1 GB at
 # d=128, 8 GB at the d=351 CD bucket that crashed the Mosaic batched-
@@ -396,6 +448,36 @@ def resolve_re_optimizer(optimizer: str, local_dim: int = None) -> str:
     return choice
 
 
+def _run_entity_blocks(run, args, n_entities: int, bs: int,
+                       compute_variance):
+    """Drive the bucket solver over fixed-width entity blocks and fetch
+    per-entity results. ``args`` is the 10-tuple of device arrays (8
+    per-entity + 2 scalars); blocks are padded to ``bs`` with
+    ``_pad_entities`` so every block shares one compiled shape."""
+    W_parts, V_parts, conv_parts, iter_parts = [], [], [], []
+    for s in range(0, n_entities, bs):
+        e = min(s + bs, n_entities)
+        if s == 0 and e == n_entities == bs:
+            blk = args  # single full block: no slice/pad device copies
+        else:
+            blk = tuple(
+                _pad_entities(a[s:e], bs) if i < 8 else a
+                for i, a in enumerate(args)
+            )
+        Wb, Vb, convb, itersb = run(*blk)
+        W_parts.append(np.asarray(Wb)[: e - s])
+        V_parts.append(np.asarray(Vb)[: e - s] if compute_variance else None)
+        conv_parts.append(np.asarray(convb)[: e - s])
+        iter_parts.append(np.asarray(itersb)[: e - s])
+
+    def cat(parts):
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    W = cat(W_parts)
+    V = cat(V_parts) if compute_variance else None
+    return W, V, cat(conv_parts).astype(bool), cat(iter_parts)
+
+
 def train_random_effect(
     data: RandomEffectTrainData,
     offsets: jax.Array,
@@ -410,6 +492,8 @@ def train_random_effect(
     compute_variance: bool | str = False,  # False | "diagonal" | "full"
     dtype=jnp.float32,
     normalization: Optional[NormalizationContext] = None,
+    active: Optional[Sequence[Optional[np.ndarray]]] = None,
+    prev_variances: Optional[List[Optional[np.ndarray]]] = None,
 ) -> RandomEffectFitResult:
     """Solve every entity's local GLM. ``offsets`` is the full-dataset
     residual-offset vector [n] from the coordinate-descent loop. L1 weight
@@ -418,9 +502,20 @@ def train_random_effect(
     ``normalization`` (the shard's global context) is applied inside each
     per-entity objective via gathered local factor/shift vectors; incoming
     ``w0`` and returned coefficients stay in raw feature space (conversion
-    happens here), so scoring/saving/warm-start paths are unchanged."""
+    happens here), so scoring/saving/warm-start paths are unchanged.
+
+    ``active`` (the active-set CD path): one boolean mask [E] per bucket —
+    only masked entities are re-solved. Their rows are gathered on the host
+    into a power-of-two-padded sub-bucket (``_active_width``), solved with
+    the same shape-bucketed jitted solver, and scattered back; frozen
+    entities carry their ``w0`` coefficients (and ``prev_variances``)
+    untouched and report converged=True / iterations=0. Requires ``w0``.
+    A ``None`` mask entry means "solve the whole bucket"."""
     if np.asarray(l1).item() > 0 and optimizer != "owlqn":
         optimizer = "owlqn"
+    if active is not None and w0 is None:
+        raise ValueError("active-set training needs w0 (frozen entities "
+                         "carry their previous coefficients)")
     # "auto" stays unresolved here: the per-bucket local_dim feeds the
     # dense-Newton dimension gate inside the loop
     offsets = jnp.asarray(offsets, dtype)
@@ -430,7 +525,8 @@ def train_random_effect(
     if normalization is not None:
         norm_mode = 2 if normalization.shifts is not None else 1
     coeffs, variances = [], []
-    conv_sum, iter_sum, total = 0.0, 0.0, 0
+    conv_list, iter_list = [], []
+    conv_sum, iter_sum, total, solved_total = 0.0, 0.0, 0, 0
     for b, bucket in enumerate(data.buckets):
         E, D = bucket.num_entities, bucket.local_dim
         if E == 0:
@@ -442,37 +538,34 @@ def train_random_effect(
             coeffs.append(np.zeros((0, D), np.dtype(dtype)))
             variances.append(np.zeros((0, D), np.dtype(dtype))
                              if compute_variance else None)
+            conv_list.append(np.zeros(0, bool))
+            iter_list.append(np.zeros(0, np.int32))
             continue
+        mask = None if active is None else active[b]
+        if mask is not None:
+            mask = np.asarray(mask, bool)
+            if mask.shape != (E,):
+                raise ValueError(
+                    f"active mask for bucket {b} has shape {mask.shape}, "
+                    f"expected ({E},)")
+            if mask.all():
+                mask = None  # full solve — take the unsliced path
+        if mask is not None and not mask.any():
+            # fully frozen bucket: nothing touches the device at all
+            coeffs.append(np.array(np.asarray(w0[b]), copy=True))
+            variances.append(
+                None if not compute_variance else
+                (np.array(prev_variances[b], copy=True)
+                 if prev_variances is not None and prev_variances[b]
+                 is not None else np.zeros((E, D), np.dtype(dtype))))
+            conv_list.append(np.ones(E, bool))
+            iter_list.append(np.zeros(E, np.int32))
+            conv_sum += E
+            total += E
+            continue
+        sel = None if mask is None else np.flatnonzero(mask)
+        n_solve = E if sel is None else len(sel)
         opt_b = resolve_re_optimizer(optimizer, D)
-        sidx = jnp.asarray(bucket.sample_idx)
-        # padding rows (sidx == -1) carry weight 0, offset value irrelevant
-        off = jnp.take(offsets, jnp.maximum(sidx, 0), axis=0) * (sidx >= 0)
-        if w0 is not None:
-            w_init = np.asarray(w0[b])
-            if local_norm is not None:
-                w_init = _re_to_training_space(w_init, *local_norm[b])
-            w_init = jnp.asarray(w_init, dtype)
-        else:
-            w_init = jnp.zeros((E, D), dtype)
-        if local_norm is not None:
-            f_loc = jnp.asarray(local_norm[b][0], dtype)
-            s_loc = (jnp.zeros((E, 1), dtype) if local_norm[b][1] is None
-                     else jnp.asarray(local_norm[b][1], dtype))
-        else:  # unused dummies (dead-code-eliminated under jit)
-            f_loc = jnp.zeros((E, 1), dtype)
-            s_loc = jnp.zeros((E, 1), dtype)
-        args = (
-            jnp.asarray(bucket.indices),
-            jnp.asarray(bucket.values, dtype),
-            jnp.asarray(bucket.labels, dtype),
-            jnp.asarray(bucket.weights, dtype),
-            off.astype(dtype),
-            w_init,
-            f_loc,
-            s_loc,
-            jnp.asarray(l2, dtype),
-            jnp.asarray(l1, dtype),
-        )
         if mesh is not None:
             n_dev = mesh.shape[axis]
             run = _jitted_sharded_solver(D, task, opt_b, config,
@@ -490,39 +583,99 @@ def train_random_effect(
         # one shape (single compile), results fetched per block so HBM
         # only ever holds one block's solver intermediates.
         bs = -(-min(_RE_BLOCK_ENTITIES, E) // n_dev) * n_dev
-        W_parts, V_parts, conv_sum_b, iter_sum_b = [], [], 0.0, 0.0
-        for s in range(0, E, bs):
-            e = min(s + bs, E)
-            if s == 0 and e == E == bs:
-                blk = args  # single full block: no slice/pad device copies
-            else:
-                blk = tuple(
-                    _pad_entities(a[s:e], bs) if i < 8 else a
-                    for i, a in enumerate(args)
-                )
-            Wb, Vb, convb, itersb = run(*blk)
-            W_parts.append(np.asarray(Wb)[: e - s])
-            V_parts.append(np.asarray(Vb)[: e - s] if compute_variance
-                           else None)
-            conv_sum_b += float(jnp.sum(convb[: e - s]))
-            iter_sum_b += float(jnp.sum(itersb[: e - s]))
-        W = np.concatenate(W_parts) if len(W_parts) > 1 else W_parts[0]
-        V = (np.concatenate(V_parts) if len(V_parts) > 1 else V_parts[0]) \
-            if compute_variance else None
-        conv, iters = conv_sum_b, iter_sum_b
+        # active-set sub-bucket: gather the unconverged entities ON THE
+        # HOST (the frozen majority's arrays never transfer), pad to the
+        # power-of-two ladder width, and solve that
+        width = bs if sel is None else _active_width(n_solve, bs, n_dev)
+        idx_np = bucket.indices if sel is None else bucket.indices[sel]
+        val_np = bucket.values if sel is None else bucket.values[sel]
+        lab_np = bucket.labels if sel is None else bucket.labels[sel]
+        wts_np = bucket.weights if sel is None else bucket.weights[sel]
+        sidx_np = (bucket.sample_idx if sel is None
+                   else bucket.sample_idx[sel])
+        ln_b = None
         if local_norm is not None:
-            W = _re_to_model_space(W, *local_norm[b])
+            f_np, s_np, pos_np = local_norm[b]
+            if sel is not None:
+                f_np = f_np[sel]
+                s_np = None if s_np is None else s_np[sel]
+                pos_np = None if pos_np is None else pos_np[sel]
+            ln_b = (f_np, s_np, pos_np)
+        sidx = jnp.asarray(sidx_np)
+        # padding rows (sidx == -1) carry weight 0, offset value irrelevant
+        off = jnp.take(offsets, jnp.maximum(sidx, 0), axis=0) * (sidx >= 0)
+        if w0 is not None:
+            w_init = np.asarray(w0[b])
+            if sel is not None:
+                w_init = w_init[sel]
+            if ln_b is not None:
+                w_init = _re_to_training_space(w_init, *ln_b)
+            w_init = jnp.asarray(w_init, dtype)
+        else:
+            w_init = jnp.zeros((n_solve, D), dtype)
+        if ln_b is not None:
+            f_loc = jnp.asarray(ln_b[0], dtype)
+            s_loc = (jnp.zeros((n_solve, 1), dtype) if ln_b[1] is None
+                     else jnp.asarray(ln_b[1], dtype))
+        else:  # unused dummies (dead-code-eliminated under jit)
+            f_loc = jnp.zeros((n_solve, 1), dtype)
+            s_loc = jnp.zeros((n_solve, 1), dtype)
+        args = (
+            jnp.asarray(idx_np),
+            jnp.asarray(val_np, dtype),
+            jnp.asarray(lab_np, dtype),
+            jnp.asarray(wts_np, dtype),
+            off.astype(dtype),
+            w_init,
+            f_loc,
+            s_loc,
+            jnp.asarray(l2, dtype),
+            jnp.asarray(l1, dtype),
+        )
+        W, V, conv, iters = _run_entity_blocks(run, args, n_solve, width,
+                                               compute_variance)
+        if ln_b is not None:
+            W = _re_to_model_space(W, *ln_b)
+        if sel is None:
+            conv_arr, iter_arr = conv, iters.astype(np.int32)
+        else:
+            # scatter solved entities back; frozen rows carry over
+            W_full = np.array(np.asarray(w0[b]), copy=True)
+            W_full[sel] = W
+            W = W_full
+            if compute_variance:
+                V_full = (np.array(prev_variances[b], copy=True)
+                          if prev_variances is not None
+                          and prev_variances[b] is not None
+                          else np.zeros((E, np.asarray(V).shape[1]),
+                                        np.asarray(V).dtype))
+                V_full[sel] = V
+                V = V_full
+            conv_arr = np.ones(E, bool)
+            conv_arr[sel] = conv
+            iter_arr = np.zeros(E, np.int32)
+            iter_arr[sel] = iters
         coeffs.append(W)
         variances.append(V)
-        conv_sum += conv
-        iter_sum += iters
+        conv_list.append(conv_arr)
+        iter_list.append(iter_arr)
+        conv_sum += float(conv_arr.sum())
+        iter_sum += float(iter_arr.sum())
         total += E
+        solved_total += n_solve
     return RandomEffectFitResult(
         coefficients=coeffs,
         variances=variances if compute_variance else None,
         converged_fraction=conv_sum / max(total, 1),
-        mean_iterations=iter_sum / max(total, 1),
+        mean_iterations=iter_sum / max(solved_total, 1),
+        converged=conv_list,
+        iterations=iter_list,
+        entities_solved=solved_total,
     )
+
+
+def _margins_one(w_e, idx_e, val_e):
+    return jnp.sum(val_e * w_e[idx_e], axis=-1)  # [M]
 
 
 def score_random_effect(
@@ -530,23 +683,68 @@ def score_random_effect(
     coefficients: Sequence[np.ndarray],
     num_samples: int,
     dtype=jnp.float32,
+    prev: Optional[jax.Array] = None,
+    changed: Optional[Sequence[Optional[np.ndarray]]] = None,
 ) -> jax.Array:
     """Margins of every sample under its entity's model, scattered into a
     full-dataset score vector (the reference's CoordinateDataScores role,
-    SURVEY.md §3.2). Samples with no entity model score 0."""
-    scores = jnp.zeros((num_samples + 1,), dtype)  # slot n swallows padding
-    for view, W in zip(score_view, coefficients):
-        Wd = jnp.asarray(W, dtype)
-        idx = jnp.asarray(view.indices)
-        val = jnp.asarray(view.values, dtype)
-        sidx = jnp.asarray(view.sample_idx)
+    SURVEY.md §3.2). Samples with no entity model score 0.
 
-        def margins_one(w_e, idx_e, val_e):
-            return jnp.sum(val_e * w_e[idx_e], axis=-1)  # [M]
+    Incremental mode (``prev`` + ``changed``): recompute margins only for
+    the rows owned by re-solved entities and scatter-overwrite them into
+    the previous score vector — every row belongs to at most one entity
+    per coordinate, so a plain set is exact. ``changed`` holds one boolean
+    mask [E] per bucket (None = whole bucket changed); the changed rows
+    are gathered on the host and padded to a power-of-two entity width so
+    the margin kernel's shape ladder stays bounded as active sets shrink."""
+    if prev is None or changed is None:
+        scores = jnp.zeros((num_samples + 1,), dtype)  # slot n swallows pad
+        for view, W in zip(score_view, coefficients):
+            Wd = jnp.asarray(W, dtype)
+            idx = jnp.asarray(view.indices)
+            val = jnp.asarray(view.values, dtype)
+            sidx = jnp.asarray(view.sample_idx)
+            m = jax.vmap(_margins_one)(Wd, idx, val)  # [E, M]
+            target = jnp.where(sidx >= 0, sidx, num_samples)
+            scores = scores.at[target.reshape(-1)].add(
+                jnp.where(sidx >= 0, m, 0.0).reshape(-1)
+            )
+        return scores[:num_samples]
 
-        m = jax.vmap(margins_one)(Wd, idx, val)  # [E, M]
+    scores = jnp.concatenate(
+        [jnp.asarray(prev, dtype), jnp.zeros((1,), dtype)])
+    for view, W, mask in zip(score_view, coefficients, changed):
+        E = view.sample_idx.shape[0]
+        if E == 0:
+            continue
+        if mask is None:
+            sel = np.arange(E)
+        else:
+            sel = np.flatnonzero(np.asarray(mask, bool))
+            if len(sel) == 0:
+                continue
+        width = _active_width(len(sel), E, 1)
+        pad = width - len(sel)
+        W_np = np.asarray(W)[sel]
+        idx_np = view.indices[sel]
+        val_np = view.values[sel]
+        sidx_np = view.sample_idx[sel]
+        if pad:
+            W_np = np.concatenate([W_np, np.zeros((pad,) + W_np.shape[1:],
+                                                  W_np.dtype)])
+            idx_np = np.concatenate(
+                [idx_np, np.zeros((pad,) + idx_np.shape[1:], idx_np.dtype)])
+            val_np = np.concatenate(
+                [val_np, np.zeros((pad,) + val_np.shape[1:], val_np.dtype)])
+            sidx_np = np.concatenate(
+                [sidx_np, np.full((pad,) + sidx_np.shape[1:], -1,
+                                  sidx_np.dtype)])
+        sidx = jnp.asarray(sidx_np)
+        m = jax.vmap(_margins_one)(jnp.asarray(W_np, dtype),
+                                   jnp.asarray(idx_np),
+                                   jnp.asarray(val_np, dtype))
         target = jnp.where(sidx >= 0, sidx, num_samples)
-        scores = scores.at[target.reshape(-1)].add(
-            jnp.where(sidx >= 0, m, 0.0).reshape(-1)
-        )
+        # overwrite, don't add: these rows' previous margins are stale
+        scores = scores.at[target.reshape(-1)].set(
+            jnp.where(sidx >= 0, m, 0.0).reshape(-1), mode="drop")
     return scores[:num_samples]

@@ -1,4 +1,9 @@
-from photon_ml_tpu.optimize.common import OptimizationResult, OptimizerConfig
+from photon_ml_tpu.optimize.common import (
+    OptimizationResult,
+    OptimizerConfig,
+    ToleranceSchedule,
+    parse_tolerance_schedule,
+)
 from photon_ml_tpu.optimize.lbfgs import lbfgs
 from photon_ml_tpu.optimize.owlqn import owlqn
 from photon_ml_tpu.optimize.tron import tron

@@ -33,6 +33,58 @@ class OptimizerConfig:
     max_line_search_steps: int = 25
 
 
+@dataclasses.dataclass(frozen=True)
+class ToleranceSchedule:
+    """Inexact-outer-loop solver tolerance schedule (the standard trick in
+    distributed block-coordinate methods: early sweeps don't need exact
+    inner solves because the other blocks will move anyway — arxiv
+    1611.02101 / 1803.06333). ``at(step, final_tol)`` starts at ``start``
+    and tightens geometrically by ``decay`` per outer step, clamped from
+    below at the caller's final tolerance; once the schedule reaches the
+    final tolerance it stays there, so the set of distinct tolerances (and
+    therefore of solver compilations keyed on them) is bounded by
+    ``log(start/final) / log(1/decay)`` + 1."""
+
+    start: float = 1e-3
+    decay: float = 0.1
+
+    def __post_init__(self):
+        import math
+
+        if not (math.isfinite(self.start) and self.start > 0):
+            raise ValueError(f"schedule start must be finite and > 0, "
+                             f"got {self.start}")
+        if not (0 < self.decay < 1):
+            raise ValueError(f"schedule decay must be in (0, 1), "
+                             f"got {self.decay}")
+
+    def at(self, step: int, final_tol: float) -> float:
+        if final_tol <= 0:
+            # an explicit tol <= 0 disables convergence tests entirely
+            # (pinned iteration counts); a schedule must not re-enable them
+            return final_tol
+        return max(float(final_tol), self.start * self.decay ** max(step, 0))
+
+
+def parse_tolerance_schedule(spec: str) -> "ToleranceSchedule | None":
+    """Parse a ``START:DECAY`` CLI spec (e.g. ``1e-3:0.1``) into a
+    :class:`ToleranceSchedule`; ``off``/``none`` disable it. Raises
+    ``ValueError`` with a usable message on anything malformed."""
+    s = spec.strip().lower()
+    if s in ("off", "none", ""):
+        return None
+    parts = s.split(":")
+    if len(parts) != 2:
+        raise ValueError(
+            f"expected START:DECAY (e.g. 1e-3:0.1) or 'off', got {spec!r}")
+    try:
+        start, decay = float(parts[0]), float(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"expected numeric START:DECAY, got {spec!r}") from None
+    return ToleranceSchedule(start, decay)
+
+
 class OptimizationResult(NamedTuple):
     """Final point + convergence record (OptimizationStatesTracker role)."""
 

@@ -129,12 +129,27 @@ def lbfgs(
             ls.ok,
             converged_check(s.f, ls.f, gnorm, g0_norm, config.tolerance),
             grad_converged(gnorm, g0_norm, config.tolerance))
+        # A fit that converges on its FIRST iteration was already at its
+        # stopping point: the step it just probed buys less than the
+        # tolerance by definition, and taking it would make a warm-started
+        # re-fit of an already-converged problem drift by one noise-level
+        # step per call — coordinate descent re-fits every coordinate
+        # every sweep, and that drift kept re-activating the active-set
+        # frontier (game/descent.py) and prevented the sweep-level early
+        # exit from ever seeing a stationary score vector. Such a re-fit
+        # is now an exact no-op: it returns w0 bit-identically. Later
+        # iterations keep their converging step (it carries the final
+        # refinement of a genuinely-progressing fit), as before.
+        take = ~(conv & (s.it == 0))
+        w_out = jnp.where(take, w_new, s.w)
+        f_out = jnp.where(take, ls.f, s.f)
+        g_out = jnp.where(take, ls.g, s.g)
         return _State(
-            s.it + 1, k_new, w_new, ls.f, ls.g,
+            s.it + 1, k_new, w_out, f_out, g_out,
             s_hist, y_hist, rho,
             conv, stalled,
-            s.loss_hist.at[s.it].set(ls.f),
-            s.gnorm_hist.at[s.it].set(gnorm),
+            s.loss_hist.at[s.it].set(f_out),
+            s.gnorm_hist.at[s.it].set(l2_norm(g_out)),
         )
 
     def cond(s: _State):

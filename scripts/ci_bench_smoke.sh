@@ -1,12 +1,19 @@
 #!/usr/bin/env bash
-# Non-tier-1 bench smoke: run `bench.py stream` on a tiny synthetic shard
-# (CPU, seconds) so the streamed-throughput bench mode cannot rot between
-# hardware rounds. Runs alongside — never instead of — scripts/ci_tier1.sh.
-# The mode self-checks its acceptance invariants (warm >= 2x cold, f64
-# cache parity <= 1e-9, flat compile count) and exits non-zero on failure.
+# Non-tier-1 bench smoke: run the CPU-sized bench modes (seconds to a
+# couple of minutes each) so they cannot rot between hardware rounds.
+# Runs alongside — never instead of — scripts/ci_tier1.sh. Each mode
+# self-checks its acceptance invariants and exits non-zero on failure:
+#   stream — warm chunk-cache >= 2x cold, f64 cache parity <= 1e-9, flat
+#            compile count
+#   cd     — active-set CD >= 1.5x full sweeps, f64 coefficient parity
+#            <= 1e-9, 0 RE-solver compiles across the timed active run
 set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu \
 BENCH_STREAM_ROWS="${BENCH_STREAM_ROWS:-8000}" \
 BENCH_STREAM_FIT_ITERS="${BENCH_STREAM_FIT_ITERS:-3}" \
 timeout -k 10 600 python bench.py stream
+JAX_PLATFORMS=cpu \
+BENCH_CD_ENTITIES="${BENCH_CD_ENTITIES:-1200}" \
+BENCH_CD_SWEEPS="${BENCH_CD_SWEEPS:-24}" \
+timeout -k 10 600 python bench.py cd

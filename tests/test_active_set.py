@@ -1,0 +1,332 @@
+"""Active-set coordinate descent: converged-entity freezing with
+offset-drift re-activation, incremental delta scoring, the running
+residual total, sweep-level early exit, and the inexact-solve tolerance
+schedule (game/descent.py + game/random_effect.py + optimize/common.py)."""
+
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game import descent as descent_mod
+from photon_ml_tpu.game.data import build_random_effect_data, build_score_view
+from photon_ml_tpu.game.descent import (
+    CoordinateConfig,
+    CoordinateDescent,
+    make_game_dataset,
+)
+from photon_ml_tpu.game.random_effect import (
+    re_solver_compile_count,
+    score_random_effect,
+    train_random_effect,
+)
+from photon_ml_tpu.optimize import (
+    OptimizerConfig,
+    ToleranceSchedule,
+    parse_tolerance_schedule,
+)
+
+
+def _synth_game(seed=0, n_users=60, d_g=6, d_u=4):
+    rng = np.random.default_rng(seed)
+    w_fixed = rng.normal(size=d_g)
+    U = rng.normal(size=(n_users, d_u))
+    Xg, Xu, y, uid = [], [], [], []
+    for u in range(n_users):
+        m = int(rng.integers(10, 24))
+        xg, xu = rng.normal(size=(m, d_g)), rng.normal(size=(m, d_u))
+        marg = xg @ w_fixed + xu @ U[u]
+        y.append((rng.random(m) < 1 / (1 + np.exp(-marg))).astype(float))
+        Xg.append(xg)
+        Xu.append(xu)
+        uid.append(np.full(m, u))
+    Xg, Xu, y, uid = map(np.concatenate, (Xg, Xu, y, uid))
+    return make_game_dataset({"g": Xg, "u": Xu}, y,
+                             entity_ids={"userId": uid})
+
+
+@pytest.fixture(scope="module")
+def game_ds():
+    return _synth_game()
+
+
+N_USERS = 60
+
+
+def _configs(active_set, fixed_kw=None, **re_kw):
+    re_kw.setdefault("tolerance", 1e-11)
+    re_kw.setdefault("optimizer", "newton")
+    re_kw.setdefault("refresh_every", 5)
+    re_kw.setdefault("active_tol", 1e-10)
+    return [
+        CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                         reg_weight=2.0, tolerance=1e-12,
+                         **(fixed_kw or {})),
+        CoordinateConfig("per-user", coordinate_type="random",
+                         feature_shard="u", entity_column="userId",
+                         reg_type="l2", reg_weight=2.0,
+                         active_set=active_set, **re_kw),
+    ]
+
+
+def _coeff_diff(m_a, m_b):
+    diffs = [np.max(np.abs(
+        np.asarray(m_a.coordinates["fixed"].model.coefficients.means)
+        - np.asarray(m_b.coordinates["fixed"].model.coefficients.means)))]
+    for ba, bb in zip(m_a.coordinates["per-user"].buckets,
+                      m_b.coordinates["per-user"].buckets):
+        if np.asarray(ba.coefficients).size:
+            diffs.append(np.max(np.abs(np.asarray(ba.coefficients)
+                                       - np.asarray(bb.coefficients))))
+    return max(diffs)
+
+
+def _solved(history):
+    return [r["entities_solved"] for r in history
+            if r["coordinate"] == "per-user" and "entities_solved" in r]
+
+
+def test_active_set_matches_full_sweeps_f64(game_ds):
+    """The tentpole gate: active-set CD (freezing + incremental rescoring
+    + drift re-activation) agrees with the full-sweep fit to <= 1e-9 in
+    f64 over the same sweep budget, while actually shrinking the per-sweep
+    frontier."""
+    n_it = 14
+    m_full, h_full = CoordinateDescent(
+        _configs(False), task="logistic", n_iterations=n_it,
+        dtype=jnp.float64).run(game_ds)
+    m_act, h_act = CoordinateDescent(
+        _configs(True), task="logistic", n_iterations=n_it,
+        dtype=jnp.float64).run(game_ds)
+    assert _coeff_diff(m_full, m_act) <= 1e-9
+    solved = _solved(h_act)
+    assert solved[0] == N_USERS  # first sweep is always a full solve
+    assert min(solved) < N_USERS  # the frontier shrank at some sweep
+    # the full-sweep run never skips anything
+    assert all(s == N_USERS for s in _solved(h_full))
+
+
+def test_frozen_entities_reactivated_after_fixed_effect_moves(game_ds):
+    """Freezing is not a one-way door: with a loose drift tolerance the
+    random effect freezes while the (iteration-capped, slowly-moving)
+    fixed effect is still drifting; each refresh sweep re-solves the
+    frozen entities against the moved offsets and actually changes their
+    coefficients."""
+    snaps = {}
+    m, h = CoordinateDescent(
+        _configs(True, fixed_kw={"max_iters": 2}, active_tol=3e-2,
+                 refresh_every=3),
+        task="logistic", n_iterations=10, dtype=jnp.float64,
+    ).run(game_ds, checkpoint_callback=lambda it, model: snaps.update(
+        {it: [np.array(b.coefficients) for b in
+              model.coordinates["per-user"].buckets]}))
+    re_recs = [r for r in h if r["coordinate"] == "per-user"]
+    frozen_sweeps = [r["iteration"] for r in re_recs
+                     if r["entities_solved"] == 0]
+    assert frozen_sweeps, "loose active_tol should fully freeze some sweep"
+    s = frozen_sweeps[0]
+    refreshes = [r["iteration"] for r in re_recs
+                 if r["iteration"] > s and r.get("refresh")]
+    assert refreshes, "a refresh sweep must follow the frozen sweep"
+    ref = refreshes[0]
+    # frozen sweep: coefficients carried bit-identically
+    for a, b in zip(snaps[s - 1], snaps[s]):
+        np.testing.assert_array_equal(a, b)
+    # the refresh re-solved against the fixed effect's moved offsets and
+    # the frozen entities' coefficients actually moved (re-activation)
+    assert max(np.max(np.abs(a - b)) for a, b in
+               zip(snaps[ref - 1], snaps[ref])) > 0
+
+
+def test_early_exit_deterministic_and_recorded(game_ds):
+    """cd_tolerance early exit fires before the sweep budget, records the
+    stop reason, and two identical runs are bit-identical."""
+    def run():
+        return CoordinateDescent(
+            _configs(True), task="logistic", n_iterations=20,
+            dtype=jnp.float64, cd_tolerance=1e-10).run(game_ds)
+
+    m1, h1 = run()
+    m2, h2 = run()
+    assert h1[-1]["stop_reason"] == "cd_tolerance"
+    assert h1[-1]["iteration"] + 1 < 20
+    assert len(h1) == len(h2)
+    assert [r["score_delta"] for r in h1] == [r["score_delta"] for r in h2]
+    assert _coeff_diff(m1, m2) == 0.0
+    # a disabled tolerance runs the full budget and says so
+    _, h3 = CoordinateDescent(
+        _configs(True), task="logistic", n_iterations=3,
+        dtype=jnp.float64).run(game_ds)
+    assert h3[-1]["stop_reason"] == "max_iterations"
+
+
+def test_compile_counter_flat_across_shrinking_active_sets(game_ds):
+    """Once the power-of-two sub-bucket ladder is warm, shrinking active
+    sets must reuse it: 0 new RE-solver compiles across every sweep of a
+    repeat run."""
+    def run(callback=None):
+        return CoordinateDescent(
+            _configs(True), task="logistic", n_iterations=14,
+            dtype=jnp.float64).run(game_ds, checkpoint_callback=callback)
+
+    run()  # warm the ladder
+    counts = []
+    _, h = run(callback=lambda it, m: counts.append(
+        re_solver_compile_count()))
+    assert min(_solved(h)) < N_USERS  # the active set did shrink
+    assert len(set(counts)) == 1, counts  # flat: no compile at any sweep
+
+
+def test_running_total_parity(game_ds, monkeypatch):
+    """Satellite: the O(1)-per-update running residual total must match
+    the explicit per-coordinate re-sum it replaced (<= 1e-9 on the final
+    f64 coefficients)."""
+    m_run, _ = CoordinateDescent(
+        _configs(True), task="logistic", n_iterations=6,
+        dtype=jnp.float64).run(game_ds)
+
+    def exact_excluding(self, name, scores):
+        return self.base + sum(v for k, v in scores.items() if k != name)
+
+    monkeypatch.setattr(descent_mod._ResidualTotal, "excluding",
+                        exact_excluding)
+    m_sum, _ = CoordinateDescent(
+        _configs(True), task="logistic", n_iterations=6,
+        dtype=jnp.float64).run(game_ds)
+    assert _coeff_diff(m_run, m_sum) <= 1e-9
+
+
+def test_residual_total_tracks_resum():
+    rng = np.random.default_rng(3)
+    base = jnp.asarray(rng.normal(size=200))
+    scores = {k: jnp.asarray(rng.normal(size=200)) for k in "abc"}
+    rt = descent_mod._ResidualTotal(base)
+    rt.resync(scores)
+    for _ in range(20):
+        k = rng.choice(list("abc"))
+        new = jnp.asarray(rng.normal(size=200))
+        np.testing.assert_allclose(
+            np.asarray(rt.excluding(k, scores)),
+            np.asarray(base + sum(v for n, v in scores.items() if n != k)),
+            atol=1e-12)
+        rt.replace(scores[k], new)
+        scores[k] = new
+        np.testing.assert_allclose(
+            np.asarray(rt.total),
+            np.asarray(base + sum(scores.values())), atol=1e-12)
+
+
+def test_incremental_scoring_matches_full(rng):
+    """score_random_effect's incremental mode (changed-entity gather +
+    scatter-overwrite) must reproduce the full recompute."""
+    n, d, E = 300, 6, 24
+    X = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.7)
+    ids = rng.integers(0, E, n)
+    y = rng.integers(0, 2, n).astype(float)
+    data = build_random_effect_data(X, y, np.ones(n), ids, num_buckets=3)
+    view = build_score_view(data, X, ids)
+    W0 = [rng.normal(size=(b.num_entities, b.local_dim))
+          for b in data.buckets]
+    s0 = score_random_effect(view, W0, n, jnp.float64)
+    # perturb a subset of entities in every bucket
+    W1, changed = [], []
+    for W in W0:
+        mask = rng.random(W.shape[0]) < 0.3
+        Wn = W.copy()
+        Wn[mask] += rng.normal(size=(int(mask.sum()), W.shape[1]))
+        W1.append(Wn)
+        changed.append(mask)
+    full = score_random_effect(view, W1, n, jnp.float64)
+    incr = score_random_effect(view, W1, n, jnp.float64, prev=s0,
+                               changed=changed)
+    np.testing.assert_allclose(np.asarray(incr), np.asarray(full),
+                               atol=1e-12)
+    # empty changed masks are a no-op returning prev
+    same = score_random_effect(view, W1, n, jnp.float64, prev=full,
+                               changed=[np.zeros(len(m), bool)
+                                        for m in changed])
+    np.testing.assert_array_equal(np.asarray(same), np.asarray(full))
+
+
+def test_train_random_effect_active_carries_frozen(rng):
+    """Frozen entities' coefficients/variances ride through untouched and
+    report converged=True / iterations=0; solved entities match a full
+    solve restricted to them."""
+    n, d, E = 240, 5, 16
+    X = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.8)
+    ids = rng.integers(0, E, n)
+    y = rng.integers(0, 2, n).astype(float)
+    data = build_random_effect_data(X, y, np.ones(n), ids, num_buckets=2)
+    offs = jnp.zeros((n,), jnp.float64)
+    kw = dict(task="logistic", l2=1.0, dtype=jnp.float64,
+              optimizer="newton", compute_variance="diagonal",
+              config=OptimizerConfig(max_iters=50, tolerance=1e-10))
+    full = train_random_effect(data, offs, **kw)
+    w0 = [np.array(c) for c in full.coefficients]
+    active = [np.zeros(b.num_entities, bool) for b in data.buckets]
+    active[0][: max(1, data.buckets[0].num_entities // 2)] = True
+    refit = train_random_effect(data, offs, w0=w0,
+                                prev_variances=full.variances,
+                                active=active, **kw)
+    for b in range(len(data.buckets)):
+        frozen = ~active[b]
+        np.testing.assert_array_equal(
+            np.asarray(refit.coefficients[b])[frozen],
+            np.asarray(w0[b])[frozen])
+        np.testing.assert_array_equal(
+            np.asarray(refit.variances[b])[frozen],
+            np.asarray(full.variances[b])[frozen])
+        assert refit.converged[b][frozen].all()
+        assert (refit.iterations[b][frozen] == 0).all()
+    assert refit.entities_solved == int(sum(a.sum() for a in active))
+    # active without w0 is a contract violation
+    with pytest.raises(ValueError, match="active-set training needs w0"):
+        train_random_effect(data, offs, active=active, **kw)
+    # shape-mismatched mask is rejected
+    bad = [np.zeros(3, bool) for _ in data.buckets]
+    with pytest.raises(ValueError, match="active mask"):
+        train_random_effect(data, offs, w0=w0, active=bad, **kw)
+
+
+def test_history_timing_split_and_logging(game_ds, caplog):
+    """Satellite: per-coordinate records carry the solve vs eval timing
+    split (PR-4 stall accounting composes with it), and the verbose path
+    goes through logging, not print."""
+    with caplog.at_level(logging.INFO, logger="photon_ml_tpu.game.descent"):
+        _, h = CoordinateDescent(
+            _configs(True), task="logistic", n_iterations=2,
+            dtype=jnp.float64, evaluators=["auc"],
+            verbose=True).run(game_ds, validation=game_ds)
+    for r in h:
+        assert {"solve_seconds", "eval_seconds", "seconds",
+                "score_delta"} <= set(r)
+        assert r["seconds"] >= r["solve_seconds"] >= 0
+        assert r["eval_seconds"] >= 0
+    assert any("[CD]" in rec.message for rec in caplog.records)
+
+
+def test_tolerance_schedule():
+    s = ToleranceSchedule(1e-2, 0.1)
+    assert s.at(0, 1e-8) == 1e-2
+    assert s.at(3, 1e-8) == pytest.approx(1e-5)
+    assert s.at(10, 1e-8) == 1e-8  # clamped at the final tolerance
+    assert s.at(5, 0.0) == 0.0  # explicit tol<=0 stays disabled
+    assert parse_tolerance_schedule("off") is None
+    assert parse_tolerance_schedule("1e-3:0.5") == ToleranceSchedule(1e-3, 0.5)
+    for bad in ("1e-3", "1e-3:2", "nan:0.1", "a:b", "0:0.1"):
+        with pytest.raises(ValueError):
+            parse_tolerance_schedule(bad)
+
+
+def test_solver_tol_schedule_in_history(game_ds):
+    """The schedule's per-sweep effective tolerance is recorded and
+    tightens geometrically to the coordinate tolerance."""
+    _, h = CoordinateDescent(
+        _configs(True), task="logistic", n_iterations=4,
+        dtype=jnp.float64,
+        solver_tol_schedule=ToleranceSchedule(1e-3, 0.1)).run(game_ds)
+    tols = [r["solver_tolerance"] for r in h if r["coordinate"] == "fixed"]
+    assert tols[0] == pytest.approx(1e-3)
+    assert all(b <= a for a, b in zip(tols, tols[1:]))
+    assert tols[-1] >= 1e-12  # never below the coordinate tolerance

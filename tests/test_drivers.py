@@ -155,6 +155,69 @@ def test_warm_start_and_locked_via_driver(game_fixture):
         assert np.isclose(wa[k], wb[k], rtol=1e-10)
 
 
+def test_training_driver_rejects_bad_cd_flags(game_fixture):
+    """--re-refresh-every must be positive and --cd-tolerance finite and
+    >= 0 (the PR-2 --batch-rows validation pattern): argparse rejects them
+    at parse time, before any data is read."""
+    base = [
+        "--train-data", str(game_fixture / "train.avro"),
+        "--output-dir", str(game_fixture / "out-bad"),
+        "--coordinates", str(game_fixture / "coords.json"),
+        "--feature-shards", str(game_fixture / "shards.json"),
+    ]
+    for extra in (["--re-refresh-every", "0"],
+                  ["--re-refresh-every", "-2"],
+                  ["--cd-tolerance", "nan"],
+                  ["--cd-tolerance", "inf"],
+                  ["--cd-tolerance", "-1e-3"],
+                  ["--solver-tol-schedule", "1e-3"],
+                  ["--solver-tol-schedule", "1e-3:2"],
+                  ["--solver-tol-schedule", "0:0.1"]):
+        with pytest.raises(SystemExit) as exc:
+            train_main(base + extra)
+        assert exc.value.code == 2, extra
+    assert not (game_fixture / "out-bad").exists()
+
+
+def test_training_driver_cd_convergence_flags(game_fixture):
+    """Happy path for the CD convergence controls: the run completes, the
+    history records the stop reason, and the tolerance schedule's
+    per-sweep solver tolerance rides the cd_iteration log events."""
+    out = game_fixture / "out-cd"
+    rc = train_main([
+        "--train-data", str(game_fixture / "train.avro"),
+        "--validation-data", str(game_fixture / "val.avro"),
+        "--output-dir", str(out),
+        "--coordinates", json.dumps([
+            {"name": "fixed", "coordinate_type": "fixed",
+             "feature_shard": "global", "reg_type": "l2",
+             "reg_weight": 1.0},
+            {"name": "per-user", "coordinate_type": "random",
+             "feature_shard": "user", "entity_column": "userId",
+             "reg_type": "l2", "reg_weight": 1.0, "optimizer": "newton",
+             "tolerance": 1e-10},
+        ]),
+        "--feature-shards", str(game_fixture / "shards.json"),
+        "--n-iterations", "4",
+        "--cd-tolerance", "1e-8",
+        "--re-active-set",
+        "--re-refresh-every", "3",
+        "--solver-tol-schedule", "1e-3:0.1",
+        "--dtype", "float64",
+    ])
+    assert rc == 0
+    assert (out / "best" / "metadata.json").exists()
+    log = [json.loads(l)
+           for l in (out / "photon.log.jsonl").read_text().splitlines()]
+    cd = [r for r in log if r["event"] == "cd_iteration"]
+    assert cd[-1]["stop_reason"] in ("cd_tolerance", "max_iterations")
+    tols = [r["solver_tolerance"] for r in cd if r["coordinate"] == "fixed"]
+    assert tols[0] == pytest.approx(1e-3)
+    assert all(b <= a for a, b in zip(tols, tols[1:]))
+    assert all("entities_solved" in r for r in cd
+               if r["coordinate"] == "per-user")
+
+
 def test_feature_indexing_driver(game_fixture):
     out = str(game_fixture / "imap.json")
     rc = index_main(["--data", str(game_fixture / "train.avro"), "--output", out])
