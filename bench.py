@@ -317,16 +317,39 @@ def main() -> None:
 
 
 def serving_main() -> None:
-    """``python bench.py serving`` — online-scoring latency/throughput.
+    """``python bench.py serving`` — online-scoring capacity on CPU.
 
-    Measures the resident serving stack (ScoringSession + MicroBatcher +
-    ScoringService, in-process — no sockets, so the numbers are the
-    scoring stack's, not the kernel's TCP stack) on CPU against a
-    synthetic GAME model: p50/p99 request latency and row throughput at
-    each batch size, after warmup (the shape ladder is pre-compiled, so
-    nothing here times XLA). Writes ``BENCH_serving.json`` next to this
-    file and prints the same JSON line."""
+    Four legs over one synthetic GAME model (in-process service — no
+    sockets, so the numbers are the scoring stack's, not the kernel's
+    TCP stack; the socket path is covered by tests/test_serving_async):
+
+    * ``closed_loop`` — the PR-2 methodology (sequential requests, batch
+      sizes 1..max_batch) on BOTH the paged fused path and the host-LRU
+      path, written as the baseline leg next to the open-loop results;
+      the previously recorded BENCH_serving.json value is carried along
+      so the speedup is against the PUBLISHED baseline, not a re-run.
+    * ``open_loop`` — an offered-load sweep through the asyncio scoring
+      path (Poisson-ish fixed-interval arrivals, many requests in
+      flight): achieved rows/s, accepted-request p50/p99, the
+      queue-wait vs device-compute split, and shed counts per rate. The
+      highest achieved rate is the single-replica capacity.
+    * ``multi_replica`` — the same sweep over N in-process replicas
+      (own session + batcher each) behind least-loaded dispatch.
+      Process-level replicas + the HTTP front door are exercised in
+      tests; in this bench the replicas share the python runtime, so on
+      a single-core container the aggregate is GIL-bound — cpu_count is
+      recorded so the number reads honestly.
+    * ``overload_soak`` — 2x the measured capacity against a small
+      queue with deadline shedding: the contract is explicit 429s,
+      ZERO scoring-path 5xx, and a flat compile-miss counter; a hot
+      swap fires mid-soak and must not compile or error.
+
+    ``BENCH_SERVING_SMOKE=1`` shrinks every leg for CI and enforces the
+    acceptance floor (exit 7): open-loop >= BENCH_SERVING_FLOOR rows/s
+    (default 15000), 0 steady-state compile misses, 0 scoring 5xx.
+    Writes ``BENCH_serving.json`` and prints the same JSON."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import asyncio
     import shutil
     import tempfile
 
@@ -342,13 +365,26 @@ def serving_main() -> None:
         CoordinateDescent,
         make_game_dataset,
     )
+    from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
     from photon_ml_tpu.io.index_map import IndexMap
     from photon_ml_tpu.io.model_io import save_game_model
     from photon_ml_tpu.serve import (
+        AsyncScoringServer,
         MicroBatcher,
         ScoringService,
         ScoringSession,
     )
+
+    smoke = os.environ.get("BENCH_SERVING_SMOKE") == "1"
+    here = os.path.dirname(os.path.abspath(__file__))
+    prev_recorded = None
+    try:
+        with open(os.path.join(here, "BENCH_serving.json")) as f:
+            prev = json.load(f)
+        prev_recorded = float(prev.get("previous_recorded_rows_per_s")
+                              or prev.get("value"))
+    except Exception:
+        pass
 
     rng = np.random.default_rng(0)
     n, d_fix, d_re, n_entities = 600, 32, 8, 64
@@ -366,22 +402,25 @@ def serving_main() -> None:
                           reg_type="l2", reg_weight=1.0)],
         task="logistic")
     model, _ = cd.run(ds)
-    # the whole run works out of one temp tree, removed on exit (the swap
-    # mode always cleaned up; serving used to leak its tree)
+    # the whole run works out of one temp tree, removed on exit
     root = tempfile.mkdtemp(prefix="bench-serving-")
     model_dir = os.path.join(root, "model")
     save_game_model(model, model_dir, {
         "g": IndexMap({f"g{j}": j for j in range(d_fix)}),
         "u": IndexMap({f"u{j}": j for j in range(d_re)}),
     })
+    # a perturbed sibling model for the mid-load hot swap
+    delta_dir = os.path.join(root, "model-delta")
+    shutil.copytree(model_dir, delta_dir)
+    re_path = os.path.join(delta_dir, "random-effect", "per-user",
+                           "coefficients.avro")
+    records, schema = read_avro_file(re_path)
+    for rec in records[: max(1, len(records) // 10)]:
+        for coef in rec["means"]:
+            coef["value"] *= 1.05
+    write_avro_file(re_path, records, schema)
 
-    max_batch = 64
-    session = ScoringSession(model_dir, max_batch=max_batch,
-                             coeff_cache_entries=n_entities)
-    batcher = MicroBatcher(session.score_rows, max_batch=max_batch,
-                           max_delay_ms=0.5, max_queue=512,
-                           metrics=session.metrics)
-    service = ScoringService(session, batcher)
+    max_batch = int(os.environ.get("BENCH_SERVING_MAX_BATCH", 64))
 
     def make_row(i):
         return {
@@ -393,49 +432,272 @@ def serving_main() -> None:
             "entityIds": {"userId": str(uid[i % n])},
         }
 
-    results = []
-    reps = int(os.environ.get("BENCH_SERVING_REPS", 100))
-    for batch_size in (1, 8, 32, 64):
-        rows = [make_row(i) for i in range(batch_size)]
-        for _ in range(5):  # warm the cache ladder + coefficient LRU
-            service.handle_score({"rows": rows})
-        lat = []
-        t_all = time.perf_counter()
-        for _ in range(reps):
+    def make_service(paged=True, max_queue=1024, max_delay_ms=0.5,
+                     deadline_s=None):
+        session = ScoringSession(model_dir, max_batch=max_batch,
+                                 coeff_cache_entries=n_entities,
+                                 paged_table=paged)
+        batcher = MicroBatcher(
+            session.score_rows, max_batch=max_batch,
+            max_delay_ms=max_delay_ms, max_queue=max_queue,
+            request_deadline_s=deadline_s, metrics=session.metrics)
+        return ScoringService(session, batcher, request_timeout_s=30.0)
+
+    # -- leg 1: closed loop (the PR-2 baseline methodology) ----------------
+    def closed_loop(service, reps):
+        out = []
+        sizes = [b for b in (1, 8, 32, 64) if b <= max_batch]
+        for batch_size in sizes:
+            rows = [make_row(i) for i in range(batch_size)]
+            for _ in range(5):
+                service.handle_score({"rows": rows})
+            lat = []
+            t_all = time.perf_counter()
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                status, _body = service.handle_score({"rows": rows})
+                lat.append((time.perf_counter() - t0) * 1e3)
+                assert status == 200, f"bench request failed: {status}"
+            wall = time.perf_counter() - t_all
+            lat.sort()
+            out.append({
+                "batch_size": batch_size,
+                "p50_ms": round(lat[len(lat) // 2], 3),
+                "p99_ms": round(lat[min(len(lat) - 1,
+                                        int(len(lat) * 0.99))], 3),
+                "rows_per_s": round(batch_size * reps / wall, 1),
+            })
+        return out
+
+    reps = int(os.environ.get("BENCH_SERVING_REPS", 20 if smoke else 100))
+    svc_lru = make_service(paged=False)
+    closed_lru = closed_loop(svc_lru, reps)
+    svc_lru.close()
+    svc = make_service(paged=True)
+    closed_paged = closed_loop(svc, reps)
+
+    # -- leg 2: open loop on the asyncio scoring path ----------------------
+    req_rows = min(max_batch, 64)
+    payloads = [{"rows": [make_row(i * req_rows + j)
+                          for j in range(req_rows)]}
+                for i in range(32)]
+
+    def open_loop(services, rate_rows_s, duration_s):
+        """Fixed-interval offered load against one or more in-process
+        replicas (least-loaded pick), via the same score_async path the
+        asyncio transport uses. Returns achieved/accepted stats."""
+        servers = [AsyncScoringServer(s) for s in services]
+
+        async def run():
+            interval = req_rows / rate_rows_s
+            results = {"ok": 0, "ok_rows": 0, "shed": 0, "errors": 0,
+                       "lat": []}
+            tasks = []
+
+            async def fire(payload):
+                pick = min(range(len(servers)),
+                           key=lambda i:
+                           services[i].batcher.queue_depth)
+                t0 = time.perf_counter()
+                status, _body = await servers[pick].score_async(payload)
+                ms = (time.perf_counter() - t0) * 1e3
+                if status == 200:
+                    results["ok"] += 1
+                    results["ok_rows"] += req_rows
+                    results["lat"].append(ms)
+                elif status == 429:
+                    results["shed"] += 1
+                else:
+                    results["errors"] += 1
+
+            loop = asyncio.get_running_loop()
+            t_start = loop.time()
+            t_next = t_start
+            i = 0
+            while loop.time() - t_start < duration_s:
+                tasks.append(asyncio.ensure_future(
+                    fire(payloads[i % len(payloads)])))
+                i += 1
+                t_next += interval
+                delay = t_next - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+            await asyncio.gather(*tasks)
+            results["wall_s"] = loop.time() - t_start
+            return results
+
+        r = asyncio.run(run())
+        lat = sorted(r["lat"]) or [0.0]
+        return {
+            "offered_rows_per_s": rate_rows_s,
+            "achieved_rows_per_s": round(r["ok_rows"] / r["wall_s"], 1),
+            "accepted_p50_ms": round(lat[len(lat) // 2], 3),
+            "accepted_p99_ms": round(lat[min(len(lat) - 1,
+                                             int(len(lat) * 0.99))], 3),
+            "requests_ok": r["ok"],
+            "requests_shed": r["shed"],
+            "requests_errored": r["errors"],
+        }
+
+    duration = float(os.environ.get(
+        "BENCH_SERVING_DURATION_S", 1.0 if smoke else 3.0))
+    rates = ([20_000, 60_000] if smoke else
+             [10_000, 25_000, 50_000, 75_000, 100_000, 150_000])
+    misses_before_steady = svc.metrics.snapshot()["compile_cache_misses"]
+    sweep = []
+    for rate in rates:
+        snap0 = svc.metrics.snapshot()
+        leg = open_loop([svc], rate, duration)
+        snap1 = svc.metrics.snapshot()
+        leg["queue_wait_p99_ms"] = snap1["queue_wait_p99_ms"]
+        leg["compute_p50_ms"] = snap1["compute_p50_ms"]
+        leg["batches"] = snap1["batches_total"] - snap0["batches_total"]
+        sweep.append(leg)
+        if leg["requests_shed"] > 0 and len(sweep) >= 2:
+            break  # past saturation: further rates only add shed noise
+    single_capacity = max(s["achieved_rows_per_s"] for s in sweep)
+    # latency criterion reads at the highest SUSTAINED rate (no shed,
+    # >= 90% of offered delivered): p99 at saturation with a deep queue
+    # measures the queue, not the serving stack
+    sustained = [s for s in sweep
+                 if s["requests_shed"] == 0
+                 and s["achieved_rows_per_s"]
+                 >= 0.9 * s["offered_rows_per_s"]]
+    at_capacity = max(sustained or sweep,
+                      key=lambda s: s["achieved_rows_per_s"])
+
+    # -- leg 3: hot swap mid-load (compile misses pinned flat) -------------
+    swap_info = {}
+
+    def swap_mid_load():
+        async def run():
+            server = AsyncScoringServer(svc)
+            stop = {"flag": False}
+
+            async def traffic():
+                i = 0
+                while not stop["flag"]:
+                    await server.score_async(payloads[i % len(payloads)])
+                    i += 1
+
+            t = asyncio.ensure_future(traffic())
+            await asyncio.sleep(0.2)
+            loop = asyncio.get_running_loop()
             t0 = time.perf_counter()
-            status, _body = service.handle_score({"rows": rows})
-            lat.append((time.perf_counter() - t0) * 1e3)
-            assert status == 200, f"bench request failed: {status}"
-        wall = time.perf_counter() - t_all
-        lat.sort()
-        results.append({
-            "batch_size": batch_size,
-            "p50_ms": round(lat[len(lat) // 2], 3),
-            "p99_ms": round(lat[min(len(lat) - 1,
-                                    int(len(lat) * 0.99))], 3),
-            "rows_per_s": round(batch_size * reps / wall, 1),
+            await loop.run_in_executor(
+                None, lambda: svc.session.swap(delta_dir))
+            swap_ms = (time.perf_counter() - t0) * 1e3
+            await asyncio.sleep(0.2)
+            stop["flag"] = True
+            await t
+            return swap_ms
+
+        misses0 = svc.metrics.snapshot()["compile_cache_misses"]
+        errors0 = svc.metrics.snapshot()["errors_total"]
+        swap_ms = asyncio.run(run())
+        svc.session.drain_installs(30.0)
+        snap = svc.metrics.snapshot()
+        swap_info.update({
+            "swap_ms": round(swap_ms, 3),
+            "compile_misses_during_swap":
+                snap["compile_cache_misses"] - misses0,
+            "errors_during_swap": snap["errors_total"] - errors0,
+            "active_version_after": snap["active_version"],
         })
-    snap = service.metrics.snapshot()
-    service.close()
+
+    swap_mid_load()
+    misses_after_steady = svc.metrics.snapshot()["compile_cache_misses"]
+    steady_misses = misses_after_steady - misses_before_steady
+    final_snap = svc.metrics.snapshot()
+    svc.close()
+
+    # -- leg 4: multi-replica aggregate ------------------------------------
+    n_replicas = int(os.environ.get(
+        "BENCH_SERVING_REPLICAS", 2 if smoke else
+        max(2, min(4, os.cpu_count() or 1))))
+    replicas = [make_service(paged=True) for _ in range(n_replicas)]
+    for r_svc in replicas:  # warm every replica's ladder + pages
+        r_svc.handle_score(payloads[0])
+    multi = []
+    for rate in ([60_000] if smoke else [60_000, 100_000, 150_000]):
+        multi.append(open_loop(replicas, rate, duration))
+    multi_capacity = max(m["achieved_rows_per_s"] for m in multi)
+    multi_errors = sum(m["requests_errored"] for m in multi)
+    for r_svc in replicas:
+        r_svc.close()
+
+    # -- leg 5: 2x-overload soak with a small queue + deadline shed --------
+    soak_svc = make_service(paged=True, max_queue=32, deadline_s=0.25)
+    soak_svc.handle_score(payloads[0])
+    soak = open_loop([soak_svc], max(2 * single_capacity, 20_000),
+                     duration)
+    soak_snap = soak_svc.metrics.snapshot()
+    soak["shed_queue_full"] = soak_snap["shed_queue_full_total"]
+    soak["shed_deadline"] = soak_snap["shed_deadline_total"]
+    soak_svc.close()
+
+    cpu_cores = os.cpu_count() or 1
+    speedup = (round(single_capacity / prev_recorded, 2)
+               if prev_recorded else None)
     record = {
-        "metric": "serving_score_latency_cpu",
-        "value": results[-1]["rows_per_s"],
-        "unit": (f"rows/sec at batch={results[-1]['batch_size']} "
-                 f"({jax.devices()[0].platform}, in-process service, "
+        "metric": "serving_open_loop_rows_per_sec_cpu",
+        "value": multi_capacity,
+        "unit": (f"rows/sec, {n_replicas}-replica in-process open loop "
+                 f"({jax.devices()[0].platform}, {cpu_cores} cores, "
+                 f"max_batch={max_batch}, req_rows={req_rows}, "
                  f"d_fix={d_fix}, d_re={d_re}, entities={n_entities}; "
-                 "per-batch-size p50/p99 in 'results')"),
-        "results": results,
+                 "single-replica sweep + closed-loop baseline legs in "
+                 "fields; on a 1-core container replicas share the GIL "
+                 "and the aggregate ~= single-replica capacity)"),
+        "single_replica_rows_per_s": single_capacity,
+        "multi_replica_rows_per_s": multi_capacity,
+        "replicas": n_replicas,
+        "cpu_cores": cpu_cores,
+        "previous_recorded_rows_per_s": prev_recorded,
+        "speedup_vs_previous_record": speedup,
+        "open_loop": sweep,
+        "multi_replica": multi,
+        "overload_soak": soak,
+        "hot_swap_mid_load": swap_info,
+        "closed_loop_baseline": {"paged": closed_paged,
+                                 "host_lru": closed_lru},
+        "steady_state_compile_misses": steady_misses,
         "compile_cache": {
-            "misses": snap["compile_cache_misses"],
-            "hits": snap["compile_cache_hits"],
+            "misses": final_snap["compile_cache_misses"],
+            "hits": final_snap["compile_cache_hits"],
         },
-        "coeff_cache_hit_rate": round(snap["coeff_cache_hit_rate"], 4),
+        "paged": {
+            "installs": final_snap["paged_installs"],
+            "faults": final_snap["paged_faults"],
+            "page_evictions": final_snap["paged_page_evictions"],
+        },
     }
-    here = os.path.dirname(os.path.abspath(__file__))
+    floor = float(os.environ.get("BENCH_SERVING_FLOOR", 15_000))
+    ok = (single_capacity >= floor
+          and steady_misses == 0
+          and swap_info.get("compile_misses_during_swap") == 0
+          and swap_info.get("errors_during_swap") == 0
+          and soak["requests_errored"] == 0 and multi_errors == 0
+          and (soak["requests_shed"] > 0
+               or soak["shed_deadline"] > 0))
+    record["acceptance_ok"] = ok
+    record["acceptance_criteria"] = {
+        "floor_rows_per_s": floor,
+        "p99_at_capacity_below_prev_p50_15_6ms":
+            at_capacity["accepted_p99_ms"] < 15.6,
+        "overload_sheds_with_zero_5xx":
+            soak["requests_errored"] == 0
+            and (soak["requests_shed"] > 0 or soak["shed_deadline"] > 0),
+    }
     with open(os.path.join(here, "BENCH_serving.json"), "w") as f:
         json.dump(record, f, indent=2)
     print(json.dumps(record))
     shutil.rmtree(root, ignore_errors=True)
+    if smoke and not ok:
+        print("serving bench acceptance FAILED (open-loop floor, flat "
+              "compile misses incl. mid-load swap, shed-not-5xx "
+              "overload)", file=sys.stderr)
+        sys.exit(7)
 
 
 def swap_main() -> None:

@@ -2,9 +2,10 @@
 
 The fourth driver next to train/score/index: load a model ONCE, keep it
 resident (``serve/session.py``), and answer JSON scoring requests with
-micro-batching, shape-bucketed pre-compiled executables, and an
-entity-coefficient LRU. See docs/serving.md for the endpoint and
-operational contract, docs/lifecycle.md for the registry integration.
+micro-batching, shape-bucketed pre-compiled executables, a
+device-resident paged coefficient table, and an entity-coefficient LRU.
+See docs/serving.md for the endpoint and operational contract,
+docs/lifecycle.md for the registry integration.
 
     photon-game-serve --model-dir out/model --port 8471 \
         --max-batch 64 --max-delay-ms 5
@@ -12,10 +13,20 @@ operational contract, docs/lifecycle.md for the registry integration.
     # registry mode: serve LATEST, follow promotions, hot-swap in place
     photon-game-serve --registry /models/registry --watch-interval-s 10
 
+    # multi-replica: N serving processes behind an asyncio front door,
+    # every replica watching the same registry for consistent hot swap
+    photon-game-serve --registry /models/registry --replicas 4 \
+        --port 8471
+
+The front end defaults to the asyncio server (``--server async``,
+``serve/aserver.py``); ``--server thread`` keeps the PR-2
+``ThreadingHTTPServer`` stack.
+
 Shutdown contract: SIGTERM/SIGINT stop the listener (no new requests),
 DRAIN the micro-batcher (in-flight and queued batches finish and their
 responses go out), then exit 0 — a rolling restart never kills requests
-mid-batch.
+mid-batch. In multi-replica mode the parent forwards the signal to
+every replica and waits for their drains.
 """
 
 from __future__ import annotations
@@ -23,7 +34,11 @@ from __future__ import annotations
 import argparse
 import os
 import signal
+import socket
+import subprocess
+import sys
 import threading
+import time
 from typing import Sequence
 
 from photon_ml_tpu.utils import PhotonLogger, Timed
@@ -67,6 +82,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="padded nonzeros per row in the compiled shapes")
     p.add_argument("--coeff-cache-entries", type=positive_int, default=4096,
                    help="resident entities per random effect (LRU)")
+    p.add_argument("--server", choices=["async", "thread"], default="async",
+                   help="front end: asyncio event loop (default) or the "
+                        "thread-per-request http.server stack")
+    p.add_argument("--replicas", type=positive_int, default=1,
+                   help="N > 1 spawns N serving processes on successive "
+                        "ports behind an asyncio front door on --port")
+    p.add_argument("--front-door-policy", default="least_loaded",
+                   choices=["least_loaded", "round_robin"],
+                   help="replica selection at the front door")
+    p.add_argument("--no-paged-table", action="store_true",
+                   help="disable the device-resident paged coefficient "
+                        "table (host-LRU scoring path only)")
+    p.add_argument("--re-pages", type=positive_int, default=4,
+                   help="paged-table pages per random effect")
+    p.add_argument("--re-page-rows", type=positive_int, default=256,
+                   help="entities per paged-table page (page = unit of "
+                        "device install/evict transfer)")
+    p.add_argument("--re-dense-dim-max", type=positive_int, default=4096,
+                   help="widest random-effect feature space to densify "
+                        "into pages; wider coordinates use the LRU path")
+    p.add_argument("--queue-deadline-s", type=float, default=0.0,
+                   help="> 0 sheds requests still queued after this long "
+                        "(429 cause=deadline) instead of scoring them")
     p.add_argument("--watchdog-s", type=float, default=60.0,
                    help="stuck-batch watchdog; <= 0 disables")
     p.add_argument("--request-timeout-s", type=float, default=30.0)
@@ -81,13 +119,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
-def build_server(args):
-    """Session + batcher + HTTP server (+ registry) from parsed args
-    (shared with the serving bench, which drives the service without
-    the process exec). Returns (server, registry_or_None)."""
+def build_service(args):
+    """Session + batcher + service (+ registry) from parsed args
+    (shared by both transports and the serving bench, which drives the
+    service without the process exec). Returns (service, registry)."""
     from photon_ml_tpu.serve import (
         MicroBatcher,
-        ScoringServer,
         ScoringService,
         ScoringSession,
     )
@@ -108,15 +145,30 @@ def build_server(args):
         source = args.model_dir
     session = ScoringSession(
         source, dtype=args.dtype, max_batch=args.max_batch,
-        pad_nnz=args.pad_nnz, coeff_cache_entries=args.coeff_cache_entries)
+        pad_nnz=args.pad_nnz, coeff_cache_entries=args.coeff_cache_entries,
+        paged_table=not getattr(args, "no_paged_table", False),
+        re_pages=getattr(args, "re_pages", 4),
+        re_page_rows=getattr(args, "re_page_rows", 256),
+        re_dense_dim_max=getattr(args, "re_dense_dim_max", 4096))
+    deadline = getattr(args, "queue_deadline_s", 0.0)
     batcher = MicroBatcher(
         session.score_rows, max_batch=args.max_batch,
         max_delay_ms=args.max_delay_ms, max_queue=args.max_queue,
         watchdog_s=(None if args.watchdog_s <= 0 else args.watchdog_s),
+        request_deadline_s=(deadline if deadline > 0 else None),
         metrics=session.metrics)
     service = ScoringService(session, batcher,
                              request_timeout_s=args.request_timeout_s,
                              registry=registry)
+    return service, registry
+
+
+def build_server(args):
+    """Threaded-transport convenience over :func:`build_service` (kept
+    for the PR-2 entry shape: returns (server, registry))."""
+    from photon_ml_tpu.serve import ScoringServer
+
+    service, registry = build_service(args)
     return ScoringServer(service, host=args.host, port=args.port), registry
 
 
@@ -145,34 +197,185 @@ def install_signal_handlers(server, signals=(signal.SIGTERM, signal.SIGINT)):
     return state
 
 
+def _maybe_watcher(args, registry, session, logger):
+    if (registry is None or args.watch_interval_s <= 0
+            or args.model_version):
+        return None
+    from photon_ml_tpu.serve import RegistryWatcher
+
+    return RegistryWatcher(
+        registry, session, interval_s=args.watch_interval_s,
+        jitter_s=min(1.0, args.watch_interval_s / 10.0),
+        on_swap=lambda v: logger.log("hot_swap", version=v,
+                                     source="watcher"),
+        on_error=lambda e: logger.log("watch_error", error=str(e)),
+    ).start()
+
+
+def _announce(logger, session, host, port, compiled, transport):
+    logger.log("serving_ready", host=host, port=port,
+               active_version=session.active_version,
+               precompiled_executables=compiled, transport=transport)
+    paged = "paged" if session.paged_active else "host-LRU"
+    print(f"serving {session.active_version} on http://{host}:{port} "
+          f"({transport}, {paged} coefficients, {compiled} pre-compiled "
+          "executables; POST /score, POST /admin/reload, GET /healthz, "
+          "GET /metrics)", flush=True)
+
+
+def _run_async(args, logger) -> int:
+    from photon_ml_tpu.serve import AsyncScoringServer
+
+    with Timed(logger, "load_and_warmup"):
+        service, registry = build_service(args)
+    session = service.session
+    compiled = session.compile_count
+    watcher = _maybe_watcher(args, registry, session, logger)
+    server = AsyncScoringServer(service, host=args.host, port=args.port)
+    try:
+        server.run_forever(
+            drain_timeout_s=args.drain_timeout_s,
+            ready_callback=lambda srv: _announce(
+                logger, session, srv.host, srv.port, compiled, "asyncio"))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        logger.log("driver_done", drained=True,
+                   **service.metrics.snapshot())
+        logger.close()
+    return 0
+
+
+def _free_port(host: str) -> int:
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _replica_argv(args, port: int, log_dir: str) -> list:
+    argv = [sys.executable, "-m", "photon_ml_tpu.cli.serving_driver",
+            "--replicas", "1", "--server", "async",
+            "--host", args.host, "--port", str(port),
+            "--max-batch", str(args.max_batch),
+            "--max-delay-ms", str(args.max_delay_ms),
+            "--max-queue", str(args.max_queue),
+            "--pad-nnz", str(args.pad_nnz),
+            "--coeff-cache-entries", str(args.coeff_cache_entries),
+            "--re-pages", str(args.re_pages),
+            "--re-page-rows", str(args.re_page_rows),
+            "--re-dense-dim-max", str(args.re_dense_dim_max),
+            "--queue-deadline-s", str(args.queue_deadline_s),
+            "--watchdog-s", str(args.watchdog_s),
+            "--request-timeout-s", str(args.request_timeout_s),
+            "--drain-timeout-s", str(args.drain_timeout_s),
+            "--watch-interval-s", str(args.watch_interval_s),
+            "--dtype", args.dtype, "--log-dir", log_dir]
+    if args.no_paged_table:
+        argv.append("--no-paged-table")
+    if args.registry:
+        argv += ["--registry", args.registry]
+        if args.model_version:
+            argv += ["--model-version", args.model_version]
+    else:
+        argv += ["--model-dir", args.model_dir]
+    return argv
+
+
+def _wait_healthy(host: str, port: int, timeout_s: float,
+                  proc=None) -> bool:
+    import urllib.request
+
+    deadline = time.monotonic() + timeout_s
+    url = f"http://{host}:{port}/healthz"
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False  # replica died during warmup
+        try:
+            with urllib.request.urlopen(url, timeout=1.0) as resp:
+                if resp.status == 200:
+                    return True
+        except Exception:
+            time.sleep(0.2)
+    return False
+
+
+def _run_multi_replica(args, logger) -> int:
+    """N replica processes + asyncio front door. Every replica loads the
+    same source; in registry mode each runs its own watcher (with
+    jitter), so a promotion reaches all replicas within one poll
+    interval — the front door needs no model awareness at all."""
+    from photon_ml_tpu.serve import AsyncFrontDoor
+
+    log_root = args.log_dir or args.model_dir or args.registry
+    ports = [_free_port(args.host) for _ in range(args.replicas)]
+    procs = []
+    for i, port in enumerate(ports):
+        rep_log = os.path.join(log_root, f"replica-{i}")
+        os.makedirs(rep_log, exist_ok=True)
+        procs.append(subprocess.Popen(
+            _replica_argv(args, port, rep_log),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+    logger.log("replicas_spawned", ports=ports,
+               pids=[p.pid for p in procs])
+    ok = all(_wait_healthy(args.host, port, timeout_s=180.0, proc=p)
+             for port, p in zip(ports, procs))
+    if not ok:
+        for p in procs:
+            p.terminate()
+        logger.log("replica_startup_failed", ports=ports)
+        logger.close()
+        print("replica startup failed (see replica logs)", flush=True)
+        return 1
+    door = AsyncFrontDoor([f"{args.host}:{p}" for p in ports],
+                          host=args.host, port=args.port,
+                          policy=args.front_door_policy)
+
+    def ready(d):
+        logger.log("front_door_ready", host=d.host, port=d.port,
+                   backends=[f"{args.host}:{p}" for p in ports])
+        print(f"front door on http://{d.host}:{d.port} -> "
+              f"{len(ports)} replicas on {ports} "
+              f"({args.front_door_policy})", flush=True)
+
+    try:
+        door.run_forever(ready_callback=ready)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for p in procs:
+            p.terminate()  # SIGTERM -> each replica drains
+        deadline = time.monotonic() + args.drain_timeout_s + 10.0
+        for p in procs:
+            try:
+                p.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        logger.log("driver_done", replicas=len(procs))
+        logger.close()
+    return 0
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
     log_dir = args.log_dir or args.model_dir or args.registry
     os.makedirs(log_dir, exist_ok=True)
     logger = PhotonLogger(os.path.join(log_dir, "photon.log.jsonl"))
     logger.log("driver_start", driver="serving", args=vars(args))
+    if args.replicas > 1:
+        return _run_multi_replica(args, logger)
+    if args.server == "async":
+        return _run_async(args, logger)
     with Timed(logger, "load_and_warmup"):
         server, registry = build_server(args)
     session = server.service.session
     compiled = session.compile_count
-    watcher = None
-    if (registry is not None and args.watch_interval_s > 0
-            and not args.model_version):
-        from photon_ml_tpu.serve import RegistryWatcher
-
-        watcher = RegistryWatcher(
-            registry, session, interval_s=args.watch_interval_s,
-            on_swap=lambda v: logger.log("hot_swap", version=v,
-                                         source="watcher"),
-            on_error=lambda e: logger.log("watch_error", error=str(e)),
-        ).start()
-    logger.log("serving_ready", host=server.host, port=server.port,
-               active_version=session.active_version,
-               precompiled_executables=compiled)
-    print(f"serving {session.active_version} on "
-          f"http://{server.host}:{server.port} "
-          f"({compiled} pre-compiled executables; POST /score, "
-          "POST /admin/reload, GET /healthz, GET /metrics)", flush=True)
+    watcher = _maybe_watcher(args, registry, session, logger)
+    _announce(logger, session, server.host, server.port, compiled,
+              "threaded")
     stop = install_signal_handlers(server)
     try:
         server.serve_forever()

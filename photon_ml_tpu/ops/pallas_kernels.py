@@ -139,6 +139,32 @@ def multiply_prefix_sum(
     return local.reshape(-1), totals, tile
 
 
+def paged_gather_score(table: jax.Array, slots: jax.Array,
+                       indices: jax.Array, values: jax.Array) -> jax.Array:
+    """Per-row margin of a batch against a device-resident paged entity
+    table: ``out[i] = sum_j table[slots[i], indices[i, j]] * values[i, j]``
+    with ``slots[i] < 0`` (no resident entity model) scoring exactly 0.
+
+    ``table`` is the paged coefficient buffer flattened to ``[S, D]``
+    (``S = pages * page_rows`` slots, ``D`` dense global-feature dims);
+    ``slots`` int32 ``[B]``; ``indices`` int32 / ``values`` ``[B, k]``
+    are the batch's resolved sparse features for the table's shard.
+
+    Lowering: ONE flat ``table_gather`` over ``slot * D + index`` — the
+    same gather idiom as the margin kernels (``types.table_gather``), so
+    the whole random-effect score is a single [B*k] gather + row-sum with
+    no ``[B, D]`` dense intermediate and no host round-trip. Serving's
+    fused executable calls this once per random coordinate per batch."""
+    from photon_ml_tpu.types import table_gather
+
+    dim = table.shape[-1]
+    safe = jnp.maximum(slots, 0).astype(jnp.int32)
+    flat_idx = safe[:, None] * dim + indices
+    picked = table_gather(table.reshape(-1), flat_idx)  # [B, k]
+    score = jnp.sum(picked * values, axis=-1)
+    return jnp.where(slots >= 0, score, jnp.zeros((), table.dtype))
+
+
 def csc_transpose_apply_pallas(csc, d: jax.Array) -> jax.Array:
     """``X^T d`` from the column-sorted view with the fused Pallas per-tile
     scan + the shared blocked boundary combine (drop-in for

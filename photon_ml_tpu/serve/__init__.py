@@ -34,14 +34,17 @@ from photon_ml_tpu.serve.coeff_cache import (
     ModelDirCoefficientStore,
 )
 from photon_ml_tpu.serve.metrics import Histogram, ServingMetrics
+from photon_ml_tpu.serve.paged_table import PagedCoefficientTable
 from photon_ml_tpu.serve.session import ScoringSession
 from photon_ml_tpu.serve.server import ScoringService, ScoringServer
+from photon_ml_tpu.serve.aserver import AsyncFrontDoor, AsyncScoringServer
 from photon_ml_tpu.serve.watcher import RegistryWatcher
 
 __all__ = [
     "ScoringSession", "MicroBatcher", "QueueFullError",
     "BatchWatchdogTimeout", "EntityCoefficientLRU",
     "LayeredCoefficientStore", "ModelDirCoefficientStore", "Histogram",
-    "ServingMetrics", "ScoringService", "ScoringServer",
+    "ServingMetrics", "PagedCoefficientTable", "ScoringService",
+    "ScoringServer", "AsyncScoringServer", "AsyncFrontDoor",
     "RegistryWatcher",
 ]

@@ -1,0 +1,499 @@
+"""Asyncio serving front end + multi-replica front door (stdlib-only).
+
+The PR-2 transport was ``http.server.ThreadingHTTPServer``: one OS
+thread per connection, JSON parsed on the request thread, and every
+blocked reader holding a thread while it waits on the batcher. At
+production QPS the thread churn and per-connection stacks dominate the
+host budget before the scoring stack is even warm. This module replaces
+that edge with an event loop:
+
+* :class:`AsyncScoringServer` — protocol-level HTTP/1.1 over
+  ``asyncio.start_server`` (uvloop is used when importable; the stdlib
+  loop is the floor). Requests are parsed ON the loop, handed to the
+  existing :class:`~photon_ml_tpu.serve.batcher.MicroBatcher` through
+  its non-blocking ``submit`` (a bounded ``put_nowait`` — the loop never
+  blocks on admission), and resolved back onto the loop via
+  ``PendingRequest.add_done_callback`` + ``call_soon_threadsafe``. The
+  200/400/404/429/503/504 status contract, ``Retry-After`` hints,
+  graceful SIGTERM drain, and Prometheus ``/metrics`` all carry over
+  (the response shaping is shared with the threaded server through
+  :class:`~photon_ml_tpu.serve.server.ScoringService`).
+
+* :class:`AsyncFrontDoor` — the multi-replica edge: a tiny asyncio
+  reverse proxy that spreads ``/score`` traffic across N replica
+  servers, least-loaded first (ties round-robin), with per-backend
+  connection pooling, failure cool-down, and one retry on another
+  backend. Replicas stay consistent under hot swap by all watching the
+  same registry (``serve/watcher.py``); the front door is deliberately
+  model-oblivious.
+
+Admin/scoring split: ``/admin/reload`` runs in a worker thread
+(``run_in_executor``) because a swap legitimately takes milliseconds to
+seconds — the loop keeps serving scores while a swap builds off to the
+side.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from photon_ml_tpu.serve.server import ScoringService
+
+__all__ = ["AsyncScoringServer", "AsyncFrontDoor", "install_uvloop"]
+
+_MAX_HEAD = 64 * 1024
+_MAX_BODY = 64 * 1024 * 1024
+
+
+def install_uvloop() -> bool:
+    """Install uvloop's event-loop policy when the wheel is present.
+    Optional by design: the container may not ship uvloop, and the
+    stdlib loop must remain a correct (slower) floor."""
+    try:
+        import uvloop  # type: ignore
+    except ImportError:
+        return False
+    uvloop.install()
+    return True
+
+
+def _http_date() -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+
+
+def _encode_response(status: int, body, content_type="application/json",
+                     keep_alive=True, extra_headers: Sequence[Tuple[str,
+                                                                    str]] = ()
+                     ) -> bytes:
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              409: "Conflict", 429: "Too Many Requests",
+              500: "Internal Server Error", 503: "Service Unavailable",
+              504: "Gateway Timeout"}.get(status, "Status")
+    data = body if isinstance(body, (bytes, str)) else json.dumps(body)
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    head = [f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(data)}",
+            f"Connection: {'keep-alive' if keep_alive else 'close'}"]
+    for k, v in extra_headers:
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode("ascii") + data
+
+
+async def _read_request(reader: asyncio.StreamReader):
+    """One HTTP/1.1 request: ``(method, path, headers, body)`` or None
+    on clean EOF. Raises ValueError on malformed input (caller answers
+    400 and closes)."""
+    try:
+        head = await reader.readuntil(b"\r\n\r\n")
+    except asyncio.IncompleteReadError as e:
+        if not e.partial:
+            return None  # clean close between requests
+        raise ValueError("truncated request head") from None
+    except asyncio.LimitOverrunError:
+        raise ValueError(f"request head over {_MAX_HEAD} bytes") from None
+    lines = head.decode("latin-1").split("\r\n")
+    try:
+        method, path, _version = lines[0].split(" ", 2)
+    except ValueError:
+        raise ValueError(f"bad request line {lines[0]!r}") from None
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if not line:
+            continue
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    if "chunked" in headers.get("transfer-encoding", "").lower():
+        raise ValueError("chunked request bodies are not supported")
+    length = int(headers.get("content-length", "0") or 0)
+    if length < 0 or length > _MAX_BODY:
+        raise ValueError(f"bad content-length {length}")
+    body = await reader.readexactly(length) if length else b""
+    return method, path, headers, body
+
+
+class AsyncScoringServer:
+    """Event-loop HTTP endpoint over a :class:`ScoringService`.
+
+    Same endpoints and status contract as the threaded
+    :class:`~photon_ml_tpu.serve.server.ScoringServer`; the difference
+    is the execution model — parsing on the loop, scoring resolved
+    through batcher callbacks, no thread per connection. ``start()`` /
+    ``aclose()`` are the async API (tests, in-process bench);
+    :meth:`run_forever` is the driver entry (installs SIGTERM/SIGINT
+    drain handlers on the loop)."""
+
+    def __init__(self, service: ScoringService, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.service = service
+        self._host_arg, self._port_arg = host, port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.host: str = host
+        self.port: int = 0
+        self._conns: set = set()
+        self._draining = False
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> "AsyncScoringServer":
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._serve_connection, self._host_arg, self._port_arg,
+            limit=_MAX_HEAD)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        return self
+
+    async def aclose(self, drain_timeout_s: float = 5.0) -> None:
+        """Graceful drain: stop accepting, let in-flight requests finish
+        (bounded), flush the batcher, then drop stragglers."""
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        deadline = time.monotonic() + drain_timeout_s
+        while self._conns and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        # batcher drain blocks: keep the loop alive in an executor
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.service.close, drain_timeout_s)
+        for task in list(self._conns):
+            task.cancel()
+
+    def run_forever(self, drain_timeout_s: float = 30.0,
+                    ready_callback=None) -> int:
+        """Foreground serve (the CLI driver's main loop): SIGTERM/SIGINT
+        stop the listener, the batcher drains, then return 0 — the same
+        rolling-restart contract as the threaded server."""
+        install_uvloop()
+
+        async def main():
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    loop.add_signal_handler(sig, stop.set)
+                except (NotImplementedError, RuntimeError):
+                    pass  # non-main thread / platforms without support
+            await self.start()
+            if ready_callback is not None:
+                ready_callback(self)
+            await stop.wait()
+            await self.aclose(drain_timeout_s)
+
+        asyncio.run(main())
+        return 0
+
+    # -- connection handling ----------------------------------------------
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            while not self._draining:
+                try:
+                    req = await _read_request(reader)
+                except ValueError as e:
+                    writer.write(_encode_response(
+                        400, {"error": str(e)}, keep_alive=False))
+                    await writer.drain()
+                    return
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    return
+                if req is None:
+                    return
+                method, path, headers, body = req
+                keep = headers.get("connection", "").lower() != "close"
+                data = await self._dispatch(method, path, body)
+                writer.write(data if keep else
+                             data.replace(b"Connection: keep-alive",
+                                          b"Connection: close", 1))
+                await writer.drain()
+                if not keep:
+                    return
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._conns.discard(task)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, method: str, path: str, body: bytes) -> bytes:
+        svc = self.service
+        if method == "GET":
+            if path == "/healthz":
+                status, payload = svc.handle_healthz()
+                payload["server"] = "asyncio"
+                return _encode_response(status, payload)
+            if path == "/metrics":
+                status, text = svc.handle_metrics()
+                return _encode_response(
+                    status, text, content_type="text/plain; version=0.0.4")
+            return _encode_response(404,
+                                    {"error": f"unknown path {path}"})
+        if method != "POST" or path not in ("/score", "/admin/reload"):
+            return _encode_response(404, {"error": f"unknown path {path}"})
+        try:
+            payload = json.loads(body or b"null")
+        except (ValueError, json.JSONDecodeError) as e:
+            return _encode_response(400, {"error": f"bad JSON: {e}"})
+        if path == "/admin/reload":
+            # swaps take ms-seconds: off the loop, scores keep flowing
+            status, resp = await asyncio.get_running_loop().run_in_executor(
+                None, svc.handle_reload, payload)
+            return _encode_response(status, resp)
+        status, resp = await self.score_async(payload)
+        extra = ()
+        if status == 429 and isinstance(resp, dict):
+            after = max(1, int(-(-float(resp.get("retryAfterS", 1.0)) // 1)))
+            extra = (("Retry-After", str(after)),)
+        return _encode_response(status, resp, extra_headers=extra)
+
+    async def score_async(self, payload) -> Tuple[int, dict]:
+        """``/score`` without blocking the loop: validate inline, admit
+        through the batcher's non-blocking submit, await the worker's
+        resolution via done-callback."""
+        svc = self.service
+        valid, err = svc.validate_score_payload(payload)
+        if valid is None:
+            return 400, err
+        rows, per_coord = valid
+        loop = asyncio.get_running_loop()
+        fut: "asyncio.Future" = loop.create_future()
+
+        def _resolve(req):
+            if not fut.cancelled():
+                loop.call_soon_threadsafe(_complete, req)
+
+        def _complete(req):
+            if fut.cancelled():
+                return
+            if req.error is not None:
+                fut.set_exception(req.error)
+            else:
+                fut.set_result(req.result(0))
+
+        try:
+            svc.batcher.submit(rows, per_coord).add_done_callback(_resolve)
+            result = await asyncio.wait_for(fut, svc.request_timeout_s)
+        except Exception as e:
+            return svc.score_error_response(e)
+        return 200, svc.score_body(rows, per_coord, result)
+
+
+class _Backend:
+    """One replica behind the front door: address, pooled connections,
+    in-flight count, failure cool-down."""
+
+    __slots__ = ("host", "port", "inflight", "down_until", "pool")
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = int(port)
+        self.inflight = 0
+        self.down_until = 0.0
+        self.pool: List[tuple] = []  # (reader, writer) keep-alive pairs
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class AsyncFrontDoor:
+    """Least-loaded/round-robin HTTP front door for N scoring replicas.
+
+    Policy: among backends not in failure cool-down, pick the lowest
+    in-flight count (ties resolved round-robin). A backend that fails to
+    connect or mid-exchange is cooled down for ``retry_backend_s`` and
+    the request is retried ONCE on another backend; with every backend
+    down the client sees 503 (the front door never queues — queueing and
+    shedding live in the replicas' batchers, one admission-control point
+    per process)."""
+
+    def __init__(self, backends: Sequence[str], host: str = "127.0.0.1",
+                 port: int = 0, policy: str = "least_loaded",
+                 retry_backend_s: float = 1.0):
+        if not backends:
+            raise ValueError("front door needs at least one backend")
+        if policy not in ("least_loaded", "round_robin"):
+            raise ValueError(f"unknown policy {policy!r}")
+        self._backends = []
+        for b in backends:
+            h, _, p = str(b).rpartition(":")
+            self._backends.append(_Backend(h or "127.0.0.1", int(p)))
+        self.policy = policy
+        self.retry_backend_s = float(retry_backend_s)
+        self._rr = 0
+        self._host_arg, self._port_arg = host, port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.host: str = host
+        self.port: int = 0
+        self.proxied = 0
+        self.retried = 0
+        self.unavailable = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> "AsyncFrontDoor":
+        self._server = await asyncio.start_server(
+            self._serve_connection, self._host_arg, self._port_arg,
+            limit=_MAX_HEAD)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        return self
+
+    async def aclose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for b in self._backends:
+            for _r, w in b.pool:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            b.pool.clear()
+
+    def run_forever(self, ready_callback=None) -> int:
+        install_uvloop()
+
+        async def main():
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    loop.add_signal_handler(sig, stop.set)
+                except (NotImplementedError, RuntimeError):
+                    pass
+            await self.start()
+            if ready_callback is not None:
+                ready_callback(self)
+            await stop.wait()
+            await self.aclose()
+
+        asyncio.run(main())
+        return 0
+
+    # -- backend selection -------------------------------------------------
+    def _pick(self, exclude: set) -> Optional[_Backend]:
+        now = time.monotonic()
+        live = [b for b in self._backends
+                if b.address not in exclude and b.down_until <= now]
+        if not live:
+            return None
+        if self.policy == "round_robin":
+            self._rr += 1
+            return live[self._rr % len(live)]
+        best = min(b.inflight for b in live)
+        tied = [b for b in live if b.inflight == best]
+        self._rr += 1
+        return tied[self._rr % len(tied)]
+
+    async def _backend_exchange(self, backend: _Backend,
+                                request: bytes) -> bytes:
+        """Send one request on a pooled (or fresh) connection; return
+        the full response bytes (head + body, content-length framed)."""
+        if backend.pool:
+            reader, writer = backend.pool.pop()
+        else:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(backend.host, backend.port,
+                                        limit=_MAX_HEAD), timeout=5.0)
+        try:
+            writer.write(request)
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            length = 0
+            for line in head.split(b"\r\n")[1:]:
+                if line.lower().startswith(b"content-length:"):
+                    length = int(line.split(b":", 1)[1])
+                    break
+            body = await reader.readexactly(length) if length else b""
+            backend.pool.append((reader, writer))
+            return head + body
+        except BaseException:
+            try:
+                writer.close()
+            except Exception:
+                pass
+            raise
+
+    # -- proxy loop --------------------------------------------------------
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    req = await _read_request(reader)
+                except ValueError as e:
+                    writer.write(_encode_response(
+                        400, {"error": str(e)}, keep_alive=False))
+                    await writer.drain()
+                    return
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    return
+                if req is None:
+                    return
+                method, path, headers, body = req
+                if method == "GET" and path == "/fd/healthz":
+                    writer.write(_encode_response(200, self.stats()))
+                    await writer.drain()
+                    continue
+                data = await self._proxy(method, path, body)
+                writer.write(data)
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    return
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _proxy(self, method: str, path: str, body: bytes) -> bytes:
+        request = (
+            f"{method} {path} HTTP/1.1\r\n"
+            f"Host: backend\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: keep-alive\r\n\r\n").encode("ascii") + body
+        tried: set = set()
+        for _attempt in range(2):
+            backend = self._pick(tried)
+            if backend is None:
+                break
+            backend.inflight += 1
+            try:
+                data = await self._backend_exchange(backend, request)
+                self.proxied += 1
+                return data
+            except Exception:
+                tried.add(backend.address)
+                backend.down_until = (time.monotonic()
+                                      + self.retry_backend_s)
+                self.retried += 1
+            finally:
+                backend.inflight -= 1
+        self.unavailable += 1
+        return _encode_response(
+            503, {"error": "no live backend replica"})
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "policy": self.policy,
+            "backends": [
+                {"address": b.address, "inflight": b.inflight,
+                 "down": b.down_until > time.monotonic()}
+                for b in self._backends
+            ],
+            "proxied": self.proxied,
+            "retried": self.retried,
+            "unavailable": self.unavailable,
+        }

@@ -40,15 +40,25 @@ __all__ = ["QueueFullError", "BatchWatchdogTimeout", "MicroBatcher",
 
 
 class QueueFullError(RuntimeError):
-    """Admission queue at capacity: the request was SHED, not queued.
-    Callers should surface this as retryable backpressure (HTTP 429)."""
+    """The request was SHED, not queued — either the admission queue was
+    at capacity (``cause="queue_full"``) or the request's deadline
+    expired while it waited for a batch slot (``cause="deadline"``).
+    Callers should surface this as retryable backpressure (HTTP 429);
+    ``retry_after_s`` is the server's backoff hint — current queue depth
+    times the batching deadline, i.e. roughly how long the backlog ahead
+    of a retry takes to drain."""
 
-    def __init__(self, depth: int, capacity: int):
+    def __init__(self, depth: int, capacity: int,
+                 retry_after_s: float = 0.0, cause: str = "queue_full"):
+        what = ("admission queue full" if cause == "queue_full"
+                else "deadline expired while queued")
         super().__init__(
-            f"admission queue full ({depth}/{capacity}); request shed — "
+            f"{what} ({depth}/{capacity}); request shed — "
             "retry with backoff or scale out")
         self.depth = depth
         self.capacity = capacity
+        self.retry_after_s = float(retry_after_s)
+        self.cause = cause
 
 
 class BatchWatchdogTimeout(WatchdogTimeout):
@@ -60,10 +70,13 @@ class BatchWatchdogTimeout(WatchdogTimeout):
 class PendingRequest:
     """One admitted request: rows in, (scores, parts) or an exception
     out. ``result()`` blocks the submitting thread until the batcher's
-    worker resolves it."""
+    worker resolves it; ``add_done_callback`` is the non-blocking
+    alternative the asyncio front end uses (the callback fires on the
+    batcher's worker thread — bridge back to the event loop with
+    ``loop.call_soon_threadsafe``)."""
 
     __slots__ = ("rows", "per_coordinate", "_event", "_result", "_error",
-                 "admitted_at")
+                 "admitted_at", "_callbacks", "_cb_lock")
 
     def __init__(self, rows: Sequence[dict], per_coordinate: bool):
         self.rows = list(rows)
@@ -71,15 +84,40 @@ class PendingRequest:
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        self._callbacks: List[Callable] = []
+        self._cb_lock = threading.Lock()
         self.admitted_at = time.monotonic()
 
     def set_result(self, value) -> None:
         self._result = value
         self._event.set()
+        self._fire_callbacks()
 
     def set_error(self, exc: BaseException) -> None:
         self._error = exc
         self._event.set()
+        self._fire_callbacks()
+
+    def _fire_callbacks(self) -> None:
+        with self._cb_lock:
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb(self)
+
+    def add_done_callback(self, cb: Callable) -> None:
+        """Invoke ``cb(self)`` when the request resolves (immediately if
+        it already has). Runs on whichever thread resolves the request —
+        the submitter may race the worker, so registration is locked
+        against the resolution's callback drain."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(cb)
+                return
+        cb(self)
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -104,11 +142,18 @@ class MicroBatcher:
 
     ``watchdog_s=None`` disables the stuck-batch watchdog (execution runs
     inline on the worker); the default keeps it armed.
+
+    ``request_deadline_s`` arms queued-request expiry: a request that is
+    still waiting when its admission time + deadline passes is shed by
+    the worker (:class:`QueueFullError` with ``cause="deadline"``)
+    instead of being scored — under sustained overload the queue would
+    otherwise serve only requests whose clients already gave up.
     """
 
     def __init__(self, score_fn: Callable, *, max_batch: int = 64,
                  max_delay_ms: float = 5.0, max_queue: int = 256,
-                 watchdog_s: Optional[float] = 60.0, metrics=None):
+                 watchdog_s: Optional[float] = 60.0,
+                 request_deadline_s: Optional[float] = None, metrics=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 1:
@@ -117,6 +162,8 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1e3
         self.watchdog_s = watchdog_s
+        self.request_deadline_s = (None if request_deadline_s is None
+                                   else float(request_deadline_s))
         self._queue: "queue.Queue[Optional[PendingRequest]]" = queue.Queue(
             maxsize=int(max_queue))
         self._metrics = metrics
@@ -146,12 +193,22 @@ class MicroBatcher:
             self._queue.put_nowait(req)
         except queue.Full:
             if self._metrics is not None:
-                self._metrics.record_shed()
-            raise QueueFullError(self._queue.qsize(),
-                                 self._queue.maxsize) from None
+                self._metrics.record_shed(cause="queue_full")
+            raise QueueFullError(self._queue.qsize(), self._queue.maxsize,
+                                 retry_after_s=self.retry_after_s,
+                                 cause="queue_full") from None
         if self._metrics is not None:
             self._metrics.set_queue_depth(self._queue.qsize())
         return req
+
+    @property
+    def retry_after_s(self) -> float:
+        """Backoff hint for shed requests: the backlog ahead of a retry,
+        estimated as queue depth (in batches) times the batching deadline
+        — the slowest the queue can drain when traffic is too sparse to
+        fill batches early. Floored at one deadline."""
+        batches_queued = self._queue.qsize() / max(self.max_batch, 1)
+        return max(self.max_delay_s, batches_queued * self.max_delay_s)
 
     def score(self, rows: Sequence[dict], per_coordinate: bool = False,
               timeout: Optional[float] = None):
@@ -171,17 +228,36 @@ class MicroBatcher:
         self._worker.join(drain_timeout_s)
 
     # -- worker ------------------------------------------------------------
+    def _expired(self, req: PendingRequest) -> bool:
+        """Shed a queued request whose deadline passed (worker-side;
+        returns True when the request was shed and must be skipped)."""
+        if (self.request_deadline_s is None
+                or time.monotonic() - req.admitted_at
+                < self.request_deadline_s):
+            return False
+        if self._metrics is not None:
+            self._metrics.record_shed(cause="deadline")
+        req.set_error(QueueFullError(
+            self._queue.qsize(), self._queue.maxsize,
+            retry_after_s=self.retry_after_s, cause="deadline"))
+        return True
+
     def _collect_batch(self) -> Optional[List[PendingRequest]]:
         """Block for the first request, then coalesce companions until
         the deadline (first request's arrival + max_delay) or max_batch
         rows. Requests are admitted whole: one whose rows would overflow
-        the batch stays queued for the next one."""
-        if self._carry is not None:
-            first, self._carry = self._carry, None
-        else:
-            first = self._queue.get()
-            if first is None:
-                return None
+        the batch stays queued for the next one. Requests whose own
+        deadline expired while queued are shed, not scored."""
+        first = None
+        while first is None:
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                first = self._queue.get()
+                if first is None:
+                    return None
+            if self._expired(first):
+                first = None
         batch = [first]
         rows = len(first.rows)
         deadline = time.monotonic() + self.max_delay_s
@@ -196,6 +272,8 @@ class MicroBatcher:
             if nxt is None:
                 self._queue.put(None)  # re-post the shutdown token
                 break
+            if self._expired(nxt):
+                continue
             if rows + len(nxt.rows) > self.max_batch:
                 # no peeking API on queue.Queue: hold the overflow
                 # request back; it seeds the next batch
@@ -246,6 +324,7 @@ class MicroBatcher:
         for req in batch:
             rows.extend(req.rows)
         t0 = time.monotonic()
+        queue_waits = [(t0 - req.admitted_at) * 1e3 for req in batch]
         per_coord = any(r.per_coordinate for r in batch)
         try:
             result = self._score_with_watchdog(rows, per_coord)
@@ -265,12 +344,15 @@ class MicroBatcher:
                                        elapsed_ms)
         now = time.monotonic()
         start = 0
-        for req in batch:
+        for req, waited_ms in zip(batch, queue_waits):
             end = start + len(req.rows)
             sl = {k: v[start:end] for k, v in parts.items()}
             req.set_result((scores[start:end], sl)
                            if req.per_coordinate else scores[start:end])
             if self._metrics is not None:
+                # queue_wait: admission -> execution start; compute: the
+                # batch's scoring wall attributed to each of its requests
                 self._metrics.record_request(
-                    len(req.rows), (now - req.admitted_at) * 1e3)
+                    len(req.rows), (now - req.admitted_at) * 1e3,
+                    queue_wait_ms=waited_ms, compute_ms=elapsed_ms)
             start = end

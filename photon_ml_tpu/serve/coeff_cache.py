@@ -116,6 +116,34 @@ class ModelDirCoefficientStore:
                 return self._parse(rec)
         return None  # pragma: no cover - known_ids guarantees a record
 
+    def load_many(self, entity_ids: Sequence[str]
+                  ) -> Dict[str, Optional[CoeffEntry]]:
+        """Resolve a batch of ids in ONE streaming pass over the
+        coordinate's file — a cold fault of m entities costs O(file), not
+        O(m * file) as m single-entity :meth:`load` calls would (the
+        paged table's install path and the LRU's batched misses come
+        through here). Absent ids resolve to None without a file read."""
+        known = self.known_ids()
+        out: Dict[str, Optional[CoeffEntry]] = {}
+        wanted = set()
+        for eid in entity_ids:
+            key = str(eid)
+            if key in known:
+                wanted.add(key)
+            else:
+                out[key] = None
+        if wanted:
+            from photon_ml_tpu.io.avro import iter_avro_records
+
+            for rec in iter_avro_records([self._path()]):
+                key = str(rec["modelId"])
+                if key in wanted:
+                    out[key] = self._parse(rec)
+                    wanted.discard(key)
+                    if not wanted:
+                        break
+        return out
+
 
 class LayeredCoefficientStore:
     """Delta-chain resolution for per-entity coefficients: stores are
@@ -144,6 +172,29 @@ class LayeredCoefficientStore:
                 return s.load(key)
         return None
 
+    def load_many(self, entity_ids: Sequence[str]
+                  ) -> Dict[str, Optional[CoeffEntry]]:
+        """Batched delta-chain resolution: route each id to the FIRST
+        layer that knows it, then one :meth:`load_many` pass per layer
+        that owns any of the requested ids."""
+        out: Dict[str, Optional[CoeffEntry]] = {}
+        per_store: Dict[int, list] = {}
+        routed = set()
+        for eid in entity_ids:
+            key = str(eid)
+            if key in out or key in routed:
+                continue
+            for si, s in enumerate(self.stores):
+                if key in s.known_ids():
+                    per_store.setdefault(si, []).append(key)
+                    routed.add(key)
+                    break
+            else:
+                out[key] = None
+        for si, keys in per_store.items():
+            out.update(self.stores[si].load_many(keys))
+        return out
+
 
 class EntityCoefficientLRU:
     """Bounded LRU over :class:`CoeffEntry` payloads (negative entries
@@ -152,10 +203,11 @@ class EntityCoefficientLRU:
     tests pass fakes to pin eviction/counter behaviour."""
 
     def __init__(self, loader: Callable[[str], Optional[CoeffEntry]],
-                 capacity: int, metrics=None):
+                 capacity: int, metrics=None, batch_loader=None):
         if capacity < 1:
             raise ValueError(f"cache capacity must be >= 1, got {capacity}")
         self._loader = loader
+        self._batch_loader = batch_loader  # ids -> {id: entry|None}
         self.capacity = int(capacity)
         self._data: "OrderedDict[str, Optional[CoeffEntry]]" = OrderedDict()
         self._lock = threading.Lock()
@@ -224,13 +276,91 @@ class EntityCoefficientLRU:
                     self._metrics.record_coeff(evictions=evicted)
         return loaded
 
-    def get_many(self, entity_ids) -> Dict[str, Optional[CoeffEntry]]:
-        """Resolve a batch of ids (deduplicated; order-preserving dict)."""
+    def warm_entries(self, entity_ids) -> Dict[str, Optional[CoeffEntry]]:
+        """Prefetch + return: load ``entity_ids`` WITHOUT touching the
+        hit/miss counters (like :meth:`prefetch`) and hand the resolved
+        entries back — the hot-swap path uses this to seed BOTH the new
+        version's LRU and its paged device table from the previous hot
+        set in one store pass (evictions still count)."""
         out: Dict[str, Optional[CoeffEntry]] = {}
-        for eid in entity_ids:
-            key = str(eid)
-            if key not in out:
-                out[key] = self.get(key)
+        missing: list = []
+        with self._lock:
+            for eid in entity_ids:
+                key = str(eid)
+                if key in out or key in missing:
+                    continue
+                if key in self._data:
+                    out[key] = self._data[key]
+                else:
+                    missing.append(key)
+        if missing:
+            if self._batch_loader is not None:
+                loaded = self._batch_loader(missing)
+            else:
+                loaded = {key: self._loader(key) for key in missing}
+            evicted = 0
+            with self._lock:
+                for key in missing:
+                    entry = loaded.get(key)
+                    out[key] = entry
+                    self._data[key] = entry
+                    self._data.move_to_end(key)
+                while len(self._data) > self.capacity:
+                    self._data.popitem(last=False)
+                    evicted += 1
+                self.evictions += evicted
+            if self._metrics is not None and evicted:
+                self._metrics.record_coeff(evictions=evicted)
+        return out
+
+    def get_many(self, entity_ids) -> Dict[str, Optional[CoeffEntry]]:
+        """Resolve a batch of ids (deduplicated; order-preserving dict).
+        With a ``batch_loader``, all of the batch's cold misses load in
+        ONE store pass instead of one file scan per missing entity."""
+        out: Dict[str, Optional[CoeffEntry]] = {}
+        if self._batch_loader is None:
+            for eid in entity_ids:
+                key = str(eid)
+                if key not in out:
+                    out[key] = self.get(key)
+            return out
+        missing: list = []
+        missing_set = set()
+        hits = 0
+        with self._lock:
+            for eid in entity_ids:
+                key = str(eid)
+                if key in out or key in missing_set:
+                    continue
+                if key in self._data:
+                    self._data.move_to_end(key)
+                    hits += 1
+                    out[key] = self._data[key]
+                else:
+                    missing.append(key)
+                    missing_set.add(key)
+            self.hits += hits
+            self.misses += len(missing)
+        if self._metrics is not None and hits:
+            self._metrics.record_coeff(hits=hits)
+        if not missing:
+            return out
+        # load OUTSIDE the lock: a cold batch may stream the model file
+        loaded = self._batch_loader(missing)
+        evicted = 0
+        with self._lock:
+            for key in missing:
+                entry = loaded.get(key)
+                out[key] = entry
+                self._data[key] = entry
+                self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+                evicted += 1
+            self.evictions += evicted
+        if self._metrics is not None:
+            self._metrics.record_coeff(misses=len(missing),
+                                       evictions=evicted)
         return out
 
     @property

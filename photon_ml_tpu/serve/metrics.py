@@ -79,8 +79,17 @@ class ServingMetrics:
 
     Exported series (``photon_serve_`` prefix):
       requests_total / rows_total / shed_total / errors_total — counters;
+      shed_queue_full_total / shed_deadline_total — the load-shedding
+        split by cause: admission-queue-at-capacity rejections vs
+        requests whose deadline expired while still queued (shed_total
+        stays the sum, for dashboards that predate the split);
       request_latency_ms / batch_latency_ms — histograms (request latency
         is admission -> response; batch latency is one scoring execution);
+      queue_wait_ms / compute_ms — the request-latency split: time a
+        request sat in the admission queue waiting for a batch slot vs
+        the scoring execution's wall time attributed to the request, so
+        the bench's stall accounting and /metrics agree on where time
+        goes (queue_wait + compute ~= request_latency per request);
       queue_depth — gauge, current admission-queue occupancy;
       batch_fill_ratio — gauge, rolling mean of rows/max_batch per batch;
       compile_cache_{hits,misses}_total, coeff_cache_{hits,misses,
@@ -99,6 +108,8 @@ class ServingMetrics:
         self.requests_total = 0
         self.rows_total = 0
         self.shed_total = 0
+        self.shed_queue_full_total = 0
+        self.shed_deadline_total = 0
         self.errors_total = 0
         self.batches_total = 0
         self.batch_rows_sum = 0
@@ -106,6 +117,8 @@ class ServingMetrics:
         self.queue_depth = 0
         self.request_latency_ms = Histogram()
         self.batch_latency_ms = Histogram()
+        self.queue_wait_ms = Histogram()
+        self.compute_ms = Histogram()
         # cache counters are owned here but incremented through the cache
         # objects' stat hooks so the caches stay usable standalone
         self.compile_cache_hits = 0
@@ -113,6 +126,10 @@ class ServingMetrics:
         self.coeff_cache_hits = 0
         self.coeff_cache_misses = 0
         self.coeff_cache_evictions = 0
+        # device-resident paged coefficient table (serve/paged_table.py)
+        self.paged_installs = 0
+        self.paged_page_evictions = 0
+        self.paged_faults = 0
         # model lifecycle (registry/ + ScoringSession.swap)
         self.swaps_total = 0
         self.swap_latency_ms = Histogram()
@@ -121,15 +138,25 @@ class ServingMetrics:
         self.gate_fail_total = 0
 
     # -- recording sites ---------------------------------------------------
-    def record_request(self, rows: int, latency_ms: float) -> None:
+    def record_request(self, rows: int, latency_ms: float,
+                       queue_wait_ms: Optional[float] = None,
+                       compute_ms: Optional[float] = None) -> None:
         with self._lock:
             self.requests_total += 1
             self.rows_total += rows
             self.request_latency_ms.observe(latency_ms)
+            if queue_wait_ms is not None:
+                self.queue_wait_ms.observe(queue_wait_ms)
+            if compute_ms is not None:
+                self.compute_ms.observe(compute_ms)
 
-    def record_shed(self) -> None:
+    def record_shed(self, cause: str = "queue_full") -> None:
         with self._lock:
             self.shed_total += 1
+            if cause == "deadline":
+                self.shed_deadline_total += 1
+            else:
+                self.shed_queue_full_total += 1
 
     def record_error(self) -> None:
         with self._lock:
@@ -161,6 +188,13 @@ class ServingMetrics:
             self.coeff_cache_misses += misses
             self.coeff_cache_evictions += evictions
 
+    def record_paged(self, installs: int = 0, page_evictions: int = 0,
+                     faults: int = 0) -> None:
+        with self._lock:
+            self.paged_installs += installs
+            self.paged_page_evictions += page_evictions
+            self.paged_faults += faults
+
     def set_active_version(self, version: str) -> None:
         with self._lock:
             self.active_version = str(version)
@@ -191,6 +225,8 @@ class ServingMetrics:
                 "requests_total": self.requests_total,
                 "rows_total": self.rows_total,
                 "shed_total": self.shed_total,
+                "shed_queue_full_total": self.shed_queue_full_total,
+                "shed_deadline_total": self.shed_deadline_total,
                 "errors_total": self.errors_total,
                 "batches_total": self.batches_total,
                 "queue_depth": self.queue_depth,
@@ -200,6 +236,10 @@ class ServingMetrics:
                     self.request_latency_ms.quantile(0.5),
                 "request_latency_p99_ms":
                     self.request_latency_ms.quantile(0.99),
+                "queue_wait_p50_ms": self.queue_wait_ms.quantile(0.5),
+                "queue_wait_p99_ms": self.queue_wait_ms.quantile(0.99),
+                "compute_p50_ms": self.compute_ms.quantile(0.5),
+                "compute_p99_ms": self.compute_ms.quantile(0.99),
                 "compile_cache_hits": self.compile_cache_hits,
                 "compile_cache_misses": self.compile_cache_misses,
                 "compile_cache_hit_rate": self._rate(
@@ -207,6 +247,9 @@ class ServingMetrics:
                 "coeff_cache_hits": self.coeff_cache_hits,
                 "coeff_cache_misses": self.coeff_cache_misses,
                 "coeff_cache_evictions": self.coeff_cache_evictions,
+                "paged_installs": self.paged_installs,
+                "paged_page_evictions": self.paged_page_evictions,
+                "paged_faults": self.paged_faults,
                 "coeff_cache_hit_rate": self._rate(
                     self.coeff_cache_hits, self.coeff_cache_misses),
                 "swaps_total": self.swaps_total,
@@ -232,6 +275,10 @@ class ServingMetrics:
             counter("photon_serve_requests_total", self.requests_total)
             counter("photon_serve_rows_total", self.rows_total)
             counter("photon_serve_shed_total", self.shed_total)
+            counter("photon_serve_shed_queue_full_total",
+                    self.shed_queue_full_total)
+            counter("photon_serve_shed_deadline_total",
+                    self.shed_deadline_total)
             counter("photon_serve_errors_total", self.errors_total)
             counter("photon_serve_batches_total", self.batches_total)
             gauge("photon_serve_queue_depth", self.queue_depth)
@@ -241,6 +288,8 @@ class ServingMetrics:
                 "photon_serve_request_latency_ms", out)
             self.batch_latency_ms.render(
                 "photon_serve_batch_latency_ms", out)
+            self.queue_wait_ms.render("photon_serve_queue_wait_ms", out)
+            self.compute_ms.render("photon_serve_compute_ms", out)
             counter("photon_serve_compile_cache_hits_total",
                     self.compile_cache_hits)
             counter("photon_serve_compile_cache_misses_total",
@@ -253,6 +302,11 @@ class ServingMetrics:
                     self.coeff_cache_misses)
             counter("photon_serve_coeff_cache_evictions_total",
                     self.coeff_cache_evictions)
+            counter("photon_serve_paged_installs_total",
+                    self.paged_installs)
+            counter("photon_serve_paged_page_evictions_total",
+                    self.paged_page_evictions)
+            counter("photon_serve_paged_faults_total", self.paged_faults)
             gauge("photon_serve_coeff_cache_hit_rate", self._rate(
                 self.coeff_cache_hits, self.coeff_cache_misses))
             counter("photon_serve_swaps_total", self.swaps_total)

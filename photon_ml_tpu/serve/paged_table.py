@@ -1,0 +1,255 @@
+"""Device-resident paged table of per-entity random-effect coefficients.
+
+The host-side :class:`~photon_ml_tpu.serve.coeff_cache
+.EntityCoefficientLRU` keeps the hot working set in HOST memory, which
+forces every batch through a host gather (rebuild score buckets, pack a
+coefficient matrix, upload it) — the per-batch round-trip that capped
+BENCH_serving.json at ~6k rows/s. This module is the device-side tier of
+that hierarchy (Snap ML's "keep the working set resident next to the
+compute", arXiv:1803.06333): the hot entities' coefficients live in a
+padded ``(pages, page_rows, k_pad)`` buffer ON DEVICE, densified into the
+shard's global feature space, and a warm batch's random-effect margins
+are one :func:`~photon_ml_tpu.ops.pallas_kernels.paged_gather_score`
+call inside the session's fused executable — no host gather, no upload.
+
+Design points:
+
+* **Pages are the unit of transfer and eviction.** Installs write a host
+  mirror then refresh only the touched pages through a jitted
+  ``dynamic_update_slice`` whose page index is a TRACED argument — one
+  executable per table shape, shared process-wide, never a recompile as
+  pages churn. Eviction drops the least-recently-SCORED full page (all
+  of its entities leave the slot map at once); per-entity LRU bookkeeping
+  on the device tier would cost more host work than it saves.
+* **Functional updates keep in-flight batches consistent.** A scoring
+  call snapshots ``device_buffer`` + its slots under the table lock; an
+  install builds a NEW device array (jax functional update), so the
+  snapshot stays valid however the install/evict races the batch.
+* **Negative entries are host-side only.** Entities the store does not
+  know get a ``slot -1`` sentinel (scores 0 in the gather, matching the
+  fixed-effect-only fallback) and are remembered in an absent set so a
+  scan of unknown ids cannot trigger repeated store faults — they never
+  occupy device rows.
+* **Dense rows bound the shard size.** A row is the entity's coefficient
+  vector scattered into ``k_pad`` dense global dims; coordinates whose
+  feature space exceeds ``dense_dim_max`` (or that use a sketch
+  projection, whose "local map" is a hash, not a dict) stay on the
+  host-LRU path — the session gates eligibility per coordinate.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.serve.coeff_cache import CoeffEntry
+from photon_ml_tpu.utils import transfer_budget
+
+__all__ = ["PagedCoefficientTable", "entry_supported"]
+
+
+def entry_supported(entry: Optional[CoeffEntry]) -> bool:
+    """Only plain global-id->slot dict local maps densify into a page
+    row; sketch-projected entries (shared hash map) do not."""
+    return entry is None or isinstance(entry.local_map, dict)
+
+
+@functools.lru_cache(maxsize=None)
+def _page_setter(page_rows: int, dim: int, dtype_name: str):
+    """The (page_rows, dim, dtype)-shaped page refresh executable. The
+    page index is a traced scalar, so every page of every same-shaped
+    table shares ONE compile (cached per shape process-wide)."""
+    import jax
+
+    @jax.jit
+    def set_page(buf, page, rows):
+        start = page * page_rows
+        return jax.lax.dynamic_update_slice(buf, rows, (start, 0))
+
+    return set_page
+
+
+class PagedCoefficientTable:
+    """Paged device residency for one random-effect coordinate.
+
+    ``dim`` — dense width of a row (the shard's index-map size);
+    ``pages`` x ``page_rows`` bound the device working set. ``loader``
+    is unused here by design: the table only stores what the session
+    installs (the session faults cold entities through the LRU so cache
+    hit/miss accounting stays in one place).
+    """
+
+    def __init__(self, dim: int, *, pages: int = 4, page_rows: int = 256,
+                 dtype=np.float32, name: str = "", metrics=None):
+        if dim < 1:
+            raise ValueError(f"dense dim must be >= 1, got {dim}")
+        if pages < 1 or page_rows < 1:
+            raise ValueError(
+                f"need pages >= 1 and page_rows >= 1, got "
+                f"{pages}x{page_rows}")
+        self.dim = int(dim)
+        self.pages = int(pages)
+        self.page_rows = int(page_rows)
+        self.name = name
+        self.dtype = np.dtype(dtype)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._host = np.zeros((self.pages * self.page_rows, self.dim),
+                              self.dtype)
+        self._device = transfer_budget.device_put(
+            self._host, what=f"serve.paged_table[{name}]")
+        self._slots: Dict[str, int] = {}
+        self._absent: set = set()
+        self._page_ids: List[List[str]] = [[] for _ in range(self.pages)]
+        self._fill = [0] * self.pages
+        self._clock = 0
+        self._page_last = [0] * self.pages
+        self._setter = _page_setter(self.page_rows, self.dim,
+                                    self.dtype.name)
+        # counters (exposed through session stats + /metrics)
+        self.installs = 0
+        self.page_evictions = 0
+        self.absent_marks = 0
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    @property
+    def capacity(self) -> int:
+        return self.pages * self.page_rows
+
+    @property
+    def device_buffer(self):
+        return self._device
+
+    def resident_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._slots)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "resident": len(self._slots),
+                "capacity": self.capacity,
+                "installs": self.installs,
+                "page_evictions": self.page_evictions,
+                "absent": len(self._absent),
+            }
+
+    # -- lookup ------------------------------------------------------------
+    def lookup(self, entity_ids: Sequence[str]
+               ) -> Tuple[object, np.ndarray, List[str]]:
+        """One consistent read for a batch: ``(device_buffer, slots,
+        missing)``. ``slots`` is int32 per id (-1 for absent/unknown);
+        ``missing`` lists the deduplicated ids that are neither resident
+        nor known-absent — the caller faults those through the LRU and
+        (asynchronously) installs them. Touches the hit pages' LRU
+        clocks."""
+        slots = np.empty(len(entity_ids), np.int32)
+        missing: List[str] = []
+        seen_missing: set = set()
+        with self._lock:
+            self._clock += 1
+            clock = self._clock
+            get = self._slots.get
+            for i, eid in enumerate(entity_ids):
+                s = get(eid)
+                if s is None:
+                    slots[i] = -1
+                    if eid not in self._absent and eid not in seen_missing:
+                        missing.append(eid)
+                        seen_missing.add(eid)
+                else:
+                    slots[i] = s
+                    self._page_last[s // self.page_rows] = clock
+            return self._device, slots, missing
+
+    def warm_device_path(self) -> None:
+        """Trigger the page-refresh executable's compile during warmup
+        (the refreshed buffer is identical — page 0 rewritten with its
+        own contents — so this is shape-warming, not a data change)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            self._device = self._setter(
+                self._device, 0,
+                jnp.asarray(self._host[:self.page_rows]))
+
+    # -- install / evict ---------------------------------------------------
+    def dense_row(self, entry: CoeffEntry) -> np.ndarray:
+        row = np.zeros(self.dim, self.dtype)
+        coeffs = entry.coefficients
+        for g, s in entry.local_map.items():
+            if 0 <= g < self.dim and s < coeffs.shape[0]:
+                row[g] = coeffs[s]
+        return row
+
+    def _allocate(self) -> int:
+        """A free flat slot, evicting the least-recently-scored full
+        page when the table is at capacity (caller holds the lock)."""
+        for p in range(self.pages):
+            if self._fill[p] < self.page_rows:
+                return p * self.page_rows + self._fill[p]
+        victim = min(range(self.pages), key=self._page_last.__getitem__)
+        for eid in self._page_ids[victim]:
+            self._slots.pop(eid, None)
+        self._page_ids[victim] = []
+        self._fill[victim] = 0
+        self._host[victim * self.page_rows:
+                   (victim + 1) * self.page_rows] = 0
+        self.page_evictions += 1
+        if self._metrics is not None:
+            self._metrics.record_paged(page_evictions=1)
+        return victim * self.page_rows
+
+    def install(self, entries: Dict[str, Optional[CoeffEntry]]) -> int:
+        """Install a fault's resolutions: positive entries get page rows
+        (allocating/evicting as needed) and the touched pages are
+        refreshed on device; ``None`` resolutions join the absent set.
+        Returns the number of rows written. Safe to call from the
+        session's background installer while batches score."""
+        touched: set = set()
+        installed = 0
+        with self._lock:
+            for eid, entry in entries.items():
+                if entry is None:
+                    if eid not in self._absent:
+                        self._absent.add(eid)
+                        self.absent_marks += 1
+                    continue
+                if not entry_supported(entry):
+                    raise ValueError(
+                        f"paged table {self.name!r} cannot hold sketch-"
+                        "projected entries; gate the coordinate off the "
+                        "paged path")
+                slot = self._slots.get(eid)
+                if slot is None:
+                    slot = self._allocate()
+                    page = slot // self.page_rows
+                    self._slots[eid] = slot
+                    self._page_ids[page].append(eid)
+                    self._fill[page] = max(self._fill[page],
+                                           slot % self.page_rows + 1)
+                self._host[slot] = self.dense_row(entry)
+                touched.add(slot // self.page_rows)
+                installed += 1
+            if installed:
+                self.installs += installed
+                # page-wise functional refresh: new buffer per install
+                # burst, old snapshots stay valid for in-flight batches
+                buf = self._device
+                for page in sorted(touched):
+                    rows = transfer_budget.device_put(
+                        self._host[page * self.page_rows:
+                                   (page + 1) * self.page_rows],
+                        what=f"serve.paged_install[{self.name}]")
+                    buf = self._setter(buf, page, rows)
+                self._device = buf
+        if installed and self._metrics is not None:
+            self._metrics.record_paged(installs=installed)
+        return installed

@@ -63,33 +63,40 @@ class ScoringService:
         self._reload_lock = threading.Lock()
 
     # -- endpoints ---------------------------------------------------------
-    def handle_score(self, payload) -> Tuple[int, dict]:
-        """``{"rows": [...], "perCoordinate": bool}`` -> scores. Each row
-        as ``ScoringSession.score_rows`` documents (features /
-        entityIds / offset, plus an optional echoed ``uid``)."""
+    @staticmethod
+    def validate_score_payload(payload):
+        """``(rows, per_coordinate) | None, error_body | None`` — the
+        parse/validate half of ``/score``, shared by the sync handler
+        and the asyncio front end (which must not block the event loop
+        on the scoring half)."""
         if not isinstance(payload, dict) or not isinstance(
                 payload.get("rows"), list):
-            return 400, {"error": "payload must be "
-                                  '{"rows": [...], "perCoordinate"?: bool}'}
+            return None, {"error": "payload must be "
+                                   '{"rows": [...], "perCoordinate"?: '
+                                   'bool}'}
         rows = payload["rows"]
         if not rows:
-            return 400, {"error": "empty rows"}
+            return None, {"error": "empty rows"}
         if not all(isinstance(r, dict) for r in rows):
-            return 400, {"error": "every row must be an object"}
-        per_coord = bool(payload.get("perCoordinate"))
-        try:
-            result = self.batcher.score(rows, per_coord,
-                                        timeout=self.request_timeout_s)
-        except QueueFullError as e:
-            return 429, {"error": str(e), "shed": True}
-        except ValueError as e:
+            return None, {"error": "every row must be an object"}
+        return (rows, bool(payload.get("perCoordinate"))), None
+
+    @staticmethod
+    def score_error_response(e: BaseException) -> Tuple[int, dict]:
+        """Map a scoring-path exception onto the status contract — ONE
+        definition for the threaded and asyncio transports."""
+        if isinstance(e, QueueFullError):
+            return 429, {"error": str(e), "shed": True, "cause": e.cause,
+                         "retryAfterS": round(e.retry_after_s, 3)}
+        if isinstance(e, ValueError):
             return 400, {"error": str(e)}
-        except BatchWatchdogTimeout as e:
+        if isinstance(e, (BatchWatchdogTimeout, TimeoutError)):
             return 504, {"error": str(e)}
-        except TimeoutError as e:
-            return 504, {"error": str(e)}
-        except Exception as e:
-            return 503, {"error": f"scoring failed: {e}"}
+        return 503, {"error": f"scoring failed: {e}"}
+
+    @staticmethod
+    def score_body(rows, per_coord: bool, result) -> dict:
+        """Shape a resolved batcher result into the response body."""
         if per_coord:
             scores, parts = result
         else:
@@ -101,7 +108,22 @@ class ScoringService:
         if per_coord:
             body["scoreComponents"] = {
                 k: [float(x) for x in v] for k, v in parts.items()}
-        return 200, body
+        return body
+
+    def handle_score(self, payload) -> Tuple[int, dict]:
+        """``{"rows": [...], "perCoordinate": bool}`` -> scores. Each row
+        as ``ScoringSession.score_rows`` documents (features /
+        entityIds / offset, plus an optional echoed ``uid``)."""
+        valid, err = self.validate_score_payload(payload)
+        if valid is None:
+            return 400, err
+        rows, per_coord = valid
+        try:
+            result = self.batcher.score(rows, per_coord,
+                                        timeout=self.request_timeout_s)
+        except Exception as e:
+            return self.score_error_response(e)
+        return 200, self.score_body(rows, per_coord, result)
 
     def handle_healthz(self) -> Tuple[int, dict]:
         return 200, {
@@ -166,6 +188,8 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _reply(self, status: int, body, content_type="application/json"):
+        retry_after = (body.get("retryAfterS")
+                       if status == 429 and isinstance(body, dict) else None)
         data = (body if isinstance(body, (bytes, str))
                 else json.dumps(body))
         if isinstance(data, str):
@@ -173,6 +197,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        if retry_after is not None:
+            # ceil to whole seconds: Retry-After is integral per RFC 9110
+            self.send_header("Retry-After",
+                             str(max(1, int(-(-float(retry_after) // 1)))))
         self.end_headers()
         self.wfile.write(data)
 

@@ -24,7 +24,23 @@ scoring batches for as long as the process lives:
   :meth:`swap` recompile-free: a new version with the same feature dims
   re-donates fresh device coefficients to the existing executables.
 
-* **Random effects through the entity LRU.** Per-entity coefficients are
+* **Random effects through a device-resident paged table** (default) or
+  the host entity LRU. The hot slice of per-entity coefficients lives in
+  a :class:`~photon_ml_tpu.serve.paged_table.PagedCoefficientTable` on
+  device, and a batch whose entities are warm scores in ONE fused
+  executable call — fixed margins + a
+  :func:`~photon_ml_tpu.ops.pallas_kernels.paged_gather_score` per
+  random coordinate + offsets, no host gather, no per-batch coefficient
+  upload. Cold entities fault through the
+  :class:`~photon_ml_tpu.serve.coeff_cache.EntityCoefficientLRU` (one
+  batched store pass) and are installed into pages before the batch's
+  device call — the disk read dominates the fault, and one margin path
+  keeps scores bitwise-stable across swaps; a background installer
+  rebuilds pages asynchronously after a hot swap so the swap's critical
+  path stays flat. Coordinates the table cannot hold (sketch
+  projections, feature
+  spaces wider than ``re_dense_dim_max``) keep the PR-2 LRU path:
+  per-entity coefficients are
   fetched from :class:`~photon_ml_tpu.serve.coeff_cache
   .EntityCoefficientLRU`; a batch's score views are assembled with the
   SAME ``build_score_buckets`` / ``score_random_effect`` machinery the
@@ -50,6 +66,7 @@ scoring batches for as long as the process lives:
 from __future__ import annotations
 
 import os
+import queue as _queue
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -74,10 +91,15 @@ from photon_ml_tpu.serve.coeff_cache import (
     ModelDirCoefficientStore,
 )
 from photon_ml_tpu.serve.metrics import ServingMetrics
+from photon_ml_tpu.serve.paged_table import PagedCoefficientTable
 from photon_ml_tpu.types import SparseFeatures, margins as _margins
 from photon_ml_tpu.utils import resolve_dtype, transfer_budget
 
 __all__ = ["ScoringSession", "bucket_ladder", "bucketize"]
+
+# per-row sentinel for "no entity id for this effect" — never a real id,
+# never faulted against the store
+_NO_ENTITY = "\x00<no-entity>"
 
 
 def bucket_ladder(top: int, start: int = 1) -> List[int]:
@@ -107,13 +129,17 @@ def bucketize(n: int, ladder: Sequence[int]) -> int:
 
 class _ModelState:
     """Everything that changes when the served model changes — installed
-    and read as one reference, never mutated after construction."""
+    and read as one reference, never mutated after construction (the
+    paged tables' interiors mutate behind their own locks; the REFERENCES
+    here do not, so a swap rebuilds pages by building new tables)."""
 
     __slots__ = ("chain", "version", "task", "index_maps", "k_pad",
-                 "model", "coeff_caches", "resident")
+                 "model", "coeff_caches", "resident", "router",
+                 "shard_order", "intercepts", "paged", "plan", "fused_sig")
 
     def __init__(self, chain, version, task, index_maps, k_pad, model,
-                 coeff_caches, resident):
+                 coeff_caches, resident, router=None, shard_order=(),
+                 intercepts=(), paged=None, plan=(), fused_sig=None):
         self.chain = chain
         self.version = version
         self.task = task
@@ -122,6 +148,13 @@ class _ModelState:
         self.model = model
         self.coeff_caches = coeff_caches
         self.resident = resident
+        # -- fused-path plumbing (None fused_sig = fused path disabled)
+        self.router = router          # feature key -> ((shard_pos, idx),..)
+        self.shard_order = shard_order
+        self.intercepts = intercepts  # per shard_order: intercept idx or -1
+        self.paged = paged or {}      # RE name -> PagedCoefficientTable
+        self.plan = plan              # ordered (kind, name, shard_pos)
+        self.fused_sig = fused_sig    # executable key component
 
 
 def _layer_with(chain: Sequence[str], rel: str) -> Optional[str]:
@@ -155,6 +188,16 @@ class ScoringSession:
         features than this takes the uncompiled eager path (counted in
         ``fixed_eager_batches``) instead of minting a new executable.
       coeff_cache_entries: LRU capacity per random-effect coordinate.
+      paged_table: keep the hot entity coefficients device-resident in a
+        paged table and score warm batches through the fused one-call
+        executable (False restores the PR-2 host-LRU hot path; sketched
+        or too-wide coordinates fall back per coordinate regardless).
+      re_pages / re_page_rows: paged-table geometry per random
+        coordinate — ``re_pages * re_page_rows`` resident entities, one
+        page is the unit of install/evict transfer.
+      re_dense_dim_max: widest random-effect feature space the paged
+        table will densify; beyond it the coordinate stays on the LRU
+        path (a dense row per entity would waste device memory).
       warmup: pre-compile the full ladder at construction (recommended;
         tests that exercise lazy compilation pass False).
     """
@@ -162,6 +205,8 @@ class ScoringSession:
     def __init__(self, model_dir, *, dtype="float32",
                  max_batch: int = 64, pad_nnz: int = 64,
                  coeff_cache_entries: int = 4096,
+                 paged_table: bool = True, re_pages: int = 4,
+                 re_page_rows: int = 256, re_dense_dim_max: int = 4096,
                  metrics: Optional[ServingMetrics] = None,
                  warmup: bool = True):
         self.dtype = resolve_dtype(dtype) if isinstance(dtype, str) else dtype
@@ -169,8 +214,22 @@ class ScoringSession:
         self.metrics = metrics or ServingMetrics()
         self.row_ladder = bucket_ladder(self.max_batch)
         self.fixed_eager_batches = 0
+        self.fused_fallback_batches = 0
         self._pad_nnz = int(pad_nnz)
         self._coeff_cache_entries = int(coeff_cache_entries)
+        self._paged_enabled = bool(paged_table)
+        self._re_pages = int(re_pages)
+        self._re_page_rows = int(re_page_rows)
+        self._re_dense_dim_max = int(re_dense_dim_max)
+
+        # -- background page installer: cold faults resolve host-side in
+        # the faulting batch, residency arrives asynchronously ----------
+        self._install_q: "_queue.Queue" = _queue.Queue(maxsize=256)
+        self._install_drops = 0
+        self._installer = threading.Thread(
+            target=self._install_worker, daemon=True,
+            name="photon-serve-page-install")
+        self._installer.start()
 
         # -- shape-bucketed compile cache: survives swaps by design ----
         self._compiled: Dict[tuple, object] = {}
@@ -200,6 +259,7 @@ class ScoringSession:
         k_pad: Dict[str, int] = {}
         coords: Dict[str, object] = {}
         coeff_caches: Dict[str, EntityCoefficientLRU] = {}
+        re_sketched: Dict[str, bool] = {}
         for c in meta["coordinates"]:
             shard = c["feature_shard"]
             if shard not in index_maps:
@@ -243,7 +303,10 @@ class ScoringSession:
                          else LayeredCoefficientStore(stores))
                 coeff_caches[c["name"]] = EntityCoefficientLRU(
                     store.load, self._coeff_cache_entries,
-                    metrics=self.metrics)
+                    metrics=self.metrics, batch_loader=store.load_many)
+                proj = c.get("projection")
+                re_sketched[c["name"]] = bool(
+                    proj and proj.get("type") == "random")
         model = GameModel(coords, task)
 
         # -- device residency: one budget-accounted upload per fixed
@@ -255,8 +318,57 @@ class ScoringSession:
                                np.dtype(self.dtype))
                 resident[name] = transfer_budget.device_put(
                     w, what=f"serve.fixed[{name}]")
+
+        # -- one-pass feature router: feature key -> every (shard, index)
+        # it resolves to, so a batch's features are resolved for ALL
+        # shards in a single iteration instead of one pass per shard
+        shard_order = tuple(index_maps)
+        shard_pos = {s: i for i, s in enumerate(shard_order)}
+        router: Dict[str, tuple] = {}
+        for s, imap in index_maps.items():
+            si = shard_pos[s]
+            for key, idx in imap.forward.items():
+                router[key] = router.get(key, ()) + ((si, idx),)
+        intercepts = tuple(index_maps[s].intercept_index
+                           for s in shard_order)
+
+        # -- paged device residency + the fused one-call scoring plan:
+        # eligible when EVERY random coordinate can live in a paged
+        # table (dict local maps, bounded dense width)
+        paged: Dict[str, PagedCoefficientTable] = {}
+        plan: List[tuple] = []
+        fused_ok = self._paged_enabled
+        for name, coord in model.coordinates.items():
+            si = shard_pos[coord.feature_shard]
+            if isinstance(coord, FixedEffectModel):
+                plan.append(("fixed", name, si))
+                continue
+            plan.append(("random", name, si))
+            dim = index_maps[coord.feature_shard].size
+            if (not self._paged_enabled or re_sketched.get(name)
+                    or dim > self._re_dense_dim_max):
+                fused_ok = False
+                continue
+            paged[name] = PagedCoefficientTable(
+                dim, pages=self._re_pages, page_rows=self._re_page_rows,
+                dtype=np.dtype(self.dtype), name=name,
+                metrics=self.metrics)
+        fused_sig = None
+        if fused_ok:
+            # same signature <=> same executables: a hot swap between
+            # same-shaped models reuses the whole fused ladder
+            fused_sig = (
+                tuple(plan),
+                tuple((s, index_maps[s].size, k_pad[s])
+                      for s in shard_order),
+                tuple((n, paged[n].capacity, paged[n].dim)
+                      for _, n, _ in plan if n in paged),
+            )
         return _ModelState(chain, str(version), task, index_maps, k_pad,
-                           model, coeff_caches, resident)
+                           model, coeff_caches, resident, router=router,
+                           shard_order=shard_order, intercepts=intercepts,
+                           paged=paged, plan=tuple(plan),
+                           fused_sig=fused_sig)
 
     # -- compatibility views over the active state ------------------------
     @property
@@ -307,8 +419,22 @@ class ScoringSession:
         if warm_from_previous:
             for name, cache in new.coeff_caches.items():
                 old = self._state.coeff_caches.get(name)
-                if old is not None:
-                    cache.prefetch(old.cached_ids())
+                old_paged = self._state.paged.get(name)
+                hot = list(old.cached_ids()) if old is not None else []
+                if old_paged is not None:
+                    seen = set(hot)
+                    hot += [e for e in old_paged.resident_ids()
+                            if e not in seen]
+                if not hot:
+                    continue
+                table = new.paged.get(name)
+                if table is None:
+                    cache.prefetch(hot)
+                else:
+                    # rebuild pages off the swap's critical path: the
+                    # LRU warms synchronously (one store pass), device
+                    # page installs ride the background installer
+                    self._install_async(table, cache.warm_entries(hot))
         with self._swap_lock:
             self._prev_state, self._state = self._state, new
         self.metrics.record_swap(new.version,
@@ -327,6 +453,41 @@ class ScoringSession:
             version = self._state.version
         self.metrics.record_swap(version, (time.perf_counter() - t0) * 1e3)
         return version
+
+    # -- background page installer -----------------------------------------
+    def _install_worker(self) -> None:
+        while True:
+            table, entries = self._install_q.get()
+            try:
+                table.install(entries)
+            except Exception:  # a bad install must not kill the worker
+                pass
+            finally:
+                self._install_q.task_done()
+
+    def _install_async(self, table: PagedCoefficientTable,
+                       entries: Dict[str, object]) -> None:
+        """Queue a page install; under install-queue pressure the
+        entries are DROPPED (the batch already scored correctly through
+        the host fault path — residency is an optimization, and blocking
+        the scoring thread on it would recreate the upload round-trip
+        this table removes)."""
+        if not entries:
+            return
+        try:
+            self._install_q.put_nowait((table, entries))
+        except _queue.Full:
+            self._install_drops += 1
+
+    def drain_installs(self, timeout_s: float = 10.0) -> bool:
+        """Block until queued page installs have been applied (tests and
+        the bench use this to make residency deterministic)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._install_q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.002)
+        return False
 
     # -- compile cache -----------------------------------------------------
     @property
@@ -363,19 +524,82 @@ class ScoringSession:
             self._compiled[key] = run
             return run
 
+    def _fused_executable(self, B: int, st: _ModelState):
+        """The whole-batch one-call executable for row bucket ``B``:
+        offsets + every fixed coordinate's margins + every random
+        coordinate's paged gather, in one jit dispatch. Keyed by the
+        state's ``fused_sig`` (coordinate plan + shard dims + table
+        shapes) — NOT by version, so a hot swap between same-shaped
+        models reuses the compiled ladder."""
+        import jax
+
+        from photon_ml_tpu.ops.pallas_kernels import paged_gather_score
+
+        key = ("fused", B, st.fused_sig)
+        with self._compile_lock:
+            fn = self._compiled.get(key)
+            if fn is not None:
+                self.metrics.record_compile(hit=True)
+                return fn
+            self.metrics.record_compile(hit=False)
+            plan = st.plan
+            dims = tuple(st.index_maps[s].size for s in st.shard_order)
+
+            @jax.jit
+            def run(offsets, shard_idx, shard_val, fixed_w, re_buf,
+                    re_slots):
+                total = offsets
+                parts = []
+                fi = ri = 0
+                for kind, _name, si in plan:
+                    if kind == "fixed":
+                        feats = SparseFeatures(shard_idx[si],
+                                               shard_val[si], dim=dims[si])
+                        m = _margins(feats, fixed_w[fi])
+                        fi += 1
+                    else:
+                        m = paged_gather_score(re_buf[ri], re_slots[ri],
+                                               shard_idx[si], shard_val[si])
+                        ri += 1
+                    parts.append(m)
+                    total = total + m
+                return total, tuple(parts)
+
+            dt = np.dtype(self.dtype)
+            z_idx = tuple(jnp.zeros((B, st.k_pad[s]), jnp.int32)
+                          for s in st.shard_order)
+            z_val = tuple(jnp.zeros((B, st.k_pad[s]), dt)
+                          for s in st.shard_order)
+            z_w = tuple(st.resident[name]
+                        for kind, name, _ in plan if kind == "fixed")
+            z_buf = tuple(st.paged[name].device_buffer
+                          for kind, name, _ in plan if kind == "random")
+            z_slots = tuple(jnp.full((B,), -1, jnp.int32) for _ in z_buf)
+            run(jnp.zeros((B,), dt), z_idx, z_val, z_w, z_buf, z_slots)
+            self._compiled[key] = run
+            return run
+
     def warmup(self) -> int:
-        """Pre-compile every (fixed coordinate, row-bucket) executable so
-        steady-state traffic inside the ladder never waits on XLA.
-        Returns the number of executables compiled."""
+        """Pre-compile the executables the configured hot path uses for
+        every row bucket so steady-state traffic inside the ladder never
+        waits on XLA — the fused one-call ladder when the paged path is
+        live, the per-fixed-coordinate ladder otherwise. Returns the
+        number of executables compiled."""
         st = self._state
         before = self.metrics.compile_cache_misses
-        for name, coord in st.model.coordinates.items():
-            if not isinstance(coord, FixedEffectModel):
-                continue
-            k = st.k_pad[coord.feature_shard]
-            dim = int(np.shape(st.resident[name])[0])
+        if st.fused_sig is not None:
             for B in self.row_ladder:
-                self._executable(dim, B, k)
+                self._fused_executable(B, st)
+            for table in st.paged.values():
+                table.warm_device_path()  # page-refresh executable
+        else:
+            for name, coord in st.model.coordinates.items():
+                if not isinstance(coord, FixedEffectModel):
+                    continue
+                k = st.k_pad[coord.feature_shard]
+                dim = int(np.shape(st.resident[name])[0])
+                for B in self.row_ladder:
+                    self._executable(dim, B, k)
         return self.metrics.compile_cache_misses - before
 
     # -- scoring -----------------------------------------------------------
@@ -453,7 +677,12 @@ class ScoringSession:
         ``entityIds`` — entity-column -> id for the random effects;
         ``offset`` — optional margin offset. Returns ``np.ndarray [n]``
         scores (plus a per-coordinate dict when requested), in row order.
-        """
+
+        Warm batches take the fused paged path (one device call); a
+        batch with rows wider than a shard's compiled pad width — or a
+        model the paged table cannot hold — takes the PR-2 per-coordinate
+        path. Both produce identical scores (the paged-parity tests pin
+        <= 1e-9 in f64)."""
         st = self._state  # one consistent snapshot across the batch
         n = len(rows)
         if n == 0:
@@ -463,11 +692,16 @@ class ScoringSession:
                 f"batch of {n} rows exceeds max_batch={self.max_batch}; "
                 "split it (the micro-batcher never sends oversized "
                 "batches)")
-        host = {shard: self._resolve_features(rows, shard, st)
-                for shard in st.index_maps}
+        host = self._resolve_all(rows, st)
         offsets = np.asarray(
             [float(r.get("offset") or 0.0) for r in rows],
             np.dtype(self.dtype))
+        if st.fused_sig is not None:
+            if all(host[s].indices.shape[1] <= st.k_pad[s]
+                   for s in st.shard_order):
+                return self._score_fused(rows, host, offsets, n, st,
+                                         per_coordinate)
+            self.fused_fallback_batches += 1
         score_views = {}
         for name, coord in st.model.coordinates.items():
             if isinstance(coord, RandomEffectModel):
@@ -484,39 +718,173 @@ class ScoringSession:
                     {k: np.asarray(v) for k, v in parts.items()})
         return np.asarray(result)
 
+    def _score_fused(self, rows, host, offsets, n, st: _ModelState,
+                     per_coordinate: bool):
+        """The paged hot path: pad the batch onto the row-bucket ladder,
+        resolve entity ids to device slots, and score everything in one
+        fused executable call. Cold entities (resident in neither pages
+        nor the absent set) fault through the LRU and are installed into
+        pages BEFORE the device call — the disk read dominates a cold
+        fault anyway, and scoring the faulting batch host-side instead
+        would fork the f64 summation order from the device gather (the
+        swap suite pins scores bitwise-stable across identical swaps,
+        which needs exactly one margin path). Only a batch with more
+        distinct entities than the whole table falls back to host math
+        for the overflow rows; the background installer is reserved for
+        swap-prewarm page rebuilds off the request path."""
+        dt = np.dtype(self.dtype)
+        B = bucketize(max(n, 1), self.row_ladder)
+        upload_bytes = 0
+        shard_idx, shard_val = [], []
+        for s in st.shard_order:
+            sp = host[s]
+            k = st.k_pad[s]
+            idx = np.zeros((B, k), np.int32)
+            val = np.zeros((B, k), dt)
+            kk = sp.indices.shape[1]
+            idx[:n, :kk] = sp.indices
+            val[:n, :kk] = sp.values
+            upload_bytes += idx.nbytes + val.nbytes
+            shard_idx.append(idx)
+            shard_val.append(val)
+        fixed_w = tuple(st.resident[name]
+                        for kind, name, _ in st.plan if kind == "fixed")
+        re_bufs, re_slots = [], []
+        extras: List[tuple] = []  # (plan position, host contribution)
+        for pos, (kind, name, si) in enumerate(st.plan):
+            if kind != "random":
+                continue
+            coord = st.model.coordinates[name]
+            ids = self._entity_column_values(rows, coord, name).tolist()
+            table = st.paged[name]
+            buf, slots, missing = table.lookup(ids)
+            missing = [m for m in missing if m != _NO_ENTITY]
+            if missing:
+                self.metrics.record_paged(faults=len(missing))
+                entries = st.coeff_caches[name].get_many(missing)
+                table.install(entries)
+                # re-read: fresh buffer + the installed entities' slots
+                buf, slots, still = table.lookup(ids)
+                still = set(still) - {_NO_ENTITY}
+                if still:
+                    # batch entities exceed the table: host math for the
+                    # overflow rows (size pages*page_rows >= max_batch
+                    # to never take this)
+                    sp = host[st.shard_order[si]]
+                    extra = np.zeros(n, dt)
+                    dense: Dict[str, np.ndarray] = {}
+                    for i, eid in enumerate(ids):
+                        if eid not in still:
+                            continue
+                        # an entity evicted by this very batch's installs
+                        # resolves from the LRU, not the fault entries
+                        entry = (entries.get(eid)
+                                 or st.coeff_caches[name].get(eid))
+                        if entry is None:
+                            continue
+                        drow = dense.get(eid)
+                        if drow is None:
+                            drow = dense[eid] = table.dense_row(entry)
+                        extra[i] = np.dot(drow[sp.indices[i]],
+                                          sp.values[i].astype(dt))
+                    extras.append((pos, extra))
+            slots_pad = np.full(B, -1, np.int32)
+            slots_pad[:n] = slots
+            re_bufs.append(buf)
+            re_slots.append(slots_pad)
+            upload_bytes += slots_pad.nbytes
+        off = np.zeros(B, dt)
+        off[:n] = offsets
+        upload_bytes += off.nbytes
+        # ONE budget charge for the batch's host->device bytes; the jit
+        # dispatch commits the numpy arrays itself (a single C-level
+        # shard_args pass beats one python device_put per array — at
+        # production QPS those six dispatches were measurable)
+        transfer_budget.charge(upload_bytes, "serve.fused_batch")
+        run = self._fused_executable(B, st)
+        total_d, parts_d = run(
+            off, tuple(shard_idx), tuple(shard_val), fixed_w,
+            tuple(re_bufs), tuple(re_slots))
+        total = np.asarray(total_d)[:n]
+        if extras:
+            total = total.copy()
+            for _pos, extra in extras:
+                total += extra
+        if not per_coordinate:
+            return total
+        parts = {}
+        extra_by_pos = dict(extras)
+        for pos, (kind, name, _si) in enumerate(st.plan):
+            p = np.asarray(parts_d[pos])[:n]
+            if pos in extra_by_pos:
+                p = p + extra_by_pos[pos]
+            parts[name] = p
+        return total, parts
+
     # -- request parsing ---------------------------------------------------
-    def _resolve_features(self, rows: List[dict], shard: str,
-                          st: _ModelState) -> HostSparse:
-        """Resolve request feature names through the shard's persisted
-        index map — the same resolution (+ implicit intercept) the Avro
-        data reader applies, so served rows see the exact training-time
-        feature space. Unknown features are dropped (per-shard feature
-        selection, as in the batch path)."""
-        imap = st.index_maps[shard]
-        intercept = imap.intercept_index
-        parsed: List[List[tuple]] = []
+    def _resolve_all(self, rows: List[dict],
+                     st: _ModelState) -> Dict[str, HostSparse]:
+        """Resolve every row's features for EVERY shard in one pass
+        through the state's feature router — the same resolution (+
+        implicit intercept) the Avro data reader applies, so served rows
+        see the exact training-time feature space. Unknown features are
+        dropped (per-shard feature selection, as in the batch path).
+        One iteration instead of one per shard: at production QPS the
+        per-feature dict lookups are the serving CPU floor."""
+        S = len(st.shard_order)
+        rget = st.router.get  # hoisted: this runs once per FEATURE
+        per: List[List[list]] = [[] for _ in range(S)]
         for r in rows:
-            out = []
-            for feat in r.get("features") or ():
-                if isinstance(feat, dict):
-                    name, term, value = (feat["name"], feat.get("term", ""),
-                                         feat.get("value", 1.0))
-                else:
-                    name, term, value = feat
-                idx = imap.index_of(str(name), str(term))
-                if idx is not None:
-                    out.append((idx, float(value)))
+            rowbufs: List[Optional[list]] = [None] * S
+            feats = r.get("features") or ()
+            if feats and type(feats[0]) is dict:
+                # hot shape (JSON rows): comprehension + C-level map keep
+                # the per-feature python overhead at the bytecode floor
+                keyed = [
+                    (rget(f["name"] if type(f["name"]) is str
+                          else str(f["name"]))
+                     if not f.get("term") else
+                     rget(f"{f['name']}\x01{f['term']}"),
+                     f.get("value", 1.0))
+                    for f in feats if "name" in f]
+            else:
+                keyed = []
+                for name, term, value in feats:
+                    if type(name) is not str:
+                        name = str(name)
+                    if term:
+                        key = (f"{name}\x01{term}" if type(term) is str
+                               else f"{name}\x01{term!s}")
+                    else:
+                        key = name
+                    keyed.append((rget(key), value))
+            for hits, value in keyed:
+                if hits:
+                    for si, idx in hits:
+                        b = rowbufs[si]
+                        if b is None:
+                            b = rowbufs[si] = []
+                        b.append((idx, value))
+            for si in range(S):
+                per[si].append(rowbufs[si] if rowbufs[si] is not None
+                               else [])
+        out: Dict[str, HostSparse] = {}
+        for si, shard in enumerate(st.shard_order):
+            parsed = per[si]
+            intercept = st.intercepts[si]
             if intercept is not None and intercept >= 0:
-                out.append((intercept, 1.0))
-            parsed.append(out)
-        k = max(max((len(p) for p in parsed), default=0), 1)
-        indices = np.zeros((len(rows), k), np.int32)
-        values = np.zeros((len(rows), k))
-        for i, p in enumerate(parsed):
-            for j, (idx, val) in enumerate(p):
-                indices[i, j] = idx
-                values[i, j] = val
-        return HostSparse(indices, values, imap.size)
+                for p in parsed:
+                    p.append((intercept, 1.0))
+            k = max(max((len(p) for p in parsed), default=0), 1)
+            indices = np.zeros((len(rows), k), np.int32)
+            values = np.zeros((len(rows), k))
+            for i, p in enumerate(parsed):
+                for j, (idx, val) in enumerate(p):
+                    indices[i, j] = idx
+                    values[i, j] = val
+            out[shard] = HostSparse(indices, values,
+                                    st.index_maps[shard].size)
+        return out
 
     @staticmethod
     def _entity_column_values(rows: List[dict], coord: RandomEffectModel,
@@ -534,7 +902,7 @@ class ScoringSession:
                 if key in ids:
                     val = ids[key]
                     break
-            out.append("\x00<no-entity>" if val is None else str(val))
+            out.append(_NO_ENTITY if val is None else str(val))
         return np.asarray(out)
 
     # -- introspection -----------------------------------------------------
@@ -545,6 +913,16 @@ class ScoringSession:
                    "hit_rate": c.hit_rate}
             for name, c in self._state.coeff_caches.items()
         }
+
+    def paged_table_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-coordinate device-residency stats (empty when the paged
+        path is off or no coordinate is eligible)."""
+        return {name: t.stats() for name, t in self._state.paged.items()}
+
+    @property
+    def paged_active(self) -> bool:
+        """True when the fused paged hot path serves this model."""
+        return self._state.fused_sig is not None
 
 
 def _max_live_nnz(sp: HostSparse) -> int:

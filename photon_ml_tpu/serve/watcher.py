@@ -20,6 +20,7 @@ serving; the watcher never tears down live state on a bad poll.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Optional
 
@@ -30,14 +31,23 @@ class RegistryWatcher:
     """Poll ``registry.read_latest()`` every ``interval_s`` and swap the
     session when it names a version other than the active one.
     ``on_swap(version)`` / ``on_error(exc)`` are optional observation
-    hooks (the serving driver logs through them)."""
+    hooks (the serving driver logs through them).
+
+    ``jitter_s`` adds a uniform random extra sleep per tick: in
+    multi-replica mode every replica watches the SAME registry, and
+    identical intervals would have N processes stat the same files (and
+    then all swap) on the same tick — jitter de-synchronizes the
+    stampede while keeping every replica within one interval+jitter of a
+    promotion (the consistency the front door relies on)."""
 
     def __init__(self, registry, session, interval_s: float = 10.0,
                  on_swap: Optional[Callable[[str], None]] = None,
-                 on_error: Optional[Callable[[Exception], None]] = None):
+                 on_error: Optional[Callable[[Exception], None]] = None,
+                 jitter_s: float = 0.0):
         self.registry = registry
         self.session = session
         self.interval_s = float(interval_s)
+        self.jitter_s = max(0.0, float(jitter_s))
         self.on_swap = on_swap
         self.on_error = on_error
         self.errors = 0
@@ -67,7 +77,11 @@ class RegistryWatcher:
         return latest
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        import random
+
+        rng = random.Random(os.getpid())
+        while not self._stop.wait(self.interval_s
+                                  + rng.uniform(0.0, self.jitter_s)):
             self.check_once()
 
     def start(self) -> "RegistryWatcher":

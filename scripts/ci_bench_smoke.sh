@@ -3,10 +3,14 @@
 # couple of minutes each) so they cannot rot between hardware rounds.
 # Runs alongside — never instead of — scripts/ci_tier1.sh. Each mode
 # self-checks its acceptance invariants and exits non-zero on failure:
-#   stream — warm chunk-cache >= 2x cold, f64 cache parity <= 1e-9, flat
-#            compile count
-#   cd     — active-set CD >= 1.5x full sweeps, f64 coefficient parity
-#            <= 1e-9, 0 RE-solver compiles across the timed active run
+#   stream  — warm chunk-cache >= 2x cold, f64 cache parity <= 1e-9, flat
+#             compile count
+#   cd      — active-set CD >= 1.5x full sweeps, f64 coefficient parity
+#             <= 1e-9, 0 RE-solver compiles across the timed active run
+#   serving — in-process async open-loop sweep: rows/s >= the floor
+#             (BENCH_SERVING_FLOOR, default 15000), 0 compile misses in
+#             steady state AND across a mid-load hot swap, 2x-overload
+#             soak sheds with 429s and zero scoring-path 5xx
 set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu \
@@ -17,3 +21,15 @@ JAX_PLATFORMS=cpu \
 BENCH_CD_ENTITIES="${BENCH_CD_ENTITIES:-1200}" \
 BENCH_CD_SWEEPS="${BENCH_CD_SWEEPS:-24}" \
 timeout -k 10 600 python bench.py cd
+# the smoke run must not clobber the full-run bench artifact (restore it
+# whether or not the smoke's acceptance gate passes)
+SERVING_SNAPSHOT="$(mktemp -d)"
+cp BENCH_serving.json "$SERVING_SNAPSHOT/" 2>/dev/null || true
+serving_rc=0
+JAX_PLATFORMS=cpu \
+BENCH_SERVING_SMOKE=1 \
+BENCH_SERVING_FLOOR="${BENCH_SERVING_FLOOR:-15000}" \
+timeout -k 10 600 python bench.py serving || serving_rc=$?
+cp "$SERVING_SNAPSHOT/BENCH_serving.json" . 2>/dev/null || true
+rm -rf "$SERVING_SNAPSHOT"
+exit "$serving_rc"

@@ -1,0 +1,219 @@
+"""Device-resident paged coefficient table: f64 parity with the
+host-LRU path (warm, cold, unknown entities), page eviction + refault,
+hot-swap page rebuild with a flat compile-miss counter, and the
+batched cold-miss store loader."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import serving_rows
+
+
+def _session(model_dir, **kw):
+    from photon_ml_tpu.serve import ScoringSession
+
+    kw.setdefault("dtype", "float64")
+    kw.setdefault("max_batch", 32)
+    kw.setdefault("coeff_cache_entries", 16)
+    return ScoringSession(model_dir, **kw)
+
+
+def test_paged_parity_float64_warm_cold_unknown(saved_game_model):
+    """Paged scores == host-LRU scores to <= 1e-9 in f64 for cold
+    entities (first touch), warm entities (second touch), and entities
+    the model has never seen (fixed-effect-only fallback)."""
+    model_dir, bundle = saved_game_model
+    idx = list(range(24))
+    uid = bundle["uid"].astype(str).copy()
+    uid[idx[3]] = "never-seen-entity"
+    uid[idx[17]] = "another-unknown"
+    offsets = np.linspace(-0.5, 0.5, len(idx))
+    rows = serving_rows(bundle, idx, entity_ids=uid, offsets=offsets)
+
+    paged = _session(model_dir)
+    lru = _session(model_dir, paged_table=False)
+    assert paged.paged_active and not lru.paged_active
+
+    cold = paged.score_rows(rows)  # every entity faults
+    ref = lru.score_rows(rows)
+    np.testing.assert_allclose(cold, ref, rtol=0, atol=1e-9)
+    warm = paged.score_rows(rows)  # every entity resident
+    np.testing.assert_allclose(warm, ref, rtol=0, atol=1e-9)
+    stats = paged.paged_table_stats()["per-user"]
+    assert stats["resident"] > 0
+    assert stats["absent"] == 2  # the two unknown ids are negative-cached
+
+
+def test_paged_per_coordinate_parity(saved_game_model):
+    model_dir, bundle = saved_game_model
+    idx = list(range(10))
+    rows = serving_rows(bundle, idx)
+    paged = _session(model_dir, warmup=False)
+    lru = _session(model_dir, paged_table=False, warmup=False)
+    got, parts = paged.score_rows(rows, per_coordinate=True)
+    ref, ref_parts = lru.score_rows(rows, per_coordinate=True)
+    np.testing.assert_allclose(got, ref, atol=1e-9)
+    assert set(parts) == set(ref_parts)
+    for name in parts:
+        np.testing.assert_allclose(parts[name], ref_parts[name], atol=1e-9)
+
+
+def test_page_eviction_and_refault(saved_game_model):
+    """A table smaller than the entity universe evicts whole pages and
+    refaults evicted entities correctly (scores stay at parity)."""
+    model_dir, bundle = saved_game_model
+    tiny = _session(model_dir, re_pages=2, re_page_rows=2)  # 4 resident
+    lru = _session(model_dir, paged_table=False)
+    n_entities = bundle["n_entities"]
+    assert n_entities > 4
+    # visit every entity one at a time -> guaranteed page churn
+    for ent in range(n_entities):
+        row_idx = [int(np.argmax(bundle["uid"] == ent))]
+        rows = serving_rows(bundle, row_idx)
+        got = tiny.score_rows(rows)
+        ref = lru.score_rows(rows)
+        np.testing.assert_allclose(got, ref, rtol=0, atol=1e-9)
+    stats = tiny.paged_table_stats()["per-user"]
+    assert stats["page_evictions"] > 0
+    assert stats["resident"] <= 4
+    # refault: entity 0 was evicted long ago; scoring it again is correct
+    row_idx = [int(np.argmax(bundle["uid"] == 0))]
+    rows = serving_rows(bundle, row_idx)
+    np.testing.assert_allclose(tiny.score_rows(rows),
+                               lru.score_rows(rows), rtol=0, atol=1e-9)
+    assert tiny.metrics.paged_faults >= n_entities
+
+
+def test_hot_swap_rebuilds_pages_compile_flat(saved_game_model, tmp_path):
+    """A swap to a same-shaped model rebuilds the paged tables (new
+    device buffers, prewarmed asynchronously) WITHOUT new executables,
+    and post-swap scores reflect the new coefficients."""
+    import shutil
+
+    from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+
+    model_dir, bundle = saved_game_model
+    delta_dir = str(tmp_path / "model-delta")
+    shutil.copytree(model_dir, delta_dir)
+    re_path = f"{delta_dir}/random-effect/per-user/coefficients.avro"
+    records, schema = read_avro_file(re_path)
+    for rec in records:
+        for coef in rec["means"]:
+            coef["value"] *= 1.25
+    write_avro_file(re_path, records, schema)
+
+    session = _session(model_dir)
+    lru_after = _session(delta_dir, paged_table=False)
+    idx = list(range(16))
+    rows = serving_rows(bundle, idx)
+    before = session.score_rows(rows)  # faults + installs everything
+    table_before = session.paged_table_stats()["per-user"]
+    assert table_before["resident"] > 0
+
+    warm = session.compile_count
+    session.swap(delta_dir)
+    assert session.drain_installs(30.0)  # async page prewarm finished
+    after = session.score_rows(rows)
+    assert session.compile_count == warm, (
+        "swap between same-shaped models must not compile")
+    # scores moved (new coefficients)...
+    assert not np.allclose(before, after)
+    # ...and match the host-LRU reference over the NEW model exactly
+    np.testing.assert_allclose(after, lru_after.score_rows(rows),
+                               rtol=0, atol=1e-9)
+
+
+def test_paged_table_unit_behavior():
+    from photon_ml_tpu.serve import PagedCoefficientTable
+    from photon_ml_tpu.serve.coeff_cache import CoeffEntry
+    from photon_ml_tpu.serve.paged_table import entry_supported
+
+    t = PagedCoefficientTable(4, pages=2, page_rows=2, dtype=np.float64)
+    assert t.capacity == 4 and len(t) == 0
+    buf, slots, missing = t.lookup(["a", "b", "a"])
+    assert list(slots) == [-1, -1, -1]
+    assert missing == ["a", "b"]  # deduplicated
+    t.install({"a": CoeffEntry({0: 0, 2: 1}, np.array([1.5, -2.0])),
+               "b": None})
+    buf, slots, missing = t.lookup(["a", "b"])
+    assert slots[0] >= 0 and slots[1] == -1
+    assert missing == []  # b is known-absent, not re-faulted
+    host_row = np.asarray(buf)[slots[0]]
+    np.testing.assert_allclose(host_row, [1.5, 0.0, -2.0, 0.0])
+    # fill beyond capacity -> page eviction
+    for i in range(6):
+        t.install({f"e{i}": CoeffEntry({1: 0}, np.array([float(i)]))})
+    assert t.page_evictions >= 1
+    assert len(t) <= t.capacity
+    with pytest.raises(ValueError):
+        PagedCoefficientTable(0)
+    assert entry_supported(None)
+    assert entry_supported(CoeffEntry({0: 0}, np.array([1.0])))
+
+    class _Sketch:  # stands in for game.data.SketchProjection
+        pass
+
+    assert not entry_supported(CoeffEntry(_Sketch(), np.array([1.0])))
+
+
+def test_store_load_many_matches_single_loads(saved_game_model):
+    """Satellite: the one-pass batched loader resolves exactly what m
+    single loads resolve (including absent ids)."""
+    from photon_ml_tpu.io.paldb import load_index_map
+    from photon_ml_tpu.serve import ModelDirCoefficientStore
+
+    model_dir, bundle = saved_game_model
+    imap = load_index_map(f"{model_dir}/index-map.u.json")
+    store = ModelDirCoefficientStore(model_dir, "per-user", imap)
+    ids = [str(i) for i in range(bundle["n_entities"])] + ["nope", "0"]
+    batched = store.load_many(ids)
+    for eid in set(ids):
+        single = store.load(eid)
+        got = batched[eid]
+        if single is None:
+            assert got is None
+        else:
+            assert got is not None
+            np.testing.assert_array_equal(got.coefficients,
+                                          single.coefficients)
+            assert got.local_map == single.local_map
+
+
+def test_sketched_coordinate_gates_off_paged_path(tmp_path):
+    """A sketch-projected random effect cannot densify into pages: the
+    session must fall back to the LRU path (paged_active False) and
+    still score correctly."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        make_game_dataset,
+    )
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+
+    r = np.random.default_rng(5)
+    n, d = 120, 6
+    X = r.normal(size=(n, d))
+    uid = r.integers(0, 7, n)
+    y = (r.random(n) < 0.5).astype(float)
+    ds = make_game_dataset({"u": X}, y, entity_ids={"userId": uid})
+    cd = CoordinateDescent(
+        [CoordinateConfig("per-user", coordinate_type="random",
+                          feature_shard="u", entity_column="userId",
+                          reg_type="l2", reg_weight=1.0,
+                          projection="random", projection_dim=4)],
+        task="logistic", dtype=jnp.float64)
+    model, _ = cd.run(ds)
+    model_dir = str(tmp_path / "sketched")
+    save_game_model(model, model_dir,
+                    {"u": IndexMap({f"u{j}": j for j in range(d)})})
+    session = _session(model_dir)
+    assert not session.paged_active  # gated off, not broken
+    rows = [{"features": [{"name": f"u{j}", "value": float(X[i, j])}
+                          for j in range(d)],
+             "entityIds": {"userId": str(uid[i])}} for i in range(8)]
+    scores = session.score_rows(rows)
+    assert scores.shape == (8,)
+    assert np.all(np.isfinite(scores))

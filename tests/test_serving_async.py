@@ -1,0 +1,258 @@
+"""Asyncio front end + multi-replica front door: status contract,
+Retry-After on shed, bursty-arrival coalescing through the batcher,
+keep-alive, graceful drain, replica failover, and registry-consistent
+hot swap across replicas."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from tests.conftest import serving_rows
+
+
+async def _http(host, port, method, path, payload=None, keep=None):
+    """Minimal HTTP/1.1 client: (status, headers, body_json). ``keep``
+    is an optional (reader, writer) pair to reuse (keep-alive)."""
+    if keep is None:
+        reader, writer = await asyncio.open_connection(host, port)
+    else:
+        reader, writer = keep
+    body = b"" if payload is None else json.dumps(payload).encode()
+    writer.write(
+        (f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+         f"Content-Type: application/json\r\n"
+         f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+    await writer.drain()
+    head = await reader.readuntil(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length", "0"))
+    raw = await reader.readexactly(length) if length else b""
+    try:
+        parsed = json.loads(raw) if raw else None
+    except json.JSONDecodeError:
+        parsed = raw.decode()
+    if keep is None:
+        writer.close()
+    return status, headers, parsed
+
+
+def _service(saved_game_model, **batcher_kw):
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, dtype="float64", max_batch=16,
+                             coeff_cache_entries=32)
+    batcher_kw.setdefault("max_batch", 16)
+    batcher_kw.setdefault("max_delay_ms", 2.0)
+    batcher = MicroBatcher(session.score_rows, metrics=session.metrics,
+                           **batcher_kw)
+    return ScoringService(session, batcher), bundle
+
+
+def test_async_server_contract(saved_game_model):
+    """200 with parity scores, 400 on bad payloads/JSON, 404 on unknown
+    paths, /healthz, /metrics with the new series — over real sockets."""
+    from photon_ml_tpu.serve import AsyncScoringServer
+
+    service, bundle = _service(saved_game_model)
+    rows = serving_rows(bundle, list(range(6)))
+    ref = service.session.score_rows(rows)
+
+    async def run():
+        server = await AsyncScoringServer(service).start()
+        h, p = server.host, server.port
+        out = {}
+        out["score"] = await _http(h, p, "POST", "/score", {"rows": rows})
+        out["empty"] = await _http(h, p, "POST", "/score", {"rows": []})
+        out["badjson"] = await _http(h, p, "POST", "/nope")
+        out["health"] = await _http(h, p, "GET", "/healthz")
+        out["metrics"] = await _http(h, p, "GET", "/metrics")
+        # keep-alive: two requests on one connection
+        conn = await asyncio.open_connection(h, p)
+        first = await _http(h, p, "POST", "/score", {"rows": rows},
+                            keep=conn)
+        second = await _http(h, p, "GET", "/healthz", keep=conn)
+        conn[1].close()
+        out["keepalive"] = (first[0], second[0])
+        await server.aclose()
+        return out
+
+    out = asyncio.run(run())
+    status, _, body = out["score"]
+    assert status == 200
+    np.testing.assert_allclose(body["scores"], np.asarray(ref), atol=1e-9)
+    assert out["empty"][0] == 400
+    assert out["badjson"][0] == 404
+    assert out["health"][0] == 200
+    assert out["health"][2]["server"] == "asyncio"
+    assert out["metrics"][0] == 200
+    text = out["metrics"][2]
+    assert "photon_serve_queue_wait_ms" in text
+    assert "photon_serve_compute_ms" in text
+    assert "photon_serve_shed_queue_full_total" in text
+    assert out["keepalive"] == (200, 200)
+
+
+def test_async_burst_coalesces_and_sheds_with_retry_after(
+        saved_game_model):
+    """A burst far over queue capacity: successes coalesce into batches
+    (fewer executions than requests), overflow is shed as 429 with a
+    Retry-After hint, and nothing 5xxs."""
+    from photon_ml_tpu.serve import AsyncScoringServer
+
+    # stall the first batch briefly so the burst actually queues
+    service, bundle = _service(saved_game_model, max_queue=8,
+                               max_delay_ms=20.0)
+    rows1 = serving_rows(bundle, [0])
+
+    async def run():
+        server = await AsyncScoringServer(service).start()
+        h, p = server.host, server.port
+        results = await asyncio.gather(
+            *[_http(h, p, "POST", "/score", {"rows": rows1})
+              for _ in range(40)])
+        await server.aclose()
+        return results
+
+    results = asyncio.run(run())
+    statuses = [r[0] for r in results]
+    assert set(statuses) <= {200, 429}
+    assert statuses.count(200) >= 8
+    shed = [r for r in results if r[0] == 429]
+    assert shed, "burst over an 8-deep queue must shed"
+    for _s, headers, body in shed:
+        assert int(headers["retry-after"]) >= 1
+        assert body["shed"] is True and body["cause"] == "queue_full"
+        assert body["retryAfterS"] > 0
+    snap = service.metrics.snapshot()
+    assert snap["shed_queue_full_total"] == len(shed)
+    assert snap["errors_total"] == 0
+    # bursty arrivals coalesced: strictly fewer executions than requests
+    assert 0 < snap["batches_total"] < snap["requests_total"]
+    assert snap["queue_wait_p99_ms"] >= 0.0
+
+
+def test_async_drain_completes_inflight(saved_game_model):
+    """aclose() lets an in-flight request finish (drain, not abort)."""
+    from photon_ml_tpu.serve import AsyncScoringServer
+
+    service, bundle = _service(saved_game_model, max_delay_ms=30.0)
+    rows = serving_rows(bundle, [0, 1])
+
+    async def run():
+        server = await AsyncScoringServer(service).start()
+        task = asyncio.create_task(
+            _http(server.host, server.port, "POST", "/score",
+                  {"rows": rows}))
+        await asyncio.sleep(0.005)  # request admitted, batch still open
+        await server.aclose(drain_timeout_s=10.0)
+        return await task
+
+    status, _, body = asyncio.run(run())
+    assert status == 200 and len(body["scores"]) == 2
+
+
+def test_front_door_spreads_and_fails_over(saved_game_model):
+    """Least-loaded front door: both replicas serve traffic; a dead
+    replica is cooled down and traffic fails over with zero client
+    errors; with every replica down the door answers 503."""
+    from photon_ml_tpu.serve import AsyncFrontDoor, AsyncScoringServer
+
+    service_a, bundle = _service(saved_game_model)
+    service_b, _ = _service(saved_game_model)
+    rows = serving_rows(bundle, [0, 1, 2])
+
+    async def run():
+        a = await AsyncScoringServer(service_a).start()
+        b = await AsyncScoringServer(service_b).start()
+        door = await AsyncFrontDoor(
+            [f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"],
+            retry_backend_s=0.2).start()
+        ok = await asyncio.gather(
+            *[_http(door.host, door.port, "POST", "/score",
+                    {"rows": rows}) for _ in range(12)])
+        fd = await _http(door.host, door.port, "GET", "/fd/healthz")
+        await a.aclose()  # replica A dies
+        after = await asyncio.gather(
+            *[_http(door.host, door.port, "POST", "/score",
+                    {"rows": rows}) for _ in range(6)])
+        await b.aclose()  # everything down
+        dead = await _http(door.host, door.port, "POST", "/score",
+                           {"rows": rows})
+        await door.aclose()
+        return ok, fd, after, dead, door
+
+    ok, fd, after, dead, door = asyncio.run(run())
+    assert all(r[0] == 200 for r in ok)
+    assert fd[0] == 200 and len(fd[2]["backends"]) == 2
+    assert all(r[0] == 200 for r in after), "failover must hide a dead " \
+                                            "replica from clients"
+    assert door.retried >= 1
+    assert dead[0] == 503
+    # both replicas actually served before the failure
+    assert service_a.metrics.snapshot()["requests_total"] > 0
+    assert service_b.metrics.snapshot()["requests_total"] > 0
+
+
+def test_replicas_converge_via_shared_registry(saved_game_model,
+                                               tmp_path):
+    """Hot-swap consistency in multi-replica mode: every replica watches
+    ONE registry, so a promotion reaches all of them without the front
+    door knowing models exist."""
+    import shutil
+
+    from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+    from photon_ml_tpu.registry import ModelRegistry
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        RegistryWatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    model_dir, bundle = saved_game_model
+    delta_dir = str(tmp_path / "next")
+    shutil.copytree(model_dir, delta_dir)
+    re_path = f"{delta_dir}/random-effect/per-user/coefficients.avro"
+    records, schema = read_avro_file(re_path)
+    for rec in records:
+        for coef in rec["means"]:
+            coef["value"] *= 1.1
+    write_avro_file(re_path, records, schema)
+
+    registry = ModelRegistry(str(tmp_path / "registry"))
+    v1 = registry.publish(model_dir, set_latest=True)
+
+    replicas = []
+    for _ in range(2):
+        session = ScoringSession(registry.open_version(v1),
+                                 dtype="float64", max_batch=8,
+                                 coeff_cache_entries=16)
+        batcher = MicroBatcher(session.score_rows, max_batch=8,
+                               metrics=session.metrics)
+        service = ScoringService(session, batcher, registry=registry)
+        watcher = RegistryWatcher(registry, session, interval_s=9999.0,
+                                  jitter_s=0.5)
+        replicas.append((service, watcher))
+    v2 = registry.publish(delta_dir, parent=v1, set_latest=True)
+    for _service, watcher in replicas:
+        assert watcher.check_once() == v2  # one poll tick, no stampede
+    versions = {s.session.active_version for s, _w in replicas}
+    assert versions == {v2}
+    rows = serving_rows(bundle, list(range(4)))
+    scores = [s.session.score_rows(rows) for s, _w in replicas]
+    np.testing.assert_allclose(scores[0], scores[1], rtol=0, atol=1e-12)
+    for s, _w in replicas:
+        s.close(drain_timeout_s=2.0)

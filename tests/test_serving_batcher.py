@@ -202,3 +202,121 @@ def test_close_rejects_new_submissions():
     b.close()
     with pytest.raises(RuntimeError, match="closed"):
         b.submit(_rows(1.0))
+
+
+def test_queue_full_carries_retry_after_hint():
+    """429s must tell the client HOW LONG to back off: retry_after_s
+    derives from queue depth x the batching deadline and is floored at
+    one deadline."""
+    from photon_ml_tpu.serve import MicroBatcher, QueueFullError
+
+    gate = threading.Event()
+
+    def slow(rows, per_coordinate=False):
+        gate.wait(5.0)
+        return _echo_score(rows)
+
+    b = MicroBatcher(slow, max_batch=2, max_delay_ms=10.0, max_queue=2)
+    try:
+        for i in range(3):  # worker holds one, queue holds two
+            b.submit(_rows(float(i)))
+            time.sleep(0.02 if i == 0 else 0.0)
+        with pytest.raises(QueueFullError) as exc:
+            b.submit(_rows(9.0))
+        assert exc.value.cause == "queue_full"
+        assert exc.value.retry_after_s >= b.max_delay_s
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_deadline_shed_splits_metrics_by_cause():
+    """Requests whose deadline expires while queued are shed by the
+    worker with cause='deadline'; the metrics split the two shed causes
+    and shed_total stays their sum."""
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        QueueFullError,
+        ServingMetrics,
+    )
+
+    metrics = ServingMetrics()
+    release = threading.Event()
+
+    def slow(rows, per_coordinate=False):
+        release.wait(5.0)
+        return _echo_score(rows)
+
+    b = MicroBatcher(slow, max_batch=1, max_delay_ms=1.0, max_queue=8,
+                     request_deadline_s=0.05, metrics=metrics)
+    try:
+        first = b.submit(_rows(1.0))   # occupies the worker
+        stale = b.submit(_rows(2.0))   # waits past its deadline
+        time.sleep(0.15)
+        release.set()
+        assert first.result(5.0)[0] == 1.0
+        with pytest.raises(QueueFullError) as exc:
+            stale.result(5.0)
+        assert exc.value.cause == "deadline"
+        assert exc.value.retry_after_s > 0
+        snap = metrics.snapshot()
+        assert snap["shed_deadline_total"] == 1
+        assert snap["shed_total"] == (snap["shed_queue_full_total"]
+                                      + snap["shed_deadline_total"])
+    finally:
+        release.set()
+        b.close()
+
+
+def test_request_latency_splits_into_queue_wait_and_compute():
+    """The queue-wait / device-compute histograms must account for the
+    request latency: a stalled batch shows up as queue wait for the
+    request behind it and as compute for its own batch."""
+    from photon_ml_tpu.serve import MicroBatcher, ServingMetrics
+
+    metrics = ServingMetrics()
+
+    def slow(rows, per_coordinate=False):
+        time.sleep(0.03)
+        return _echo_score(rows)
+
+    b = MicroBatcher(slow, max_batch=1, max_delay_ms=1.0, max_queue=8,
+                     metrics=metrics)
+    try:
+        pending = [b.submit(_rows(float(i))) for i in range(3)]
+        for p in pending:
+            p.result(10.0)
+        snap = metrics.snapshot()
+        # batch 3 waited behind ~2 executions of ~30ms each
+        assert snap["queue_wait_p99_ms"] >= 30.0
+        assert snap["compute_p50_ms"] >= 25.0
+        assert metrics.queue_wait_ms.total == 3
+        assert metrics.compute_ms.total == 3
+        rendered = metrics.render()
+        assert "photon_serve_queue_wait_ms_bucket" in rendered
+        assert "photon_serve_compute_ms_bucket" in rendered
+    finally:
+        b.close()
+
+
+def test_done_callback_fires_on_resolution_any_order():
+    """add_done_callback is the asyncio bridge: it must fire exactly
+    once whether registered before or after the request resolves."""
+    from photon_ml_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(_echo_score, max_batch=4, max_delay_ms=1.0)
+    try:
+        fired = []
+        req = b.submit(_rows(1.0))
+        req.add_done_callback(lambda r: fired.append(r.result(0)[0]))
+        req.result(5.0)
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert fired == [1.0]
+        # late registration: resolved request -> immediate callback
+        req.add_done_callback(lambda r: fired.append("late"))
+        assert fired == [1.0, "late"]
+        assert req.error is None
+    finally:
+        b.close()
