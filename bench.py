@@ -34,6 +34,26 @@ import time
 import numpy as np
 
 
+def _environment() -> dict:
+    """Common environment block recorded in EVERY BENCH_*.json (and the
+    headline JSON line): PR 6's serving floors turned out to be core-bound
+    and only the serving bench recorded cpu_cores, which made the numbers
+    hard to interpret after the fact. One shared helper so no mode can
+    drift. Call only after the mode has pinned/initialized its jax
+    platform — the block records what the measurement actually ran on."""
+    import jax
+
+    devs = jax.devices()
+    return {
+        "cpu_cores": os.cpu_count() or 1,
+        "jax_version": jax.__version__,
+        "platform": devs[0].platform,
+        "device_kind": getattr(devs[0], "device_kind", ""),
+        "device_count": len(devs),
+        "python_version": sys.version.split()[0],
+    }
+
+
 def _arm_watchdog() -> None:
     """The TPU tunnel in this environment can wedge indefinitely (even
     ``jax.devices()`` then blocks). Rather than hang the driver's bench run,
@@ -313,6 +333,7 @@ def main() -> None:
                 f"n={n_rows}, d={dim}, k={k}, iters={done}, "
                 f"sparse_grad={mode}; {util}{base_note}{fallback})",
         "vs_baseline": vs,
+        "environment": _environment(),
     }))
 
 
@@ -640,6 +661,7 @@ def serving_main() -> None:
     speedup = (round(single_capacity / prev_recorded, 2)
                if prev_recorded else None)
     record = {
+        "environment": _environment(),
         "metric": "serving_open_loop_rows_per_sec_cpu",
         "value": multi_capacity,
         "unit": (f"rows/sec, {n_replicas}-replica in-process open loop "
@@ -804,6 +826,7 @@ def swap_main() -> None:
         return round(xs[min(len(xs) - 1, int(len(xs) * q))], 3)
 
     record = {
+        "environment": _environment(),
         "metric": "serving_hot_swap_latency_cpu",
         "value": pct(swap_ms, 0.5),
         "unit": (f"ms swap p50 over {n_swaps} full<->delta swaps "
@@ -948,6 +971,7 @@ def stream_main() -> None:
                     "compute_stall": round(stats.stall_s / wall, 4)}
 
         record = {
+            "environment": _environment(),
             "metric": "streamed_ooc_warm_pass_example_passes_per_sec",
             "value": round(n / warm_s, 1),
             "unit": (f"example-passes/sec, warm chunk-cache pass "
@@ -1103,6 +1127,7 @@ def cd_main() -> None:
                        for r in re_records]
     sweeps_active = h_act[-1]["iteration"] + 1
     record = {
+        "environment": _environment(),
         "metric": "cd_active_set_speedup_vs_full_sweeps",
         "value": round(full_s / act_s, 3),
         "unit": (f"x wall-clock, full-sweep CD / active-set CD "
@@ -1133,6 +1158,242 @@ def cd_main() -> None:
               "parity <= 1e-9, 0 solver compiles across the timed "
               "active-set run)", file=sys.stderr)
         sys.exit(6)
+
+
+def shard_main() -> None:
+    """``python bench.py shard`` — entity-sharded GAME training on the
+    simulated multi-controller runtime.
+
+    One synthetic mixed-effect dataset (EQUAL rows per entity and fully
+    dense RE features, so every entity's padded solve shapes are
+    identical whatever the bucket composition — the sharded f64
+    coefficients must be BIT-compatible with the single-process fit);
+    1/2/4-process simulated runs (``testing.run_simulated_processes``,
+    capped by ``BENCH_SHARD_PROCS``), each warmed once so the timed run
+    pays no compiles. Per shard count it records wall-clock, bytes
+    communicated per sweep (the changed-row score exchange —
+    ``comm_bytes`` in the CD history), and peak per-process entity-table
+    bytes (``RandomEffectTrainData.table_bytes``). The sharded runs also
+    enforce a per-process table budget set BELOW the full table
+    (``entity_table_budget_bytes``), and the bench proves the same budget
+    makes the single-process run refuse to start — the "table that
+    provably does not fit one process" demonstration.
+
+    Acceptance (exit 8, distinct from stream/cd/serving's 5/6/7):
+    f64 coefficients bit-equal across every shard count, max-process
+    peak table < the single-process table, a nonzero communicated-bytes
+    counter, and total exchange bytes at least 10x below shipping every
+    full coefficient table once per sweep (the naive comparator).
+
+    Sized by ``BENCH_SHARD_ENTITIES`` (default 768) and
+    ``BENCH_SHARD_SWEEPS`` (default 14) so the CI smoke finishes in a
+    couple of minutes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
+    jax.config.update("jax_enable_x64", True)  # the bit-parity gate is f64
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        make_game_dataset,
+    )
+    from photon_ml_tpu.parallel.entity_shard import (
+        EntityShardSpec,
+        EntityTableBudgetError,
+    )
+    from photon_ml_tpu.testing import run_simulated_processes
+
+    rng = np.random.default_rng(0)
+    n_entities = int(os.environ.get("BENCH_SHARD_ENTITIES", 768))
+    n_sweeps = int(os.environ.get("BENCH_SHARD_SWEEPS", 14))
+    max_procs = int(os.environ.get("BENCH_SHARD_PROCS", 4))
+    # wide per-entity dims, few rows per entity — the paper's cold-user
+    # regime and exactly where the delta exchange wins: a sweep's changed
+    # rows cost 12 B/row while a coefficient-shipping scheme moves
+    # 8*d_re B/entity, so the per-sweep wire ratio is ~(8*96)/(4*12) = 16x
+    # even when every entity re-solves (arXiv:1611.02101's communication
+    # argument); frozen-frontier sweeps ship almost nothing on top
+    rows_per_entity, d_g, d_u = 4, 8, 96
+    w_fixed = rng.normal(size=d_g)
+    U = rng.normal(size=(n_entities, d_u)) * 1.2
+    Xg, Xu, y, uid = [], [], [], []
+    for u in range(n_entities):
+        xg = rng.normal(size=(rows_per_entity, d_g))
+        xu = rng.normal(size=(rows_per_entity, d_u))
+        marg = xg @ w_fixed + xu @ U[u]
+        y.append((rng.random(rows_per_entity)
+                  < 1 / (1 + np.exp(-marg))).astype(float))
+        Xg.append(xg)
+        Xu.append(xu)
+        uid.append(np.full(rows_per_entity, u))
+    Xg, Xu, y, uid = map(np.concatenate, (Xg, Xu, y, uid))
+    ds = make_game_dataset({"g": Xg, "u": Xu}, y, entity_ids={"userId": uid})
+
+    def coord_configs():
+        return [
+            CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                             reg_weight=2.0, tolerance=1e-12),
+            # lbfgs: the measured CPU-default RE solver AND the one whose
+            # batched kernels are bit-invariant to the entity-batch width
+            # (batched-LU newton agrees only to ~1e-11 across widths —
+            # docs/sharding.md); drift-free, so the active set freezes
+            CoordinateConfig("per-user", coordinate_type="random",
+                             feature_shard="u", entity_column="userId",
+                             reg_type="l2", reg_weight=2.0, tolerance=1e-11,
+                             optimizer="lbfgs", active_set=True,
+                             refresh_every=6, active_tol=1e-10),
+        ]
+
+    def run_one(p, budget=None):
+        def fn(rank):
+            spec = EntityShardSpec(p, rank) if p > 1 else None
+            cache = {}
+            cd = CoordinateDescent(
+                coord_configs(), task="logistic", n_iterations=n_sweeps,
+                dtype=jnp.float64, entity_shard=spec, dataset_cache=cache,
+                entity_table_budget_bytes=budget if p > 1 else None)
+            model, history = cd.run(ds)
+            # scalar fetch: the run has actually completed
+            float(np.asarray(
+                model.coordinates["fixed"].model.coefficients.means)[0])
+            table = sum(v[1].table_bytes() for k_, v in cache.items()
+                        if isinstance(k_, tuple) and k_ and k_[0] == "re_data")
+            return {"model": model, "history": history,
+                    "table_bytes": table}
+        t0 = time.perf_counter()
+        if p == 1:
+            outs = [fn(0)]
+        else:
+            outs = run_simulated_processes(p, fn, join_timeout=1800)
+        wall = time.perf_counter() - t0
+        for o in outs:
+            assert isinstance(o, dict), f"simulated process failed: {o!r}"
+        return outs, wall
+
+    def coeff_map(model):
+        out = {}
+        for b in model.coordinates["per-user"].buckets:
+            proj = np.asarray(b.projection)
+            C = np.asarray(b.coefficients)
+            for r, eid in enumerate(b.entity_ids):
+                valid = proj[r] >= 0
+                w = np.zeros(d_u)
+                w[proj[r][valid]] = C[r][valid]
+                out[str(eid)] = w
+        return out
+
+    procs_list = [p for p in (1, 2, 4) if p <= max_procs]
+    runs = {}
+    single_table = None
+    budget = None
+    ref_coeffs = None
+    ref_fixed = None
+    parity = {}
+    for p in procs_list:
+        run_one(p, budget)  # warm-up: compile this shard count's ladder
+        outs, wall = run_one(p, budget)
+        peak_table = max(o["table_bytes"] for o in outs)
+        hist = outs[0]["history"]
+        re_records = [r for r in hist if r["coordinate"] == "per-user"]
+        per_sweep = [int(r.get("comm_bytes", 0)) for r in re_records]
+        comm_s = sum(float(r.get("comm_seconds", 0.0)) for r in hist)
+        runs[str(p)] = {
+            "wall_s": round(wall, 3),
+            "peak_process_table_bytes": peak_table,
+            "comm_bytes_total": int(sum(per_sweep)),
+            "comm_bytes_per_sweep": per_sweep,
+            "comm_seconds_total": round(comm_s, 4),
+            "entities_solved_per_sweep": [
+                int(r.get("entities_solved", 0)) for r in re_records],
+        }
+        if p == 1:
+            single_table = peak_table
+            # the budget the sharded runs must fit under — and the single
+            # process provably cannot: 60% of the full table (every shard
+            # holds ~1/p of it, well under at p >= 2)
+            budget = int(single_table * 0.6)
+            ref_coeffs = coeff_map(outs[0]["model"])
+            ref_fixed = np.asarray(outs[0]["model"].coordinates["fixed"]
+                                   .model.coefficients.means)
+        else:
+            got = coeff_map(outs[0]["model"])
+            d_re = max(float(np.max(np.abs(got[k_] - ref_coeffs[k_])))
+                       for k_ in ref_coeffs)
+            d_fx = float(np.max(np.abs(
+                np.asarray(outs[0]["model"].coordinates["fixed"]
+                           .model.coefficients.means) - ref_fixed)))
+            parity[str(p)] = {"re_coeff_max_abs_diff": d_re,
+                              "fixed_coeff_max_abs_diff": d_fx}
+
+    # the budget demonstration: the same budget every sharded run trained
+    # under makes the single process refuse to start (1-sweep probe — the
+    # check fires during state construction, before any solve)
+    single_over_budget = False
+    try:
+        CoordinateDescent(coord_configs(), task="logistic", n_iterations=1,
+                          dtype=jnp.float64,
+                          entity_table_budget_bytes=budget).run(ds)
+    except EntityTableBudgetError:
+        single_over_budget = True
+
+    p_max = procs_list[-1]
+    peak_max = runs[str(p_max)]["peak_process_table_bytes"]
+    comm_total = runs[str(p_max)]["comm_bytes_total"]
+    # naive comparator: a coefficient-shipping scheme moves at least the
+    # full per-entity table once per sweep (one broadcast's worth — the
+    # most charitable accounting for it)
+    naive_per_sweep = n_entities * d_u * 8
+    naive_total = naive_per_sweep * n_sweeps
+    record = {
+        "environment": _environment(),
+        "metric": "entity_shard_peak_table_reduction",
+        "value": (round(single_table / max(peak_max, 1), 3)
+                  if p_max > 1 else 1.0),
+        "unit": (f"x peak per-process entity-table bytes, 1-process / "
+                 f"{p_max}-process simulated ({jax.devices()[0].platform}, "
+                 f"f64, entities={n_entities}, rows={len(y)}, d_re={d_u}, "
+                 f"sweeps={n_sweeps}; wall/comm per shard count in "
+                 "fields; simulated processes share one interpreter, so "
+                 "wall-clock is GIL-bound — the scaling claims are the "
+                 "table bytes and the exchange bytes)"),
+        "entities": n_entities,
+        "rows": int(len(y)),
+        "d_re": d_u,
+        "sweeps": n_sweeps,
+        "runs": runs,
+        "coeff_parity_vs_single": parity,
+        "single_process_table_bytes": single_table,
+        "table_budget_bytes": budget,
+        "single_process_refuses_over_budget": single_over_budget,
+        "naive_full_table_bytes_per_sweep": naive_per_sweep,
+        "naive_full_table_bytes_total": naive_total,
+        "delta_exchange_vs_naive_ratio": (
+            round(naive_total / comm_total, 2) if comm_total else None),
+    }
+    ok = (p_max > 1
+          and all(v["re_coeff_max_abs_diff"] == 0.0
+                  and v["fixed_coeff_max_abs_diff"] == 0.0
+                  for v in parity.values())
+          and peak_max < single_table
+          and comm_total > 0
+          and naive_total >= 10 * comm_total
+          and single_over_budget)
+    record["acceptance_ok"] = ok
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_shard.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+    if not ok:
+        print("shard bench acceptance FAILED (f64 bit parity, peak table "
+              "< single-process, nonzero comm bytes >= 10x under full-"
+              "table shipping, budget refusal on one process)",
+              file=sys.stderr)
+        sys.exit(8)
 
 
 def _baseline() -> "tuple[float, str] | None":
@@ -1194,5 +1455,7 @@ if __name__ == "__main__":
         stream_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "cd":
         cd_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "shard":
+        shard_main()
     else:
         main()

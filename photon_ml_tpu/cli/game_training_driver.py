@@ -209,6 +209,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "driver with the same args)")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--entity-shards", type=_positive_int, default=None,
+                   help="entity-sharded random-effect training: partition "
+                        "every random coordinate's entity table across this "
+                        "many processes by a stable hash of the entity id "
+                        "(must equal the controller process count — shard i "
+                        "lives on process i). Each process builds and "
+                        "solves only its owned entities; sweeps exchange "
+                        "only changed rows' scores, never coefficients "
+                        "(parallel/entity_shard.py, docs/sharding.md)")
+    p.add_argument("--re-table-budget-mb", type=float, default=None,
+                   help="per-process random-effect entity-table budget in "
+                        "MB: a coordinate whose LOCAL table exceeds it "
+                        "fails fast with a pointer at --entity-shards "
+                        "instead of silently exhausting host RAM")
     p.add_argument("--profile-dir", default=None,
                    help="capture a JAX profiler trace of training here "
                         "(view in TensorBoard/Perfetto)")
@@ -272,6 +286,24 @@ def main(argv: Sequence[str] | None = None) -> int:
     distributed = initialize_multihost(args.coordinator_address,
                                        args.num_processes, args.process_id)
     is_lead = (not distributed) or jax.process_index() == 0
+    # entity sharding is argv-validated HERE, before any data read: the
+    # owner map assigns shard i to process i, so the shard count must be
+    # the controller process count
+    entity_spec = None
+    if args.entity_shards is not None:
+        pc = jax.process_count() if distributed else 1
+        if args.entity_shards != pc:
+            raise SystemExit(
+                f"--entity-shards {args.entity_shards} must equal the "
+                f"controller process count ({pc}): the owner map assigns "
+                "entity shard i to process i (run one process per shard "
+                "via --coordinator-address/--num-processes)")
+        from photon_ml_tpu.parallel.entity_shard import EntityShardSpec
+
+        entity_spec = EntityShardSpec(
+            args.entity_shards, jax.process_index() if distributed else 0)
+    re_table_budget = (None if args.re_table_budget_mb is None
+                       else int(args.re_table_budget_mb * 1e6))
     dtype = resolve_dtype(args.dtype)
     task = TASK_TO_LOSS.get(args.task, args.task)
     os.makedirs(args.output_dir, exist_ok=True)
@@ -557,6 +589,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         task=task, n_iterations=args.n_iterations, evaluators=evaluators,
         dtype=dtype, cd_tolerance=args.cd_tolerance,
         solver_tol_schedule=args.solver_tol_schedule,
+        entity_shard=entity_spec,
+        entity_table_budget_bytes=re_table_budget,
     )
     ckpt = None
     if args.checkpoint and is_lead:
@@ -568,6 +602,12 @@ def main(argv: Sequence[str] | None = None) -> int:
                                 f"config-{gi}-iter-{it}")
             save_game_model(model, path, index_maps)
             logger.log("checkpoint", config=gi, iteration=it, path=path)
+    elif args.checkpoint and entity_spec is not None and entity_spec.active:
+        # entity-sharded checkpoints are a collective (the per-iteration
+        # model build gathers every shard's buckets): non-lead processes
+        # must still participate in the gather, they just don't write
+        def ckpt(gi, it, model):
+            del gi, it, model  # gathered; the lead wrote it
 
     def log_fit(gi, result):
         for rec in result.history:

@@ -48,6 +48,8 @@ class GameEstimator:
         verbose: bool = False,
         cd_tolerance: float = 0.0,
         solver_tol_schedule=None,
+        entity_shard=None,
+        entity_table_budget_bytes=None,
     ):
         self.task = task
         self.n_iterations = n_iterations
@@ -59,6 +61,11 @@ class GameEstimator:
         # straight to CoordinateDescent (game/descent.py)
         self.cd_tolerance = cd_tolerance
         self.solver_tol_schedule = solver_tol_schedule
+        # entity-sharded multi-controller training: this process's
+        # EntityShardSpec plus the optional per-process entity-table
+        # budget, passed straight to CoordinateDescent
+        self.entity_shard = entity_shard
+        self.entity_table_budget_bytes = entity_table_budget_bytes
 
     def fit(
         self,
@@ -91,6 +98,8 @@ class GameEstimator:
                 dataset_cache=dataset_cache,
                 cd_tolerance=self.cd_tolerance,
                 solver_tol_schedule=self.solver_tol_schedule,
+                entity_shard=self.entity_shard,
+                entity_table_budget_bytes=self.entity_table_budget_bytes,
             )
             ckpt = None
             if checkpoint_callback is not None:

@@ -199,6 +199,17 @@ class RandomEffectTrainData:
     def num_entities(self) -> int:
         return sum(b.num_entities for b in self.buckets)
 
+    def table_bytes(self) -> int:
+        """Host bytes of the padded per-entity training arrays — the
+        memory the entity sharding bounds per process (score views and
+        coefficients scale with the same entity slice)."""
+        total = 0
+        for b in self.buckets:
+            for a in (b.indices, b.values, b.labels, b.weights,
+                      b.sample_idx, b.projection):
+                total += np.asarray(a).nbytes
+        return total
+
 
 def build_random_effect_data(
     features,
@@ -212,13 +223,24 @@ def build_random_effect_data(
     projection: str = "subspace",
     projection_dim: Optional[int] = None,
     projection_seed: int = 0,
+    entity_shard=None,
 ) -> RandomEffectTrainData:
     """Group rows by entity, split active/passive, project, bucket, pad.
 
     ``projection``: "subspace" builds exact per-entity feature maps (the
     LinearSubspaceProjector role); "random" uses a shared count-sketch of
     width ``projection_dim`` (the RandomProjection role — constant-shape
-    entity problems, non-invertible)."""
+    entity problems, non-invertible).
+
+    ``entity_shard`` (a ``parallel.entity_shard.EntityShardSpec``):
+    entity-sharded multi-controller training — this process grooms and
+    buckets ONLY the entities its shard owns (stable-hash owner map);
+    rows of unowned entities never enter a bucket or score view, so the
+    per-process entity-table footprint is the owned slice. Note that
+    ``active_cap`` sampling draws from one sequential rng stream, so a
+    sharded run's sampled subsets differ from the single-host run's
+    (full-data training — no cap — is bit-compatible across shard
+    counts)."""
     sp = materialize_ones(host_sparse_from_features(features))
     labels = np.asarray(labels, np.float64)
     weights = np.asarray(weights, np.float64)
@@ -232,13 +254,19 @@ def build_random_effect_data(
     sorted_codes = codes[order]
     boundaries = np.searchsorted(sorted_codes, np.arange(len(uniq) + 1))
 
+    if entity_shard is not None and entity_shard.num_shards > 1:
+        keep = np.flatnonzero(entity_shard.owned_mask(uniq))
+    else:
+        keep = np.arange(len(uniq))
+
     active_rows: List[np.ndarray] = []
-    for e in range(len(uniq)):
+    for e in keep:
         rows = order[boundaries[e] : boundaries[e + 1]]
         if active_cap is not None and len(rows) > active_cap:
             rows = rng.choice(rows, size=active_cap, replace=False)
             rows.sort()
         active_rows.append(rows)
+    uniq = uniq[keep]
 
     # per-entity local feature maps from active data
     if projection == "random":

@@ -65,6 +65,13 @@ from photon_ml_tpu.parallel.data_parallel import (
     distributed_hvp,
     distributed_value_and_grad,
 )
+from photon_ml_tpu.parallel.entity_shard import (
+    EntityShardSpec,
+    ShardCommStats,
+    allgather_objects,
+    check_table_budget,
+    exchange_score_updates,
+)
 from photon_ml_tpu.parallel.mesh import make_mesh
 from photon_ml_tpu.parallel.resilience import CollectiveGuard
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures, margins as _margins
@@ -736,12 +743,17 @@ class _FixedState:
 
 class _RandomState:
     def __init__(self, cfg: CoordinateConfig, data: GameDataset, dtype,
-                 cache: Optional[dict] = None):
+                 cache: Optional[dict] = None,
+                 entity_shard: Optional[EntityShardSpec] = None,
+                 table_budget_bytes: Optional[int] = None):
         sp = data.features[cfg.feature_shard]
         ids = data.entity_ids[cfg.entity_column]
+        shard_key = (None if entity_shard is None
+                     else (entity_shard.num_shards, entity_shard.shard_index))
         key = ("re_data", id(data), cfg.name, cfg.feature_shard,
                cfg.entity_column, cfg.num_buckets, cfg.active_cap,
-               cfg.projection, cfg.projection_dim, cfg.projection_seed)
+               cfg.projection, cfg.projection_dim, cfg.projection_seed,
+               shard_key)
         if cache is not None and key in cache:
             # entry[0] pins the keyed dataset alive so its id() can't be
             # recycled by a different GameDataset while the cache lives
@@ -754,10 +766,17 @@ class _RandomState:
                 projection=cfg.projection,
                 projection_dim=cfg.projection_dim,
                 projection_seed=cfg.projection_seed,
+                entity_shard=entity_shard,
             )
             self.train_view = build_score_view(self.train_data, sp, ids)
             if cache is not None:
                 cache[key] = (data, self.train_data, self.train_view)
+        # fail BEFORE the first sweep when the local entity table is over
+        # the per-process budget (points at --entity-shards)
+        check_table_budget(
+            self.train_data.table_bytes(), table_budget_bytes,
+            coordinate=cfg.name,
+            num_shards=1 if entity_shard is None else entity_shard.num_shards)
         self.coeffs: Optional[List[np.ndarray]] = None
         self.variances = None
         # active-set tracking across sweeps: per-bucket boolean masks of
@@ -767,6 +786,12 @@ class _RandomState:
         # residual offsets as of each row's owning entity's last solve —
         # the drift reference for re-activation (length-n host vector)
         self.offs_snap: Optional[np.ndarray] = None
+        # entity-sharded mode: this shard's OWN score vectors (zeros on
+        # unowned rows). The published delta each sweep is the rows where
+        # these bitwise changed; the loop-facing `scores[name]` stays the
+        # assembled GLOBAL vector on every process.
+        self.local_scores: Optional[jax.Array] = None
+        self.local_val_scores: Optional[jax.Array] = None
 
 
 class CoordinateDescent:
@@ -784,6 +809,8 @@ class CoordinateDescent:
         dataset_cache: Optional[dict] = None,
         cd_tolerance: float = 0.0,
         solver_tol_schedule=None,
+        entity_shard: Optional[EntityShardSpec] = None,
+        entity_table_budget_bytes: Optional[int] = None,
     ):
         names = [c.name for c in configs]
         if len(set(names)) != len(names):
@@ -811,6 +838,15 @@ class CoordinateDescent:
         # per grid point (the reference builds coordinate datasets once and
         # reuses them across configs — SURVEY.md §4.1).
         self.dataset_cache = dataset_cache
+        # Entity-sharded multi-controller training: each process builds and
+        # solves only the random-effect entities its shard owns; sweeps
+        # exchange only changed rows' scores (parallel/entity_shard.py).
+        # ``entity_table_budget_bytes`` fails fast when any coordinate's
+        # LOCAL table exceeds the per-process budget.
+        self.entity_shard = entity_shard
+        self.entity_table_budget_bytes = entity_table_budget_bytes
+        self._sharded = entity_shard is not None and entity_shard.active
+        self._comm = ShardCommStats()
 
     # -- main loop -------------------------------------------------------
     def run(
@@ -841,8 +877,10 @@ class CoordinateDescent:
             if cfg.coordinate_type == "fixed":
                 states[cfg.name] = _FixedState(cfg, train, dtype, self.task, self.mesh)
             else:
-                states[cfg.name] = _RandomState(cfg, train, dtype,
-                                                cache=self.dataset_cache)
+                states[cfg.name] = _RandomState(
+                    cfg, train, dtype, cache=self.dataset_cache,
+                    entity_shard=self.entity_shard,
+                    table_budget_bytes=self.entity_table_budget_bytes)
 
         val_states: Dict[str, object] = {}
         val_feats: Dict[str, SparseFeatures] = {}
@@ -871,9 +909,33 @@ class CoordinateDescent:
         scores = {c.name: jnp.zeros((n,), dtype) for c in self.configs}
         val_n = validation.num_samples if validation is not None else 0
         val_scores = {c.name: jnp.zeros((val_n,), dtype) for c in self.configs}
+        if self._sharded:
+            for cfg in self.configs:
+                if cfg.coordinate_type == "random":
+                    st = states[cfg.name]
+                    st.local_scores = jnp.zeros((n,), dtype)
+                    st.local_val_scores = jnp.zeros((val_n,), dtype)
         if warm_start is not None:
             self._load_warm_start(warm_start, states, scores, val_scores,
                                   train, validation, val_states, val_feats)
+            if self._sharded:
+                # _load_warm_start fills each sharded random coordinate's
+                # scores with the LOCAL (owned-rows-only) vector; publish
+                # every shard's rows once so the loop starts from the same
+                # global vector on every process
+                for cfg in self.configs:
+                    if (cfg.coordinate_type != "random"
+                            or warm_start.coordinates.get(cfg.name) is None):
+                        continue
+                    st = states[cfg.name]
+                    has_val = validation is not None and cfg.name in val_states
+                    scores[cfg.name], val_scores[cfg.name], _, _ = (
+                        self._exchange_scores(
+                            f"warm:{cfg.name}", st, scores[cfg.name],
+                            jnp.zeros((n,), dtype),
+                            val_scores[cfg.name] if has_val else None,
+                            jnp.zeros((val_n,), dtype) if has_val
+                            else val_scores[cfg.name]))
 
         base = jnp.asarray(train.offsets, dtype)
         history: List[dict] = []
@@ -959,6 +1021,8 @@ class CoordinateDescent:
                                 # stall breakdown (decode-wait / transfer /
                                 # compute-stall seconds) rides the history
                                 record["stream"] = res.stream_stats
+                                record["comm_seconds"] = (
+                                    res.stream_stats.get("comm_s", 0.0))
                             w_model = st.model_space_w()
                             new_scores = st.train_scores(w_model)
                             score_delta = float(jnp.max(jnp.abs(
@@ -975,6 +1039,11 @@ class CoordinateDescent:
                                 cfg, st, it, offs, run_cfg, scores,
                                 val_scores, val_states, rt, vt, n, val_n,
                                 validation, entity_mesh, _eps, record)
+                    # comm_seconds rides every record (next to the solve/
+                    # eval split): cross-shard score-exchange seconds for
+                    # sharded random coordinates, the streamed pass's
+                    # cross-process reduction for fixed ones, 0 otherwise
+                    record.setdefault("comm_seconds", 0.0)
                     record["solve_seconds"] = time.time() - t0
                     t_eval = time.time()
                     if vt is not None:
@@ -1038,12 +1107,23 @@ class CoordinateDescent:
                      val_states, rt, vt, n, val_n, validation, entity_mesh,
                      eps, record) -> float:
         """One random-effect coordinate step with active-set freezing and
-        incremental rescoring. Returns the coordinate's score delta."""
+        incremental rescoring. Returns the coordinate's score delta.
+
+        Entity-sharded mode: the solve/rescore below run over this
+        shard's OWNED entities only; the step then publishes the rows
+        whose local score bitwise changed and scatter-applies every
+        shard's published rows into the global score vector — the
+        delta-only exchange (parallel/entity_shard.py). The exchange
+        runs EVERY sweep (possibly with an empty payload) so the
+        collective stays SPMD-aligned whatever each shard's local
+        frontier looks like."""
+        sharded = self._sharded
         refresh = (st.coeffs is None or st.frozen is None
                    or st.offs_snap is None or not cfg.active_set
                    or it % cfg.refresh_every == 0)
         active = None
         offs_np = None
+        solve = True
         if not refresh:
             offs_np = np.asarray(offs)
             tol = (cfg.active_tol if cfg.active_tol is not None else 0.0)
@@ -1054,68 +1134,145 @@ class CoordinateDescent:
             active = _drift_active_masks(st.train_data.buckets, st.frozen,
                                          offs_np, st.offs_snap, tol)
             if sum(int(a.sum()) for a in active) == 0:
-                # every entity frozen with stationary offsets: the
-                # coordinate is skipped outright — no solve, no rescore,
-                # zero device work this sweep
+                # every local entity frozen with stationary offsets: the
+                # solve and rescore are skipped outright — no device work
                 record.update(converged_fraction=1.0,
                               mean_optimizer_iterations=0.0,
                               entities_solved=0, refresh=False)
-                return 0.0
-        reg = cfg.reg_context()
-        fit = train_random_effect(
-            st.train_data, offs, task=self.task,
-            l2=reg.l2_weight(cfg.reg_weight),
-            l1=reg.l1_weight(cfg.reg_weight),
-            optimizer=cfg.optimizer,
-            config=run_cfg if run_cfg is not None else cfg.opt_config(),
-            w0=st.coeffs, mesh=entity_mesh,
-            compute_variance=cfg.compute_variance, dtype=self.dtype,
-            normalization=cfg.normalization,
-            active=active, prev_variances=st.variances,
-        )
-        if cfg.active_set:
-            st.frozen = [np.asarray(c) for c in fit.converged]
-            if offs_np is None:
-                offs_np = np.asarray(offs)
-            if active is None or st.offs_snap is None:
-                st.offs_snap = np.array(offs_np, copy=True)
-            else:
-                # re-solved entities get a fresh drift reference; frozen
-                # ones keep the offsets they last solved against
-                for b, bucket in enumerate(st.train_data.buckets):
-                    if bucket.num_entities == 0 or not active[b].any():
-                        continue
-                    rows = bucket.sample_idx[active[b]]
-                    rows = rows[rows >= 0]
-                    st.offs_snap[rows] = offs_np[rows]
-        st.coeffs = fit.coefficients
-        st.variances = fit.variances
-        record.update(
-            converged_fraction=fit.converged_fraction,
-            mean_optimizer_iterations=fit.mean_iterations,
-            entities_solved=fit.entities_solved,
-            refresh=bool(refresh),
-        )
-        # incremental rescoring after a partial solve: only rows owned by
-        # re-solved entities are recomputed and scatter-overwritten into
-        # the previous score vector
-        new_scores = score_random_effect(
-            st.train_view, st.coeffs, n, self.dtype,
-            prev=None if active is None else scores[cfg.name],
-            changed=active)
-        delta = (float(jnp.max(jnp.abs(new_scores - scores[cfg.name])))
-                 if n else 0.0)
-        rt.replace(scores[cfg.name], new_scores)
-        scores[cfg.name] = new_scores
-        if validation is not None and cfg.name in val_states:
-            new_v = score_random_effect(
-                val_states[cfg.name], st.coeffs, val_n, self.dtype,
-                prev=None if active is None else val_scores[cfg.name],
+                if not sharded:
+                    return 0.0
+                solve = False  # still participates in the exchange below
+        prev_local = st.local_scores if sharded else scores[cfg.name]
+        prev_val_local = (st.local_val_scores if sharded
+                          else val_scores.get(cfg.name))
+        new_local = prev_local
+        new_val_local = None
+        if solve:
+            reg = cfg.reg_context()
+            fit = train_random_effect(
+                st.train_data, offs, task=self.task,
+                l2=reg.l2_weight(cfg.reg_weight),
+                l1=reg.l1_weight(cfg.reg_weight),
+                optimizer=cfg.optimizer,
+                config=run_cfg if run_cfg is not None else cfg.opt_config(),
+                w0=st.coeffs, mesh=entity_mesh,
+                compute_variance=cfg.compute_variance, dtype=self.dtype,
+                normalization=cfg.normalization,
+                active=active, prev_variances=st.variances,
+            )
+            if cfg.active_set:
+                st.frozen = [np.asarray(c) for c in fit.converged]
+                if offs_np is None:
+                    offs_np = np.asarray(offs)
+                if active is None or st.offs_snap is None:
+                    st.offs_snap = np.array(offs_np, copy=True)
+                else:
+                    # re-solved entities get a fresh drift reference; frozen
+                    # ones keep the offsets they last solved against
+                    for b, bucket in enumerate(st.train_data.buckets):
+                        if bucket.num_entities == 0 or not active[b].any():
+                            continue
+                        rows = bucket.sample_idx[active[b]]
+                        rows = rows[rows >= 0]
+                        st.offs_snap[rows] = offs_np[rows]
+            st.coeffs = fit.coefficients
+            st.variances = fit.variances
+            record.update(
+                converged_fraction=fit.converged_fraction,
+                mean_optimizer_iterations=fit.mean_iterations,
+                entities_solved=fit.entities_solved,
+                refresh=bool(refresh),
+            )
+            # incremental rescoring after a partial solve: only rows owned
+            # by re-solved entities are recomputed and scatter-overwritten
+            # into the previous score vector (the LOCAL vector when
+            # sharded — unowned rows stay zero there)
+            new_local = score_random_effect(
+                st.train_view, st.coeffs, n, self.dtype,
+                prev=None if active is None else prev_local,
                 changed=active)
+            if validation is not None and cfg.name in val_states:
+                new_val_local = score_random_effect(
+                    val_states[cfg.name], st.coeffs, val_n, self.dtype,
+                    prev=None if active is None else prev_val_local,
+                    changed=active)
+
+        if not sharded:
+            delta = (float(jnp.max(jnp.abs(new_local - scores[cfg.name])))
+                     if n else 0.0)
+            rt.replace(scores[cfg.name], new_local)
+            scores[cfg.name] = new_local
+            if new_val_local is not None:
+                if vt is not None:
+                    vt.replace(val_scores[cfg.name], new_val_local)
+                val_scores[cfg.name] = new_val_local
+            return delta
+
+        # -- entity-sharded: delta-only cross-shard exchange ---------------
+        has_val = validation is not None and cfg.name in val_states
+        if has_val and new_val_local is None:
+            new_val_local = st.local_val_scores  # skipped solve: unchanged
+        new_global, new_val_global, comm_bytes, comm_s = (
+            self._exchange_scores(
+                f"cd:{it}:{cfg.name}", st, new_local, scores[cfg.name],
+                new_val_local if has_val else None,
+                val_scores[cfg.name]))
+        record["comm_seconds"] = comm_s
+        record["comm_bytes"] = comm_bytes
+        delta = (float(jnp.max(jnp.abs(new_global - scores[cfg.name])))
+                 if n else 0.0)
+        rt.replace(scores[cfg.name], new_global)
+        scores[cfg.name] = new_global
+        if has_val:
             if vt is not None:
-                vt.replace(val_scores[cfg.name], new_v)
-            val_scores[cfg.name] = new_v
+                vt.replace(val_scores[cfg.name], new_val_global)
+            val_scores[cfg.name] = new_val_global
         return delta
+
+    def _exchange_scores(self, tag, st, new_local, prev_global,
+                         new_val_local, prev_val_global):
+        """Publish this shard's bitwise-changed rows (train + validation)
+        and scatter the union of every shard's published rows into the
+        global vectors. Each row's entity has exactly one owner, so the
+        row sets are disjoint and the scatter lands on the bit-identical
+        vector the single-host loop computes; rows whose recomputed score
+        equals the previous value are not shipped at all — that is what
+        keeps per-sweep bytes proportional to the moving frontier, not
+        the table."""
+        new_np = np.asarray(new_local)
+        old_np = np.asarray(st.local_scores)
+        rows = np.flatnonzero(new_np != old_np).astype(np.int32)
+        vals = new_np[rows]
+        if new_val_local is not None:
+            vnew = np.asarray(new_val_local)
+            vold = np.asarray(st.local_val_scores)
+            vrows = np.flatnonzero(vnew != vold).astype(np.int32)
+            vvals = vnew[vrows]
+        else:
+            vrows = np.zeros(0, np.int32)
+            vvals = np.zeros(0, new_np.dtype)
+        b0, t0 = self._comm.bytes_gathered, self._comm.seconds
+        gathered = exchange_score_updates([rows, vals, vrows, vvals],
+                                          tag=tag, stats=self._comm)
+        comm_bytes = self._comm.bytes_gathered - b0
+        comm_s = self._comm.seconds - t0
+        all_rows = np.concatenate([g[0] for g in gathered])
+        all_vals = np.concatenate([g[1] for g in gathered])
+        g_np = np.array(np.asarray(prev_global), copy=True)
+        if len(all_rows):
+            g_np[all_rows] = all_vals
+        new_global = jnp.asarray(g_np)
+        new_val_global = prev_val_global
+        if new_val_local is not None:
+            av_rows = np.concatenate([g[2] for g in gathered])
+            av_vals = np.concatenate([g[3] for g in gathered])
+            v_np = np.array(np.asarray(prev_val_global), copy=True)
+            if len(av_rows):
+                v_np[av_rows] = av_vals
+            new_val_global = jnp.asarray(v_np)
+            st.local_val_scores = new_val_local
+        st.local_scores = new_local
+        return new_global, new_val_global, comm_bytes, comm_s
 
     def _build_model(self, states) -> GameModel:
         coords = {}
@@ -1142,6 +1299,20 @@ class CoordinateDescent:
                             sketch=lm0 if isinstance(lm0, SketchProjection) else None,
                         )
                     )
+                if self._sharded:
+                    # the ONE place the full entity table crosses the wire:
+                    # save points (checkpoints + the final model), never
+                    # per sweep. Every process merges the same rank-ordered
+                    # buckets, so checkpoints and the saved model keep the
+                    # single-file io/model_io layout (serving/registry
+                    # unchanged) and every process returns the same model.
+                    # Collective: in a sharded run EVERY process must reach
+                    # _build_model at the same points (run() does; sharded
+                    # drivers give non-lead processes a no-op checkpoint
+                    # callback so the gather stays aligned).
+                    gathered = allgather_objects(
+                        buckets, tag=f"model:{cfg.name}", stats=self._comm)
+                    buckets = [b for shard in gathered for b in shard]
                 coords[cfg.name] = RandomEffectModel(
                     cfg.name, buckets, self.task, cfg.feature_shard,
                     entity_column=cfg.entity_column,

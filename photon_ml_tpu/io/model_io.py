@@ -73,7 +73,14 @@ def save_game_model(
     resume/scoring would find it. Overwrites swap via two renames; a
     crash in that window leaves the previous COMPLETE tree at
     '{path}.old-{pid}', which checkpoint discovery counts as its base
-    name (game_training_driver._latest_checkpoint)."""
+    name (game_training_driver._latest_checkpoint).
+
+    Entity-sharded training (docs/sharding.md) keeps this single-file
+    layout unchanged: ``descent._build_model`` gathers every shard's
+    random-effect buckets into the full table at each save point, so the
+    ``model`` every process hands here is already complete — only the
+    lead process should actually call this (shared output path), which
+    the drivers enforce."""
     import shutil
 
     tmp = f"{directory}.tmp-{os.getpid()}"

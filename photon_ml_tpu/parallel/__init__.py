@@ -15,3 +15,8 @@ from photon_ml_tpu.parallel.resilience import (
     health_barrier,
     retry_transient,
 )
+from photon_ml_tpu.parallel.entity_shard import (
+    EntityShardSpec,
+    EntityTableBudgetError,
+    ShardCommStats,
+)

@@ -38,9 +38,14 @@ def make_mesh(axis_sizes: Mapping[str, int] | None = None, devices=None) -> Mesh
         axis_sizes = {"data": len(devices)}
     names = tuple(axis_sizes)
     sizes = tuple(axis_sizes[n] for n in names)
+    for name, size in zip(names, sizes):
+        if size < 1:
+            raise ValueError(f"mesh axis '{name}' must be >= 1, got {size}")
     total = math.prod(sizes)
     if total > len(devices):
-        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need {total} devices, "
+            f"have {len(devices)} — shrink an axis or pass more devices")
     dev_array = np.asarray(devices[:total]).reshape(sizes)
     return Mesh(dev_array, names)
 

@@ -81,12 +81,16 @@ class StreamStats:
     source (disk decode or the source's own producer queue);
     ``transfer_s``: seconds issuing budget-accounted host->device puts;
     ``stall_s``: consumer seconds blocked on an empty ring — the compute
-    dispatcher starved of staged data. All three accumulate across every
-    pass of a fit; ``passes``/``chunks`` normalize them."""
+    dispatcher starved of staged data; ``comm_s``: seconds in the
+    once-per-pass cross-process reduction of the streamed partials (0 in
+    single-process runs) — the stall-accounting leg the entity-sharded
+    CD's ``comm_seconds`` mirrors. All accumulate across every pass of a
+    fit; ``passes``/``chunks`` normalize them."""
 
     decode_s: float = 0.0
     transfer_s: float = 0.0
     stall_s: float = 0.0
+    comm_s: float = 0.0
     chunks: int = 0
     passes: int = 0
 
@@ -94,6 +98,7 @@ class StreamStats:
         return {"decode_s": round(self.decode_s, 6),
                 "transfer_s": round(self.transfer_s, 6),
                 "stall_s": round(self.stall_s, 6),
+                "comm_s": round(self.comm_s, 6),
                 "chunks": self.chunks, "passes": self.passes}
 
 
@@ -285,20 +290,25 @@ def make_host_chunks(
     return chunks, dim
 
 
-def _cross_process_sum(tree):
+def _cross_process_sum(tree, stats: Optional[StreamStats] = None):
     """Sum accumulator pytrees across processes (multi-controller runtime).
 
     Single-process: identity. Multi-process: each process streams only its
     own row span (``multihost.process_span``), then the per-process partials
     are reduced here — the DCN leg of the reference's ``treeAggregate``
     (SURVEY.md §5.8). Uses allgather+sum of [d]-sized partials, negligible
-    next to the per-chunk compute."""
+    next to the per-chunk compute; the time still lands in
+    ``StreamStats.comm_s`` so a multi-host stall is attributable."""
     if jax.process_count() == 1:
         return tree
+    t0 = time.perf_counter()
     from jax.experimental import multihost_utils
 
     gathered = multihost_utils.process_allgather(tree)
-    return jax.tree.map(lambda a: jnp.asarray(a).sum(axis=0), gathered)
+    out = jax.tree.map(lambda a: jnp.asarray(a).sum(axis=0), gathered)
+    if stats is not None:
+        stats.comm_s += time.perf_counter() - t0
+    return out
 
 
 def _chunk_to_device(chunk: HostChunk, dim: int, dtype, sharding) -> LabeledBatch:
@@ -506,7 +516,7 @@ def streaming_value_and_grad(
             # ONE cross-shard reduction per pass; its output is consumed by
             # the host right away, so at most one collective is in flight
             f_acc, g_acc = reduce_k(*acc)
-        f_acc, g_acc = _cross_process_sum((f_acc, g_acc))
+        f_acc, g_acc = _cross_process_sum((f_acc, g_acc), stats)
         wr = objective._reg_mask(w)
         l2 = jnp.asarray(l2, dtype)
         return f_acc + 0.5 * l2 * jnp.sum(wr * wr), g_acc + l2 * wr
@@ -564,7 +574,7 @@ def streaming_hvp(
                 acc, comp = chunk_hvp_k((w, v), *_batch_args(dev), acc,
                                         comp)
             total = reduce_k(acc, comp)
-        total = _cross_process_sum(total)
+        total = _cross_process_sum(total, stats)
         return total + jnp.asarray(l2, dtype) * objective._reg_mask(v)
 
     return hvp
@@ -637,7 +647,7 @@ def streaming_hessian_diagonal(
                 prefetch_depth, stats):
             acc, comp = chunk_diag_k(w, *_batch_args(dev), acc, comp)
         total = reduce_k(acc, comp)
-    total = _cross_process_sum(total)
+    total = _cross_process_sum(total, stats)
     reg = jnp.full((dim,), jnp.asarray(l2, dtype))
     if not objective.regularize_intercept and objective.intercept_index >= 0:
         reg = reg.at[objective.intercept_index].set(0.0)
@@ -1002,7 +1012,7 @@ def _fit_streaming_lbfgs_margin(objective, chunks, dim, w0, l2, config,
                     _put(labels_h[i]), _put(weights_h[i]),
                     f_acc, f_comp)
             total = trial_reduce_k(f_acc, f_comp)
-        (d,) = _cross_process_sum((total,))
+        (d,) = _cross_process_sum((total,), stats)
         return np.asarray(d, np.float64)
 
     direction, store_pair = _lbfgs_stream_kernels(objective, mesh, axis, m)

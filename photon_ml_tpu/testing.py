@@ -237,6 +237,16 @@ class ThreadTransport:
     def allgather_status(self, code: int, timeout: float) -> List[int]:
         return self._group.exchange(self._rank, code, timeout)
 
+    def allgather_payload(self, payload, timeout: float) -> list:
+        """Generation-counted N-way PAYLOAD exchange (the data leg of the
+        simulated transport, used by the entity-shard score exchange):
+        returns every process's payload in rank order. It shares the
+        status-exchange rendezvous, so payload and status collectives
+        stay SPMD-ordered exactly like the real runtime's in-order
+        collective stream — and a peer that never arrives surfaces as
+        WatchdogTimeout here too."""
+        return self._group.exchange(self._rank, payload, timeout)
+
 
 def run_simulated_processes(n: int, fn: Callable, *,
                             join_timeout: float = 120.0) -> list:

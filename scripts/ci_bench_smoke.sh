@@ -7,12 +7,31 @@
 #             compile count
 #   cd      — active-set CD >= 1.5x full sweeps, f64 coefficient parity
 #             <= 1e-9, 0 RE-solver compiles across the timed active run
+#   shard   — 2-process simulated entity-sharded training (exit 8,
+#             distinct from the serving leg's 7): f64 coefficients
+#             BIT-equal to the single-process fit, a nonzero
+#             communicated-bytes counter >= 10x under full-table
+#             shipping, per-process peak table < single-process, and
+#             the table budget refusing the unsharded run
 #   serving — in-process async open-loop sweep: rows/s >= the floor
 #             (BENCH_SERVING_FLOOR, default 15000), 0 compile misses in
 #             steady state AND across a mid-load hot swap, 2x-overload
 #             soak sheds with 429s and zero scoring-path 5xx
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# the smoke runs must not clobber the full-run bench artifacts (restore
+# them whether or not a smoke acceptance gate passes — previously only
+# the serving artifact was protected, so a smoke run silently replaced
+# BENCH_stream/cd with smoke-sized records)
+SNAPSHOT="$(mktemp -d)"
+for f in BENCH_stream.json BENCH_cd.json BENCH_shard.json BENCH_serving.json; do
+  cp "$f" "$SNAPSHOT/" 2>/dev/null || true
+done
+restore() {
+  cp "$SNAPSHOT"/BENCH_*.json . 2>/dev/null || true
+  rm -rf "$SNAPSHOT"
+}
+trap restore EXIT
 JAX_PLATFORMS=cpu \
 BENCH_STREAM_ROWS="${BENCH_STREAM_ROWS:-8000}" \
 BENCH_STREAM_FIT_ITERS="${BENCH_STREAM_FIT_ITERS:-3}" \
@@ -21,15 +40,14 @@ JAX_PLATFORMS=cpu \
 BENCH_CD_ENTITIES="${BENCH_CD_ENTITIES:-1200}" \
 BENCH_CD_SWEEPS="${BENCH_CD_SWEEPS:-24}" \
 timeout -k 10 600 python bench.py cd
-# the smoke run must not clobber the full-run bench artifact (restore it
-# whether or not the smoke's acceptance gate passes)
-SERVING_SNAPSHOT="$(mktemp -d)"
-cp BENCH_serving.json "$SERVING_SNAPSHOT/" 2>/dev/null || true
+JAX_PLATFORMS=cpu \
+BENCH_SHARD_ENTITIES="${BENCH_SHARD_ENTITIES:-256}" \
+BENCH_SHARD_SWEEPS="${BENCH_SHARD_SWEEPS:-10}" \
+BENCH_SHARD_PROCS="${BENCH_SHARD_PROCS:-2}" \
+timeout -k 10 600 python bench.py shard
 serving_rc=0
 JAX_PLATFORMS=cpu \
 BENCH_SERVING_SMOKE=1 \
 BENCH_SERVING_FLOOR="${BENCH_SERVING_FLOOR:-15000}" \
 timeout -k 10 600 python bench.py serving || serving_rc=$?
-cp "$SERVING_SNAPSHOT/BENCH_serving.json" . 2>/dev/null || true
-rm -rf "$SERVING_SNAPSHOT"
 exit "$serving_rc"

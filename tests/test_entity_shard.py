@@ -1,0 +1,378 @@
+"""Entity-sharded random-effect training (parallel/entity_shard.py +
+game/descent.py wiring): owner-map determinism, delta-only score
+exchange on the simulated multi-controller runtime, f64 bit parity vs
+the single-host fit, table-budget enforcement, save/warm-start round
+trips, and coordinated aborts at the new collective boundary."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.data import build_random_effect_data
+from photon_ml_tpu.game.descent import (
+    CoordinateConfig,
+    CoordinateDescent,
+    make_game_dataset,
+)
+from photon_ml_tpu.parallel import fault_injection
+from photon_ml_tpu.parallel.entity_shard import (
+    EntityShardSpec,
+    EntityTableBudgetError,
+    ShardCommStats,
+    allgather_objects,
+    exchange_score_updates,
+    stable_entity_hash,
+)
+from photon_ml_tpu.parallel.resilience import PeerFailure
+from photon_ml_tpu.testing import run_simulated_processes
+
+
+# -- shared synthetic workload ---------------------------------------------
+# EQUAL rows per entity and fully dense RE features: every entity's padded
+# solve shapes are identical whatever the bucket composition, so sharded
+# coefficients must match the single-host fit BIT-exactly (the vmapped
+# L-BFGS RE solver's kernels are width-invariant; batched-LU newton is
+# not — docs/sharding.md). Sized small: tier-1 budget.
+N_ENTITIES, ROWS_PER_ENTITY, D_G, D_U = 24, 4, 4, 6
+
+
+def _make_dataset(seed=0, n_entities=N_ENTITIES, with_val=False):
+    rng = np.random.default_rng(seed)
+    w_fixed = rng.normal(size=D_G)
+    U = rng.normal(size=(n_entities, D_U))
+
+    def block(rows_per_entity):
+        Xg, Xu, y, uid = [], [], [], []
+        for u in range(n_entities):
+            xg = rng.normal(size=(rows_per_entity, D_G))
+            xu = rng.normal(size=(rows_per_entity, D_U))
+            marg = xg @ w_fixed + xu @ U[u]
+            y.append((rng.random(rows_per_entity)
+                      < 1 / (1 + np.exp(-marg))).astype(float))
+            Xg.append(xg)
+            Xu.append(xu)
+            uid.append(np.full(rows_per_entity, u))
+        Xg, Xu, y, uid = map(np.concatenate, (Xg, Xu, y, uid))
+        return make_game_dataset({"g": Xg, "u": Xu}, y,
+                                 entity_ids={"userId": uid})
+
+    train = block(ROWS_PER_ENTITY)
+    val = block(3) if with_val else None
+    return train, val
+
+
+def _configs(optimizer="lbfgs", active_set=True):
+    return [
+        CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                         reg_weight=2.0, tolerance=1e-10, max_iters=40),
+        CoordinateConfig("per-user", coordinate_type="random",
+                         feature_shard="u", entity_column="userId",
+                         reg_type="l2", reg_weight=2.0, tolerance=1e-9,
+                         max_iters=40, num_buckets=2,
+                         optimizer=optimizer, active_set=active_set,
+                         refresh_every=3, active_tol=1e-10),
+    ]
+
+
+def _coeff_map(model):
+    out = {}
+    for b in model.coordinates["per-user"].buckets:
+        proj = np.asarray(b.projection)
+        C = np.asarray(b.coefficients)
+        for r, eid in enumerate(b.entity_ids):
+            valid = proj[r] >= 0
+            w = np.zeros(D_U)
+            w[proj[r][valid]] = C[r][valid]
+            out[str(eid)] = w
+    return out
+
+
+def _run_cd(ds, val=None, spec=None, sweeps=4, budget=None, warm=None,
+            evaluators=(), ckpt=None):
+    cd = CoordinateDescent(
+        _configs(), task="logistic", n_iterations=sweeps,
+        dtype=jnp.float64, entity_shard=spec, evaluators=list(evaluators),
+        entity_table_budget_bytes=budget)
+    return cd.run(ds, validation=val, warm_start=warm,
+                  checkpoint_callback=ckpt)
+
+
+def _assert_all_ok(outcomes):
+    from photon_ml_tpu.testing import Dropped
+
+    for i, o in enumerate(outcomes):
+        assert not isinstance(o, BaseException), (
+            f"simulated process {i} failed: {o!r}")
+        assert not isinstance(o, Dropped), f"simulated process {i} dropped"
+
+
+# -- owner map --------------------------------------------------------------
+def test_stable_hash_deterministic_across_dtypes_and_calls():
+    ids = np.arange(100)
+    h1 = stable_entity_hash(ids)
+    h2 = stable_entity_hash(ids)
+    np.testing.assert_array_equal(h1, h2)
+    # string ids hash through FNV-1a and are deterministic too
+    s1 = stable_entity_hash(np.asarray([f"user-{i}" for i in range(20)]))
+    s2 = stable_entity_hash(np.asarray([f"user-{i}" for i in range(20)]))
+    np.testing.assert_array_equal(s1, s2)
+    assert len(set(s1.tolist())) == 20  # no trivial collisions
+
+
+def test_owned_masks_partition_entities():
+    ids = np.arange(257)
+    masks = [EntityShardSpec(4, i).owned_mask(ids) for i in range(4)]
+    total = np.sum(masks, axis=0)
+    np.testing.assert_array_equal(total, np.ones(257))
+    # every shard owns a nontrivial slice at this size
+    assert all(m.sum() > 0 for m in masks)
+
+
+def test_shard_spec_validation():
+    with pytest.raises(ValueError, match="num_shards"):
+        EntityShardSpec(0, 0)
+    with pytest.raises(ValueError, match="shard_index"):
+        EntityShardSpec(2, 2)
+    assert not EntityShardSpec(1, 0).active
+    assert EntityShardSpec(2, 1).active
+
+
+def test_build_random_effect_data_sharded_partitions_entities():
+    ds, _ = _make_dataset()
+    sp = ds.features["u"]
+    ids = ds.entity_ids["userId"]
+    full = build_random_effect_data(sp, ds.labels, ds.weights, ids)
+    shards = [
+        build_random_effect_data(sp, ds.labels, ds.weights, ids,
+                                 entity_shard=EntityShardSpec(4, i))
+        for i in range(4)
+    ]
+    all_ids = sorted(str(e) for s in shards for b in s.buckets
+                     for e in b.entity_ids)
+    full_ids = sorted(str(e) for b in full.buckets for e in b.entity_ids)
+    assert all_ids == full_ids  # disjoint union == full entity set
+    assert sum(s.num_entities for s in shards) == full.num_entities
+    # the memory claim: every shard's table is strictly smaller
+    for s in shards:
+        assert 0 < s.table_bytes() < full.table_bytes()
+
+
+# -- exchange primitives ----------------------------------------------------
+def test_exchange_score_updates_single_process_identity():
+    rows = np.asarray([3, 5], np.int32)
+    vals = np.asarray([1.5, -2.0])
+    stats = ShardCommStats()
+    out = exchange_score_updates([rows, vals], tag="t", stats=stats)
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0][0], rows)
+    np.testing.assert_array_equal(out[0][1], vals)
+    assert stats.exchanges == 1 and stats.bytes_sent > 0
+
+
+def test_exchange_score_updates_simulated_multiprocess():
+    def fn(rank):
+        rows = np.asarray([rank * 2, rank * 2 + 1], np.int32)
+        vals = np.asarray([float(rank), float(rank) + 0.5])
+        got = exchange_score_updates([rows, vals], tag="t")
+        return [(g[0].tolist(), g[1].tolist()) for g in got]
+
+    outs = run_simulated_processes(3, fn)
+    _assert_all_ok(outs)
+    # every process sees every shard's payload, rank-ordered
+    for o in outs:
+        assert o == outs[0]
+        assert o[2] == ([4, 5], [2.0, 2.5])
+
+
+def test_allgather_objects_roundtrip_simulated():
+    def fn(rank):
+        return allgather_objects({"rank": rank, "arr": np.arange(rank + 1)},
+                                 tag="m")
+
+    outs = run_simulated_processes(2, fn)
+    _assert_all_ok(outs)
+    assert [o["rank"] for o in outs[0]] == [0, 1]
+    np.testing.assert_array_equal(outs[0][1]["arr"], np.arange(2))
+
+
+def test_exchange_fault_becomes_coordinated_abort():
+    """A fault at the new collective boundary (the score exchange) on ONE
+    process surfaces as PeerFailure on EVERY process — the PR-1 contract
+    extended to the sharding layer."""
+    ds, _ = _make_dataset()
+    fault_injection.install([fault_injection.Fault(
+        site="entity_shard.exchange", process=1, at=0)])
+    try:
+        outs = run_simulated_processes(
+            2, lambda rank: _run_cd(ds, spec=EntityShardSpec(2, rank),
+                                    sweeps=2))
+    finally:
+        fault_injection.clear()
+    assert all(isinstance(o, PeerFailure) for o in outs), outs
+
+
+# -- end-to-end parity ------------------------------------------------------
+@pytest.fixture(scope="module")
+def sharded_vs_single():
+    ds, val = _make_dataset(with_val=True)
+    m_ref, h_ref = CoordinateDescent(
+        _configs(), task="logistic", n_iterations=3, dtype=jnp.float64,
+        evaluators=["auc"]).run(ds, validation=val)
+
+    def fn(rank):
+        return CoordinateDescent(
+            _configs(), task="logistic", n_iterations=3, dtype=jnp.float64,
+            evaluators=["auc"],
+            entity_shard=EntityShardSpec(2, rank)).run(ds, validation=val)
+
+    outs = run_simulated_processes(2, fn, join_timeout=600)
+    _assert_all_ok(outs)
+    return ds, val, m_ref, h_ref, outs
+
+
+def test_sharded_coefficients_bit_equal_single_host(sharded_vs_single):
+    _, _, m_ref, _, outs = sharded_vs_single
+    ref = _coeff_map(m_ref)
+    for m, _h in outs:
+        got = _coeff_map(m)
+        assert set(got) == set(ref)
+        assert max(float(np.max(np.abs(got[k] - ref[k])))
+                   for k in ref) == 0.0
+        np.testing.assert_array_equal(
+            np.asarray(m.coordinates["fixed"].model.coefficients.means),
+            np.asarray(m_ref.coordinates["fixed"].model.coefficients.means))
+
+
+def test_sharded_validation_metrics_match_single_host(sharded_vs_single):
+    """Validation is scored from the same assembled global vectors, so
+    the tracked metrics are identical to the single-host run's."""
+    _, _, _, h_ref, outs = sharded_vs_single
+    ref_auc = [r["auc"] for r in h_ref if "auc" in r]
+    assert ref_auc
+    for _m, h in outs:
+        assert [r["auc"] for r in h if "auc" in r] == ref_auc
+
+
+def test_sharded_history_carries_comm_accounting(sharded_vs_single):
+    _, _, _, h_ref, outs = sharded_vs_single
+    # single host: comm_seconds present (0.0), no exchange bytes
+    assert all("comm_seconds" in r for r in h_ref)
+    assert all(r["comm_seconds"] == 0.0 for r in h_ref)
+    _m, h = outs[0]
+    re_records = [r for r in h if r["coordinate"] == "per-user"]
+    assert all("comm_bytes" in r and "comm_seconds" in r
+               for r in re_records)
+    assert sum(r["comm_bytes"] for r in re_records) > 0
+
+
+def test_sharded_model_save_load_roundtrip(sharded_vs_single, tmp_path):
+    """The gathered model keeps the single-file io/model_io layout:
+    every entity present, and a load round-trips the coefficients."""
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+
+    _, _, m_ref, _, outs = sharded_vs_single
+    m_sharded, _ = outs[0]
+    assert (m_sharded.coordinates["per-user"].num_entities
+            == m_ref.coordinates["per-user"].num_entities)
+    path = str(tmp_path / "model")
+    save_game_model(m_sharded, path, {
+        "g": IndexMap({f"g{j}": j for j in range(D_G)}),
+        "u": IndexMap({f"u{j}": j for j in range(D_U)}),
+    })
+    loaded = load_game_model(path)
+    ref = _coeff_map(m_ref)
+    got = _coeff_map(loaded)
+    assert set(got) == set(ref)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=0, atol=1e-12)
+
+
+def test_sharded_warm_start_matches_single_host(sharded_vs_single):
+    """Resume path: warm-starting a sharded run from the (full, saved)
+    model redistributes each shard's owned entities and continues
+    bit-identically to a warm-started single-host run."""
+    ds, val, m_ref, _, _ = sharded_vs_single
+    m1, _ = CoordinateDescent(
+        _configs(), task="logistic", n_iterations=1,
+        dtype=jnp.float64).run(ds, validation=val, warm_start=m_ref)
+
+    def fn(rank):
+        return CoordinateDescent(
+            _configs(), task="logistic", n_iterations=1, dtype=jnp.float64,
+            entity_shard=EntityShardSpec(2, rank)).run(
+                ds, validation=val, warm_start=m_ref)
+
+    outs = run_simulated_processes(2, fn, join_timeout=600)
+    _assert_all_ok(outs)
+    ref = _coeff_map(m1)
+    for m, _h in outs:
+        got = _coeff_map(m)
+        assert max(float(np.max(np.abs(got[k] - ref[k])))
+                   for k in ref) == 0.0
+
+
+def test_sharded_checkpoint_callback_gathers_full_model():
+    """Per-iteration checkpoints see the gathered FULL model on every
+    process (the driver's non-lead no-op callback relies on this)."""
+    ds, _ = _make_dataset()
+
+    def fn(rank):
+        seen = []
+        _run_cd(ds, spec=EntityShardSpec(2, rank), sweeps=2,
+                ckpt=lambda it, model: seen.append(
+                    model.coordinates["per-user"].num_entities))
+        return seen
+
+    outs = run_simulated_processes(2, fn, join_timeout=600)
+    _assert_all_ok(outs)
+    assert outs[0] == outs[1] == [N_ENTITIES, N_ENTITIES]
+
+
+# -- budget enforcement -----------------------------------------------------
+def test_entity_table_budget_enforced_and_relieved_by_sharding():
+    """The acceptance shape: a table that provably does not fit one
+    process's configured budget trains fine once sharded 4 ways."""
+    ds, _ = _make_dataset()
+    full = build_random_effect_data(
+        ds.features["u"], ds.labels, ds.weights, ds.entity_ids["userId"])
+    budget = int(full.table_bytes() * 0.45)
+    with pytest.raises(EntityTableBudgetError, match="entity-shards"):
+        _run_cd(ds, sweeps=1, budget=budget)
+
+    def fn(rank):
+        model, _ = _run_cd(ds, spec=EntityShardSpec(4, rank), sweeps=1,
+                           budget=budget)
+        return model.coordinates["per-user"].num_entities
+
+    outs = run_simulated_processes(4, fn, join_timeout=600)
+    _assert_all_ok(outs)
+    assert outs[0] == N_ENTITIES  # gathered model is still the full table
+
+
+# -- driver flag wiring -----------------------------------------------------
+def test_driver_rejects_entity_shards_process_count_mismatch(tmp_path):
+    from photon_ml_tpu.cli.game_training_driver import main
+
+    with pytest.raises(SystemExit, match="process count"):
+        main(["--train-data", str(tmp_path / "nope.avro"),
+              "--output-dir", str(tmp_path / "out"),
+              "--coordinates", '[{"name": "fixed"}]',
+              "--entity-shards", "2"])
+
+
+def test_driver_accepts_single_shard_and_budget_flags(tmp_path):
+    """--entity-shards 1 on one process is the no-op owner map; the
+    parser and validation layers accept it together with the budget."""
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser
+
+    args = build_arg_parser().parse_args(
+        ["--train-data", "x", "--output-dir", "y", "--coordinates", "z",
+         "--entity-shards", "1", "--re-table-budget-mb", "64"])
+    assert args.entity_shards == 1
+    assert args.re_table_budget_mb == 64.0
+    with pytest.raises(SystemExit):
+        build_arg_parser().parse_args(
+            ["--train-data", "x", "--output-dir", "y",
+             "--coordinates", "z", "--entity-shards", "0"])
