@@ -43,6 +43,8 @@ def _environment() -> dict:
     platform — the block records what the measurement actually ran on."""
     import jax
 
+    from photon_ml_tpu import analysis
+
     devs = jax.devices()
     return {
         "cpu_cores": os.cpu_count() or 1,
@@ -51,6 +53,10 @@ def _environment() -> dict:
         "device_kind": getattr(devs[0], "device_kind", ""),
         "device_count": len(devs),
         "python_version": sys.version.split()[0],
+        # lint posture the numbers were measured under: photon-check
+        # version + unsuppressed finding count (0 on a clean tree)
+        "photon_check": analysis.repo_report(
+            os.path.dirname(os.path.abspath(__file__))),
     }
 
 

@@ -1,0 +1,232 @@
+"""Blocking-call-on-event-loop lint (PB301-PB303).
+
+The asyncio serving front end (``serve/aserver.py``) parses requests ON
+the loop and resolves scores through batcher callbacks — one blocked
+coroutine stalls every connection. Three ways the loop gets blocked:
+
+* **PB301** — a known-blocking primitive called directly in an ``async
+  def``: file IO (``open``/``json.load``/``np.load``), ``time.sleep``,
+  ``os``/``shutil``/``subprocess``, synchronous HTTP, device syncs
+  (``.block_until_ready()``), registry reads (``read_latest`` /
+  ``open_version`` / ``materialize``) — anything that parks the loop on
+  a syscall or a device fence.
+* **PB302** — the same primitives one hop away: an ``async def`` calls
+  a *sync* function (resolved by name within the scanned serving
+  modules) whose body transitively blocks. Depth-limited propagation —
+  the point is catching ``handler -> service method -> disk read``.
+* **PB303** — an opaque callable *parameter* invoked synchronously in
+  async context. The lint cannot see the implementations, but the repo
+  precedent is exactly why it flags them: the serving driver's ready
+  callbacks write JSONL logs.
+
+Calls dispatched through ``loop.run_in_executor(...)`` /
+``asyncio.to_thread(...)`` are exempt — that is the fix the hints
+prescribe.
+
+Scope: ``serve/`` plus the serving driver (``cli/serving_driver.py``) —
+the "aserver-adjacent" set. Thread-based code (the watcher, the
+threaded server) blocks legitimately and is only scanned for the
+*async* entry points it exposes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from photon_ml_tpu.analysis.core import (
+    PASS_CATALOG,
+    Finding,
+    ancestors,
+    call_name,
+    dotted_name,
+    snippet_at,
+)
+
+__all__ = ["check_modules", "DEFAULT_SCOPE"]
+
+DEFAULT_SCOPE = (
+    "photon_ml_tpu/serve/",
+    "photon_ml_tpu/cli/serving_driver.py",
+)
+
+# (base module, attr) pairs; attr "*" = every attribute of that module.
+_BLOCKING_QUALIFIED = {
+    ("time", "sleep"),
+    ("os", "replace"), ("os", "remove"), ("os", "rename"),
+    ("os", "listdir"), ("os", "stat"), ("os", "makedirs"),
+    ("os", "rmdir"), ("os", "unlink"), ("os", "fsync"), ("os", "open"),
+    ("path", "exists"), ("path", "getsize"), ("path", "getmtime"),
+    ("shutil", "*"), ("subprocess", "*"),
+    ("json", "load"),  # json.loads is CPU-only and fine
+    ("np", "load"), ("np", "save"), ("np", "savez"),
+    ("numpy", "load"), ("numpy", "save"), ("numpy", "savez"),
+    ("request", "urlopen"), ("urllib", "urlopen"),
+    ("socket", "create_connection"),
+}
+
+# Attribute names that block regardless of the receiver.
+_BLOCKING_ATTRS = {
+    "block_until_ready",          # device fence
+    "read_latest", "open_version", "materialize",  # registry disk reads
+    "read_avro_file", "write_avro_file",
+    "serve_forever", "shutdown",  # http.server handshakes
+}
+
+_BLOCKING_BARE = {"open", "urlopen", "sleep"}
+
+_EXECUTOR_DISPATCH = {"run_in_executor", "to_thread"}
+
+_PROPAGATION_DEPTH = 3
+
+
+def _is_blocking_primitive(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id in _BLOCKING_BARE:
+            return func.id
+        return None
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        if attr in _BLOCKING_ATTRS:
+            return dotted_name(node) or attr
+        base = func.value
+        base_name = ""
+        if isinstance(base, ast.Name):
+            base_name = base.id
+        elif isinstance(base, ast.Attribute):
+            base_name = base.attr
+        if (base_name, attr) in _BLOCKING_QUALIFIED:
+            return f"{base_name}.{attr}"
+        if (base_name, "*") in _BLOCKING_QUALIFIED:
+            return f"{base_name}.{attr}"
+    return None
+
+
+def _inside_executor_dispatch(node: ast.AST) -> bool:
+    """True when the node sits inside the ARGUMENTS of a
+    run_in_executor/to_thread call (being shipped off the loop), either
+    as the callable or inside a lambda passed there."""
+    for anc in ancestors(node):
+        if isinstance(anc, ast.Call) \
+                and call_name(anc) in _EXECUTOR_DISPATCH:
+            return True
+    return False
+
+
+def _enclosing_async(node: ast.AST):
+    """The nearest enclosing function if it is async, else None. A sync
+    def nested inside an async def is NOT on the loop (it may be a
+    worker callback), so the nearest function decides."""
+    for anc in ancestors(node):
+        if isinstance(anc, ast.AsyncFunctionDef):
+            return anc
+        if isinstance(anc, (ast.FunctionDef, ast.Lambda)):
+            return None
+    return None
+
+
+def _param_names(node: ast.AST) -> Set[str]:
+    """Parameter names visible at ``node`` from every enclosing function
+    (closures included: a callback param of a sync wrapper invoked
+    inside its nested async main() is the repo's actual shape)."""
+    out: Set[str] = set()
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = anc.args
+            out.update(p.arg for p in a.posonlyargs + a.args + a.kwonlyargs)
+            if a.vararg:
+                out.add(a.vararg.arg)
+            if a.kwarg:
+                out.add(a.kwarg.arg)
+    return out
+
+
+def _finding(code, rel, lines, lineno, message) -> Finding:
+    return Finding(code=code, path=rel, line=lineno, message=message,
+                   hint=PASS_CATALOG[code][1],
+                   snippet=snippet_at(lines, lineno))
+
+
+def _collect_sync_defs(modules) -> Dict[str, ast.FunctionDef]:
+    """name -> def across the scanned set (methods keyed by bare name;
+    collisions keep the first — good enough for a lint hop)."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for _path, _rel, tree, _lines in modules:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                out.setdefault(node.name, node)
+    return out
+
+
+def _blocking_reason(fn: ast.FunctionDef, defs, depth: int,
+                     seen: Set[str]) -> Optional[str]:
+    """Why ``fn`` blocks (a primitive name or a call chain), or None."""
+    if depth <= 0 or fn.name in seen:
+        return None
+    seen = seen | {fn.name}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        prim = _is_blocking_primitive(node)
+        if prim is not None and not _inside_executor_dispatch(node):
+            return prim
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = defs.get(call_name(node))
+        if callee is None or callee is fn:
+            continue
+        reason = _blocking_reason(callee, defs, depth - 1, seen)
+        if reason is not None:
+            return f"{callee.name}() -> {reason}"
+    return None
+
+
+def check_modules(modules, *, scope: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    scopes = tuple(DEFAULT_SCOPE if scope is None else scope)
+    scan_all = "*" in scopes
+    in_scope = [m for m in modules
+                if scan_all or any(s in m[1] for s in scopes)]
+    if not in_scope:
+        return []
+    defs = _collect_sync_defs(in_scope)
+    findings: List[Finding] = []
+    for _path, rel, tree, lines in in_scope:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _enclosing_async(node) is None:
+                continue
+            if _inside_executor_dispatch(node):
+                continue
+            prim = _is_blocking_primitive(node)
+            if prim is not None:
+                findings.append(_finding(
+                    "PB301", rel, lines, node.lineno,
+                    f"blocking call '{prim}' runs on the asyncio event "
+                    "loop: every connection stalls behind it"))
+                continue
+            name = call_name(node)
+            callee = defs.get(name)
+            if callee is not None:
+                reason = _blocking_reason(callee, defs,
+                                          _PROPAGATION_DEPTH, set())
+                if reason is not None:
+                    findings.append(_finding(
+                        "PB302", rel, lines, node.lineno,
+                        f"'{name}()' called on the event loop blocks "
+                        f"via {reason}"))
+                    continue
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _param_names(node)
+                    and not isinstance(getattr(node, "_pcheck_parent",
+                                               None), ast.Await)):
+                findings.append(_finding(
+                    "PB303", rel, lines, node.lineno,
+                    f"opaque callable parameter '{node.func.id}' invoked "
+                    "synchronously on the event loop: implementations "
+                    "may do file IO (the serving driver's ready "
+                    "callbacks write JSONL logs)"))
+    return findings

@@ -1,0 +1,164 @@
+"""``photon-check`` CLI.
+
+Exit codes (distinct so CI can tell failure classes apart):
+  0  clean (no unsuppressed findings / all fault sites covered)
+  1  lint findings not covered by baseline or pragma
+  2  fault-site audit failure (--fault-sites)
+  3  baseline problems: malformed, unjustified, or stale entries
+
+Usage:
+  photon-check [paths...]              lint (default: photon_ml_tpu/)
+  photon-check --fault-sites           fault-injection coverage audit
+  photon-check --write-baseline        accept current findings (each
+                                       entry still needs a justification
+                                       filled in before CI accepts it)
+  photon-check --json                  machine-readable report
+  photon-check --list-passes           finding-code catalogue
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from photon_ml_tpu.analysis import __version__
+from photon_ml_tpu.analysis.core import (
+    PASS_CATALOG,
+    BaselineError,
+    load_baseline,
+    run_check,
+)
+from photon_ml_tpu.analysis.fault_sites import audit_fault_sites
+
+__all__ = ["main", "build_arg_parser"]
+
+
+def _default_repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-check",
+        description="SPMD collective-alignment, recompile-hazard and "
+                    "event-loop-blocking lint for photon_ml_tpu")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the photon_ml_tpu "
+                        "package next to this install)")
+    p.add_argument("--repo-root", default=None,
+                   help="root for repo-relative paths (default: inferred)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON (default: "
+                        "<repo-root>/photon-check-baseline.json when "
+                        "present)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write every current finding to the baseline "
+                        "file with an empty justification to fill in")
+    p.add_argument("--fault-sites", action="store_true",
+                   help="audit fault-injection site coverage against "
+                        "the tests/ tree instead of linting")
+    p.add_argument("--tests-dir", default=None,
+                   help="tests root for --fault-sites (default: "
+                        "<repo-root>/tests)")
+    p.add_argument("--passes", default=None,
+                   help="comma list: collectives,recompile,blocking")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--list-passes", action="store_true")
+    p.add_argument("--version", action="version",
+                   version=f"photon-check {__version__}")
+    return p
+
+
+def _lint(args, repo_root: str) -> int:
+    paths = args.paths or [os.path.join(repo_root, "photon_ml_tpu")]
+    baseline_path = args.baseline or os.path.join(
+        repo_root, "photon-check-baseline.json")
+    baseline = []
+    if not args.write_baseline and os.path.exists(baseline_path):
+        try:
+            baseline = load_baseline(baseline_path)
+        except BaselineError as e:
+            print(f"photon-check: {e}", file=sys.stderr)
+            return 3
+    passes = (args.passes.split(",") if args.passes else None)
+    report = run_check(paths, baseline=baseline, repo_root=repo_root,
+                       passes=passes)
+    findings = report["findings"]
+
+    if args.write_baseline:
+        entries = [{
+            "code": f.code, "path": f.path, "snippet": f.snippet,
+            "justification": "",
+        } for f in findings]
+        with open(baseline_path, "w") as fh:
+            json.dump({"entries": entries}, fh, indent=2)
+            fh.write("\n")
+        print(f"wrote {len(entries)} entries to {baseline_path} — fill "
+              "in every justification before CI will accept it")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "version": __version__,
+            "files_checked": report["files_checked"],
+            "findings": [f.as_dict() for f in findings],
+            "suppressed": [
+                {"via": via, **f.as_dict()}
+                for f, via in report["suppressed"]],
+            "stale_baseline": [
+                {"code": e.code, "path": e.path, "snippet": e.snippet}
+                for e in report["stale_baseline"]],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        for e in report["stale_baseline"]:
+            print(f"stale baseline entry (matches nothing): {e.code} "
+                  f"{e.path} :: {e.snippet!r}")
+        print(f"photon-check {__version__}: {report['files_checked']} "
+              f"files, {len(findings)} finding"
+              f"{'s' if len(findings) != 1 else ''}, "
+              f"{len(report['suppressed'])} suppressed")
+    if findings:
+        return 1
+    if report["stale_baseline"]:
+        return 3
+    return 0
+
+
+def _fault_audit(args, repo_root: str) -> int:
+    pkg = (args.paths[0] if args.paths
+           else os.path.join(repo_root, "photon_ml_tpu"))
+    tests = args.tests_dir or os.path.join(repo_root, "tests")
+    audit = audit_fault_sites(pkg, tests)
+    if args.as_json:
+        print(json.dumps({
+            "registered": {s: list(loc)
+                           for s, loc in audit.registered.items()},
+            "exercised": sorted(audit.exercised),
+            "uncovered": audit.uncovered,
+        }, indent=2))
+    else:
+        print(audit.render())
+    return 0 if audit.ok else 2
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if args.list_passes:
+        for code in sorted(PASS_CATALOG):
+            desc, hint = PASS_CATALOG[code]
+            print(f"{code}  {desc}\n       fix: {hint}")
+        return 0
+    repo_root = args.repo_root or _default_repo_root()
+    if args.fault_sites:
+        return _fault_audit(args, repo_root)
+    return _lint(args, repo_root)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

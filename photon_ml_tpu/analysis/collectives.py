@@ -1,0 +1,229 @@
+"""Collective-alignment lint (PC101 / PC102).
+
+The PR-1 contract: every cross-process collective is a guarded boundary
+— a peer that failed since the last barrier must surface as
+:class:`~photon_ml_tpu.parallel.resilience.PeerFailure` *before* this
+process can wedge inside the next gather. Two ways to break it:
+
+* **PC101** — a collective call site with no dominating guard: not
+  inside a ``with CollectiveGuard(...)`` block, not in a
+  ``guarded(...)``-wrapped function, and with no ``health_barrier``
+  earlier in the same function. A peer that died since the last
+  boundary wedges this gather for the full transport timeout.
+* **PC102** — a collective (including a health barrier: a
+  rank-conditioned barrier is the classic SPMD hang) inside control
+  flow conditioned on process-local state — rank/shard index, a
+  filesystem probe, queue depth, local frontier size. Processes take
+  different branches, collective sequences diverge, and the runtime
+  pairs up mismatched collectives (silent corruption) or deadlocks.
+  Branches are accepted when both arms issue the same collective (the
+  shape-aligned-branches escape hatch).
+
+Domination is checked lexically per function: a barrier in a *caller*
+does not clear a raw gather in a *callee* — transport primitives whose
+guards genuinely live one frame up are exactly what the baseline file
+(with per-entry justification) is for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from photon_ml_tpu.analysis.core import (
+    PASS_CATALOG,
+    Finding,
+    ancestors,
+    call_name,
+    enclosing_function,
+    snippet_at,
+)
+
+__all__ = ["check_modules", "RAW_COLLECTIVES", "GUARDED_HELPERS",
+           "SELF_GUARDED"]
+
+# Transport-level primitives: one un-aligned call deadlocks the fleet.
+RAW_COLLECTIVES = {
+    "process_allgather",   # jax.experimental.multihost_utils
+    "allgather_status",    # resilience transport leg
+    "allgather_payload",   # simulated-transport data leg
+    "sync_global_devices", "broadcast_one_to_all",  # multihost_utils kin
+}
+
+# Repo helpers that wrap a raw gather but do NOT barrier internally:
+# call sites need a dominating guard just like the raw primitives.
+GUARDED_HELPERS = {
+    "allgather_blobs",            # parallel/entity_shard.py
+    "allgather_spans",            # parallel/multihost.py
+    "allgather_varspans",
+    "allreduce_summary_moments",
+    "_cross_process_sum",         # parallel/streaming.py
+}
+
+# Helpers that run their own pre-gather health barrier (the
+# entity_shard._guarded_gather family): exempt from PC101, still
+# checked for divergent branches (PC102).
+SELF_GUARDED = {
+    "exchange_score_updates",
+    "allgather_objects",
+    "_guarded_gather",
+}
+
+BARRIERS = {"health_barrier"}
+GUARD_CONSTRUCTORS = {"CollectiveGuard", "guarded"}
+
+# Names/attributes that read process-LOCAL state. process_count() is
+# deliberately absent: it is uniform across the job, and `if
+# process_count() > 1` is the standard single-process fast path.
+PROCESS_LOCAL_NAMES = {
+    "process_index", "process_id", "rank", "shard_index", "is_lead",
+    "owned_mask", "local_rank", "frontier", "queue_depth",
+}
+PROCESS_LOCAL_CALLS = {
+    "exists",     # filesystem probes diverge across hosts / in time
+    "qsize", "is_alive", "poll", "owned_mask", "process_index",
+}
+
+
+def _collective_category(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name in RAW_COLLECTIVES or name in GUARDED_HELPERS:
+        return "gather"
+    if name in SELF_GUARDED:
+        return "self_guarded"
+    if name in BARRIERS:
+        return "barrier"
+    return None
+
+
+def _is_guard_with(node: ast.With) -> bool:
+    return any(isinstance(item.context_expr, ast.Call)
+               and call_name(item.context_expr) in GUARD_CONSTRUCTORS
+               for item in node.items)
+
+
+def _function_is_guarded(fn) -> bool:
+    return any(isinstance(dec, ast.Call)
+               and call_name(dec) in GUARD_CONSTRUCTORS
+               for dec in fn.decorator_list)
+
+
+def _barrier_lines(fn) -> List[int]:
+    """Lines inside ``fn`` (excluding nested defs) where a health
+    barrier runs or a CollectiveGuard block opens."""
+    out: List[int] = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call) and call_name(child) in BARRIERS:
+                out.append(child.lineno)
+            if isinstance(child, ast.With) and _is_guard_with(child):
+                out.append(child.lineno)
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+def _divergence_marker(test: ast.AST) -> Optional[str]:
+    """The first process-local marker inside a branch condition, or
+    None when the condition looks process-uniform."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in PROCESS_LOCAL_CALLS:
+                return f"{name}()"
+        elif isinstance(node, ast.Attribute):
+            if node.attr in PROCESS_LOCAL_NAMES:
+                return node.attr
+        elif isinstance(node, ast.Name):
+            if node.id in PROCESS_LOCAL_NAMES:
+                return node.id
+    return None
+
+
+def _branch_has_collective(body, name: str) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and call_name(node) == name:
+                return True
+    return False
+
+
+def _finding(code: str, rel: str, lines, node: ast.Call, message: str
+             ) -> Finding:
+    return Finding(code=code, path=rel, line=node.lineno, message=message,
+                   hint=PASS_CATALOG[code][1],
+                   snippet=snippet_at(lines, node.lineno))
+
+
+def check_modules(modules) -> List[Finding]:
+    findings: List[Finding] = []
+    for _path, rel, tree, lines in modules:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            category = _collective_category(node)
+            if category is None:
+                continue
+            name = call_name(node)
+            if category == "gather":
+                findings.extend(_check_pc101(rel, lines, node, name))
+            findings.extend(_check_pc102(rel, lines, node, name))
+    return findings
+
+
+def _check_pc101(rel, lines, node: ast.Call, name: str) -> List[Finding]:
+    fn = enclosing_function(node)
+    dominated = False
+    for anc in ancestors(node):
+        if isinstance(anc, ast.With) and _is_guard_with(anc):
+            dominated = True
+            break
+        if anc is fn:
+            break
+    if not dominated and fn is not None:
+        if _function_is_guarded(fn):
+            dominated = True
+        elif any(line < node.lineno for line in _barrier_lines(fn)):
+            # approximate dominance: a barrier earlier in this function.
+            dominated = True
+    if dominated:
+        return []
+    return [_finding(
+        "PC101", rel, lines, node,
+        f"collective '{name}' is not dominated by a health-barrier "
+        "guard: a peer that failed since the last boundary wedges this "
+        "gather instead of raising PeerFailure")]
+
+
+def _check_pc102(rel, lines, node: ast.Call, name: str) -> List[Finding]:
+    fn = enclosing_function(node)
+    for anc in ancestors(node):
+        if fn is not None and anc is fn:
+            break
+        if isinstance(anc, (ast.If, ast.While)):
+            marker = _divergence_marker(anc.test)
+            if marker is None:
+                continue
+            if (isinstance(anc, ast.If) and anc.orelse
+                    and _branch_has_collective(anc.body, name)
+                    and _branch_has_collective(anc.orelse, name)):
+                continue  # both arms issue the collective: shape-aligned
+            return [_finding(
+                "PC102", rel, lines, node,
+                f"collective '{name}' runs inside a branch conditioned "
+                f"on process-local state ('{marker}'): processes that "
+                "take the other branch never reach it and the job's "
+                "collective sequences diverge")]
+        elif isinstance(anc, ast.IfExp):
+            marker = _divergence_marker(anc.test)
+            if marker is not None:
+                return [_finding(
+                    "PC102", rel, lines, node,
+                    f"collective '{name}' inside a conditional "
+                    f"expression on process-local state ('{marker}')")]
+    return []

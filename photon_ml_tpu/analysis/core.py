@@ -1,0 +1,330 @@
+"""photon-check engine: findings, suppression, file walking, pass registry.
+
+Stdlib-only by design (``ast`` + ``json``): the lint must run in CI and
+pre-commit without initializing jax or touching a device, and a pass
+over the whole package must take well under a second.
+
+Suppression has two layers, both requiring a human-written reason:
+
+* **Inline pragma** — ``# photon-check: allow[PC101] reason`` on the
+  finding's line or the line directly above. An empty reason does not
+  suppress (the reason IS the review artifact).
+* **Baseline file** — ``photon-check-baseline.json``: a list of entries
+  keyed by ``(code, path, snippet)`` where ``snippet`` is the stripped
+  source line, so entries survive unrelated line drift. Every entry
+  must carry a non-empty ``justification`` that is not a TODO; entries
+  matching nothing are reported as stale so the baseline can only
+  shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding", "BaselineEntry", "BaselineError", "PASS_CATALOG",
+    "attach_parents", "call_name", "dotted_name", "iter_python_files",
+    "load_baseline", "parse_module", "run_check",
+]
+
+# code -> (one-line description, fix hint) — the pass catalogue rendered
+# by ``photon-check --list-passes`` and docs/analysis.md.
+PASS_CATALOG: Dict[str, Tuple[str, str]] = {
+    "PC101": (
+        "collective call not dominated by a health-barrier guard",
+        "wrap the phase in resilience.CollectiveGuard(tag) or call "
+        "health_barrier(tag) before the gather (parallel/resilience.py)",
+    ),
+    "PC102": (
+        "collective inside control flow conditioned on process-local "
+        "state (SPMD divergence: peers hang in their next collective)",
+        "hoist the collective out of the branch, or make every branch "
+        "issue the same shape-aligned collective sequence",
+    ),
+    "PH201": (
+        "jit wrapper constructed inside a hot-path function body "
+        "(a fresh executable per call: recompile storm)",
+        "hoist the jit to module scope, memoize with functools.lru_cache, "
+        "or store it in a compile cache keyed by shape",
+    ),
+    "PH202": (
+        "traced-value concretization inside a jit target "
+        "(.item()/int()/float() forces a device sync + shape dependence)",
+        "keep the value traced (jnp.where / lax.cond) or pass it as a "
+        "host-computed static operand",
+    ),
+    "PH203": (
+        "hot-path jit call takes a shape from raw len()/.shape instead "
+        "of the registered power-of-two bucket/pad helpers",
+        "route the width through bucketize()/bucket_ladder()/"
+        "_active_width()/_pad_entities() so shapes stay on the ladder",
+    ),
+    "PH204": (
+        "unhashable Python object passed at a jit static-arg position",
+        "pass a hashable scalar/tuple, or drop static_argnums and let "
+        "the value be traced",
+    ),
+    "PB301": (
+        "blocking call on the asyncio event loop",
+        "dispatch through loop.run_in_executor(...) / asyncio.to_thread "
+        "so the loop keeps serving while it runs",
+    ),
+    "PB302": (
+        "event-loop call into a sync function that transitively blocks",
+        "move the blocking callee into an executor, or make the "
+        "offending leaf async",
+    ),
+    "PB303": (
+        "opaque callable parameter invoked synchronously on the event "
+        "loop (implementations may do file IO)",
+        "invoke callbacks via loop.run_in_executor(None, cb, ...) unless "
+        "the callback is documented non-blocking",
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to ``path:line`` with a fix hint."""
+
+    code: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+    hint: str = ""
+    snippet: str = ""  # stripped source line (the baseline match key)
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: {self.code} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class BaselineError(ValueError):
+    """The baseline file is malformed or an entry lacks a justification."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    code: str
+    path: str
+    snippet: str
+    justification: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.code, self.path, self.snippet)
+
+
+_TODO_RE = re.compile(r"^\s*(todo|fixme|xxx|tbd)?\s*$", re.IGNORECASE)
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    """Parse + validate the baseline: every entry must carry a real
+    justification — an entry without one is a finding nobody reviewed."""
+    with open(path) as f:
+        raw = json.load(f)
+    entries = raw.get("entries") if isinstance(raw, dict) else None
+    if not isinstance(entries, list):
+        raise BaselineError(
+            f"{path}: expected {{\"entries\": [...]}} at top level")
+    out = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or not all(
+                isinstance(e.get(k), str)
+                for k in ("code", "path", "snippet", "justification")):
+            raise BaselineError(
+                f"{path}: entry {i} needs string fields "
+                "code/path/snippet/justification")
+        if _TODO_RE.match(e["justification"]):
+            raise BaselineError(
+                f"{path}: entry {i} ({e['code']} {e['path']}) has no "
+                "justification — every suppressed finding must say WHY "
+                "it is accepted")
+        out.append(BaselineEntry(e["code"], e["path"], e["snippet"],
+                                 e["justification"]))
+    return out
+
+
+# -- source + AST helpers ---------------------------------------------------
+def iter_python_files(roots: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            files.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            files.extend(os.path.join(dirpath, f)
+                         for f in sorted(filenames) if f.endswith(".py"))
+    return sorted(set(files))
+
+
+def parse_module(path: str) -> Tuple[Optional[ast.Module], List[str]]:
+    """(tree, source lines); tree is None on a syntax error (the caller
+    emits nothing — a file that does not parse fails its own tests)."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None, lines
+    attach_parents(tree)
+    return tree, lines
+
+
+def attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._pcheck_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_pcheck_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def call_name(node: ast.AST) -> str:
+    """Terminal name of a call target: ``a.b.c(...)`` -> ``c``."""
+    func = node.func if isinstance(node, ast.Call) else node
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted form: ``jax.jit`` -> ``"jax.jit"``; empty when
+    the base is not a plain name chain."""
+    func = node.func if isinstance(node, ast.Call) else node
+    parts: List[str] = []
+    cur = func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing_function(node: ast.AST):
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def snippet_at(lines: List[str], lineno: int) -> str:
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+# -- inline pragma ----------------------------------------------------------
+_PRAGMA_RE = re.compile(
+    r"#\s*photon-check:\s*allow\[([A-Z0-9,\s]+)\]\s*(.*)")
+
+
+def pragma_map(lines: List[str]) -> Dict[int, set]:
+    """line -> set of allowed codes; a pragma suppresses findings on its
+    own line and the line below (pragma-above style). Pragmas without a
+    reason are ignored — same contract as the baseline."""
+    out: Dict[int, set] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(line)
+        if not m or not m.group(2).strip():
+            continue
+        codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        out.setdefault(i, set()).update(codes)
+        out.setdefault(i + 1, set()).update(codes)
+    return out
+
+
+# -- engine -----------------------------------------------------------------
+def _relpath(path: str, repo_root: Optional[str]) -> str:
+    if repo_root:
+        try:
+            return os.path.relpath(path, repo_root).replace(os.sep, "/")
+        except ValueError:  # different drive (windows)
+            pass
+    return path.replace(os.sep, "/")
+
+
+def run_check(roots: Sequence[str], *,
+              baseline: Sequence[BaselineEntry] = (),
+              repo_root: Optional[str] = None,
+              passes: Optional[Sequence[str]] = None,
+              hot_paths: Optional[Sequence[str]] = None,
+              blocking_scope: Optional[Sequence[str]] = None) -> dict:
+    """Run the lint passes over ``roots``.
+
+    Returns a report dict: ``findings`` (unsuppressed), ``suppressed``
+    (finding, via) pairs, ``stale_baseline`` entries that matched
+    nothing, and ``files_checked``. ``passes`` selects a subset by
+    module name (collectives/recompile/blocking); ``hot_paths`` /
+    ``blocking_scope`` override the per-pass file scopes (None = the
+    repo defaults; pass ``["*"]`` to scan every file — what the fixture
+    tests do)."""
+    from photon_ml_tpu.analysis import blocking, collectives, recompile
+
+    files = iter_python_files(roots)
+    modules = []
+    for path in files:
+        tree, lines = parse_module(path)
+        if tree is None:
+            continue
+        modules.append((path, _relpath(path, repo_root), tree, lines))
+
+    selected = set(passes) if passes is not None else {
+        "collectives", "recompile", "blocking"}
+    raw: List[Finding] = []
+    if "collectives" in selected:
+        raw += collectives.check_modules(modules)
+    if "recompile" in selected:
+        raw += recompile.check_modules(modules, hot_paths=hot_paths)
+    if "blocking" in selected:
+        raw += blocking.check_modules(modules, scope=blocking_scope)
+    raw.sort(key=lambda f: (f.path, f.line, f.code))
+
+    pragmas = {rel: pragma_map(lines) for _p, rel, _t, lines in modules}
+    by_key: Dict[Tuple[str, str, str], BaselineEntry] = {
+        e.key: e for e in baseline}
+    used_keys: set = set()
+    findings: List[Finding] = []
+    suppressed: List[Tuple[Finding, str]] = []
+    for f in raw:
+        allowed = pragmas.get(f.path, {}).get(f.line, set())
+        if f.code in allowed:
+            suppressed.append((f, "pragma"))
+            continue
+        entry = by_key.get((f.code, f.path, f.snippet))
+        if entry is not None:
+            used_keys.add(entry.key)
+            suppressed.append((f, "baseline"))
+            continue
+        findings.append(f)
+    stale = [e for e in baseline if e.key not in used_keys]
+    return {
+        "findings": findings,
+        "suppressed": suppressed,
+        "stale_baseline": stale,
+        "files_checked": len(modules),
+    }

@@ -1,0 +1,104 @@
+"""Fault-site coverage audit (``photon-check --fault-sites``).
+
+``parallel/fault_injection.py`` plants named sites in the hot paths so
+every failure path the resilience layer promises to handle is
+EXERCISABLE. That promise decays silently: a new site with no test is
+dead code until the first real outage. The audit closes the loop:
+
+* **registered sites** — every string literal passed to
+  ``fault_injection.check("...")`` / ``fault_injection.mangle_payload
+  ("...", ...)`` in the package (AST scan, so dynamically-composed
+  site names do not count — keep site names literal);
+* **exercised sites** — every registered site name appearing as a
+  string literal anywhere under ``tests/`` (covers direct
+  ``Fault(site=...)`` construction, parametrize tables, and env-plan
+  JSON alike);
+* any registered-but-never-exercised site fails the audit, listing the
+  site and where it is planted.
+
+Sites that appear only in tests (test-local harness sites like
+``work.step``) are ignored — the audit covers the production surface.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Set, Tuple
+
+from photon_ml_tpu.analysis.core import iter_python_files, parse_module
+
+__all__ = ["FaultSiteAudit", "audit_fault_sites", "registered_sites",
+           "exercised_sites"]
+
+_INJECTION_FUNCS = {"check", "mangle_payload"}
+
+
+def registered_sites(package_root: str) -> Dict[str, Tuple[str, int]]:
+    """site name -> (path, line) of its first injection point."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for path in iter_python_files([package_root]):
+        tree, _lines = parse_module(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in _INJECTION_FUNCS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "fault_injection"):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.setdefault(arg.value, (path, node.lineno))
+    return out
+
+
+def exercised_sites(tests_root: str, known: Set[str]) -> Set[str]:
+    """Registered site names referenced as string literals in tests."""
+    seen: Set[str] = set()
+    for path in iter_python_files([tests_root]):
+        tree, _lines = parse_module(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value in known):
+                seen.add(node.value)
+    return seen
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSiteAudit:
+    registered: Dict[str, Tuple[str, int]]
+    exercised: Set[str]
+
+    @property
+    def uncovered(self) -> List[str]:
+        return sorted(set(self.registered) - self.exercised)
+
+    @property
+    def ok(self) -> bool:
+        return not self.uncovered
+
+    def render(self) -> str:
+        lines = [f"fault-injection sites: {len(self.registered)} "
+                 f"registered, {len(self.exercised)} exercised by tests"]
+        for site in sorted(self.registered):
+            path, lineno = self.registered[site]
+            mark = "ok " if site in self.exercised else "MISSING"
+            lines.append(f"  [{mark}] {site}  ({path}:{lineno})")
+        if self.uncovered:
+            lines.append(
+                "uncovered sites have NO tier-1 test arming a Fault at "
+                "them — the failure path they guard is unexercised")
+        return "\n".join(lines)
+
+
+def audit_fault_sites(package_root: str, tests_root: str) -> FaultSiteAudit:
+    reg = registered_sites(package_root)
+    return FaultSiteAudit(registered=reg,
+                          exercised=exercised_sites(tests_root, set(reg)))

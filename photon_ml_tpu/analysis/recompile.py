@@ -1,0 +1,338 @@
+"""Recompile-hazard lint (PH201-PH204).
+
+The serving/CD hot paths keep compile counts flat by construction:
+every jit-wrapped executable is either module-level, memoized behind
+``functools.lru_cache`` (the RE solver registry), or stored in an
+explicit shape-keyed compile cache (``ScoringSession._compiled``), and
+every varying dimension is routed through the power-of-two bucket/pad
+helpers so the set of distinct shapes is O(log max). This pass flags
+the ways that discipline gets broken:
+
+* **PH201** — ``jax.jit`` constructed inside a hot-path function body
+  with no memoization: a fresh executable per call.
+* **PH202** — ``.item()`` / ``int()`` / ``float()`` applied to a traced
+  parameter inside a jit target: forces a host sync and turns a traced
+  value into a Python scalar the next trace depends on.
+* **PH203** — a call to a jitted executable whose operand takes its
+  shape from raw ``len()`` / ``.shape`` instead of the registered
+  bucket/pad helpers: every distinct input size becomes a compile.
+* **PH204** — a list/dict/set literal passed at a ``static_argnums`` /
+  ``static_argnames`` position: unhashable, so the jit cache cannot
+  even key it.
+
+Scope: PH201/PH203 run only over the registered hot-path modules
+(descent sweeps, RE solver, serving score path, streamed passes) —
+cold-path jit construction (e.g. a one-off driver) is fine. PH202/204
+run everywhere a jit target is visible.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Sequence, Set
+
+from photon_ml_tpu.analysis.core import (
+    PASS_CATALOG,
+    Finding,
+    ancestors,
+    call_name,
+    dotted_name,
+    enclosing_function,
+    snippet_at,
+)
+
+__all__ = ["check_modules", "DEFAULT_HOT_PATHS", "SHAPE_HELPERS"]
+
+# Repo-relative hot-path modules: jit churn here is a per-sweep /
+# per-request recompile storm, not a one-off.
+DEFAULT_HOT_PATHS = (
+    "photon_ml_tpu/game/random_effect.py",
+    "photon_ml_tpu/game/descent.py",
+    "photon_ml_tpu/game/scoring.py",
+    "photon_ml_tpu/serve/session.py",
+    "photon_ml_tpu/serve/paged_table.py",
+    "photon_ml_tpu/parallel/streaming.py",
+    "photon_ml_tpu/parallel/data_parallel.py",
+    "photon_ml_tpu/evaluation/device.py",
+)
+
+# The registered power-of-two bucket/pad helpers: a shape that flows
+# through one of these stays on the compiled ladder.
+SHAPE_HELPERS = {
+    "bucketize", "bucket_ladder", "_active_width", "_pad_entities",
+    "pad_to_bucket", "next_power_of_two", "round_up_to_multiple",
+}
+
+_JIT_CONSTRUCTORS = {"jax.jit", "jit"}
+_CACHED_WRAPPERS = {"cached_jit"}  # repo's shape-keyed jit wrapper
+_MEMO_DECORATORS = {"lru_cache", "cache"}
+_CACHE_NAME_RE = re.compile(r"cache|compil", re.IGNORECASE)
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    dn = dotted_name(node)
+    return dn in _JIT_CONSTRUCTORS or dn.endswith(".jit")
+
+
+def _decorated_with_jit(fn) -> bool:
+    for dec in fn.decorator_list:
+        dn = dotted_name(dec if not isinstance(dec, ast.Call) else dec)
+        if dn in _JIT_CONSTRUCTORS or dn.endswith(".jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            inner = dotted_name(dec)
+            if inner in _JIT_CONSTRUCTORS or inner.endswith(".jit"):
+                return True
+            # functools.partial(jax.jit, ...)
+            if call_name(dec) == "partial" and any(
+                    isinstance(a, (ast.Attribute, ast.Name))
+                    and (dotted_name(a) in _JIT_CONSTRUCTORS
+                         or dotted_name(a).endswith(".jit"))
+                    for a in dec.args):
+                return True
+    return False
+
+
+def _memoized(fn) -> bool:
+    return any(call_name(d) in _MEMO_DECORATORS
+               or dotted_name(d if not isinstance(d, ast.Call) else d)
+               .split(".")[-1] in _MEMO_DECORATORS
+               for d in fn.decorator_list)
+
+
+def _stored_in_compile_cache(bound_name: str, fn) -> bool:
+    """``self._compiled[key] = run`` (or any cache/compile-named
+    subscript) inside the same function marks the construction as
+    explicitly memoized."""
+    if not bound_name:
+        return False
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == bound_name
+                    and _CACHE_NAME_RE.search(call_name(tgt.value) or "")):
+                return True
+    return False
+
+
+def _finding(code, rel, lines, lineno, message) -> Finding:
+    return Finding(code=code, path=rel, line=lineno, message=message,
+                   hint=PASS_CATALOG[code][1],
+                   snippet=snippet_at(lines, lineno))
+
+
+# -- jit-target discovery ---------------------------------------------------
+def _jit_target_defs(tree) -> Set[ast.AST]:
+    """FunctionDefs whose body will be traced: decorated with jit, or
+    passed by name to jax.jit/cached_jit/shard_map in this module."""
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+    targets: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _decorated_with_jit(node):
+                targets.add(node)
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            if (_is_jit_call(node) or name in _CACHED_WRAPPERS
+                    or name == "shard_map"):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name) and arg.id in defs_by_name:
+                        targets.add(defs_by_name[arg.id])
+    return targets
+
+
+def _jitted_callee_names(tree) -> Set[str]:
+    """Names bound to jitted executables in this module: assignment
+    targets of jax.jit(...)/cached_jit(...), plus the ``*_jit`` /
+    ``_jitted*`` naming convention."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if (_is_jit_call(node.value)
+                    or call_name(node.value) in _CACHED_WRAPPERS):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def _under_shape_helper(node: ast.AST, stop: ast.AST) -> bool:
+    for anc in ancestors(node):
+        if anc is stop:
+            return False
+        if isinstance(anc, ast.Call) and call_name(anc) in SHAPE_HELPERS:
+            return True
+    return False
+
+
+# -- the pass ---------------------------------------------------------------
+def check_modules(modules, *, hot_paths: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    hot = set(DEFAULT_HOT_PATHS if hot_paths is None else hot_paths)
+    scan_all = "*" in hot
+    findings: List[Finding] = []
+    for _path, rel, tree, lines in modules:
+        is_hot = scan_all or rel in hot or any(rel.endswith(h) for h in hot)
+        jit_targets = _jit_target_defs(tree)
+        jitted_names = _jitted_callee_names(tree)
+        if is_hot:
+            findings += _check_ph201(rel, lines, tree)
+            findings += _check_ph203(rel, lines, tree, jitted_names)
+        findings += _check_ph202(rel, lines, jit_targets)
+        findings += _check_ph204(rel, lines, tree)
+    return findings
+
+
+def _check_ph201(rel, lines, tree) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        bound = ""
+        site = None
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            site = node
+            par = ancestors(node).__iter__()
+            p = next(par, None)
+            if isinstance(p, ast.Assign):
+                tgt = p.targets[0]
+                if isinstance(tgt, ast.Name):
+                    bound = tgt.id
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _decorated_with_jit(node):
+            site = node
+            bound = node.name
+        if site is None:
+            continue
+        fn = enclosing_function(site)
+        if fn is None or (isinstance(site, ast.FunctionDef)
+                          and fn is site):
+            continue  # module-level jit: compiled once
+        chain = [fn] + [a for a in ancestors(fn)
+                        if isinstance(a, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))]
+        if any(_memoized(f) for f in chain):
+            continue
+        if any(_stored_in_compile_cache(bound, f) for f in chain):
+            continue
+        out.append(_finding(
+            "PH201", rel, lines, site.lineno,
+            f"jit wrapper constructed inside '{fn.name}' with no "
+            "memoization: every call compiles a fresh executable"))
+    return out
+
+
+_COERCERS = {"int", "float", "bool"}
+
+
+def _check_ph202(rel, lines, jit_targets) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in jit_targets:
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                out.append(_finding(
+                    "PH202", rel, lines, node.lineno,
+                    f"traced value concretized with .item() inside jit "
+                    f"target '{fn.name}'"))
+                continue
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _COERCERS and node.args):
+                touches_param = any(
+                    isinstance(n, ast.Name) and n.id in params
+                    for n in ast.walk(node.args[0]))
+                if touches_param:
+                    out.append(_finding(
+                        "PH202", rel, lines, node.lineno,
+                        f"{node.func.id}() applied to traced parameter "
+                        f"inside jit target '{fn.name}' forces a host "
+                        "sync per call"))
+    return out
+
+
+def _check_ph203(rel, lines, tree, jitted_names) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if not (name in jitted_names or name.endswith("_jit")
+                or name.startswith("_jitted")):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                raw = None
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "len"):
+                    raw = "len()"
+                elif (isinstance(sub, ast.Attribute)
+                        and sub.attr == "shape"):
+                    raw = ".shape"
+                if raw is None or _under_shape_helper(sub, node):
+                    continue
+                out.append(_finding(
+                    "PH203", rel, lines, node.lineno,
+                    f"jitted call '{name}' takes a shape from raw {raw} "
+                    "not routed through the bucket/pad helpers: every "
+                    "distinct size compiles"))
+                break
+    return out
+
+
+def _check_ph204(rel, lines, tree) -> List[Finding]:
+    """jit constructions with static args, cross-referenced against
+    same-module call sites passing unhashable literals there."""
+    out: List[Finding] = []
+    static_specs = {}  # wrapper name -> (argnums set, argnames set)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_jit_call(node.value)):
+            continue
+        nums, names = set(), set()
+        for kw in node.value.keywords:
+            if kw.arg == "static_argnums":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                                  int):
+                        nums.add(c.value)
+            elif kw.arg == "static_argnames":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                                  str):
+                        names.add(c.value)
+        if (nums or names) and isinstance(node.targets[0], ast.Name):
+            static_specs[node.targets[0].id] = (nums, names)
+    if not static_specs:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        spec = static_specs.get(call_name(node))
+        if spec is None:
+            continue
+        nums, names = spec
+        for i, arg in enumerate(node.args):
+            if i in nums and isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                out.append(_finding(
+                    "PH204", rel, lines, node.lineno,
+                    f"unhashable {type(arg).__name__.lower()} literal at "
+                    f"static_argnums position {i}"))
+        for kw in node.keywords:
+            if kw.arg in names and isinstance(kw.value,
+                                              (ast.List, ast.Dict, ast.Set)):
+                out.append(_finding(
+                    "PH204", rel, lines, node.lineno,
+                    f"unhashable {type(kw.value).__name__.lower()} "
+                    f"literal for static_argnames '{kw.arg}'"))
+    return out

@@ -1,0 +1,202 @@
+"""Runtime sanitizers: collective-trace alignment + flat-compile checks.
+
+Static analysis catches the lexical shapes of SPMD divergence; these
+two sanitizers catch the *dynamic* ones, in tier-1, with zero
+dependence on jax (pure stdlib — importable from the lint CLI).
+
+**CollectiveTraceSanitizer** — a race detector for multi-controller
+code. The simulated harness (``testing.run_simulated_processes``)
+records every collective each simulated process issues through its
+``ThreadTransport`` — ``(op, site, payload descriptor)`` in program
+order — and verifies the sequences at join: under fail-stop SPMD,
+every process's trace must be a *prefix* of the longest trace (a
+process that died early stops participating; it must never have issued
+a DIFFERENT collective). A rank-conditioned extra allgather, a
+reordered barrier, or a payload-kind mismatch surfaces as
+:class:`CollectiveTraceMismatch` naming the step, the site(s), and the
+diverging ranks — instead of a silent generation-pairing corruption or
+a watchdog timeout with no attribution.
+
+Payload *values* are deliberately not compared: shards legitimately
+publish different row sets, and a health barrier's whole purpose is
+letting ranks report different status codes. Alignment is about the
+SEQUENCE — op kind + site label + payload kind.
+
+**CompileSanitizer** — one implementation of the "compile counter must
+stay flat" assertion that serving/CD tests previously each hand-rolled.
+Wrap the steady-state block; any counter movement beyond ``max_new``
+raises :class:`CompileSanitizerError` with the counter label and the
+moment it moved (``check()`` gives mid-block anchors, e.g. per sweep).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "CollectiveTraceMismatch", "CollectiveTraceSanitizer",
+    "CompileSanitizer", "CompileSanitizerError", "describe_payload",
+]
+
+# One trace event: (op, site, payload descriptor), e.g.
+# ("status", "entity_shard.exchange:cd:0:per-user", "i32") or
+# ("payload", "cd:0:per-user", "bytes").
+TraceEvent = Tuple[str, str, str]
+
+
+class CollectiveTraceMismatch(AssertionError):
+    """Simulated processes issued diverging collective sequences."""
+
+
+class CompileSanitizerError(AssertionError):
+    """A compile counter moved inside a block that must stay flat."""
+
+
+def describe_payload(payload) -> str:
+    """Stable payload-kind descriptor for trace events: enough to catch
+    a payload/status mix-up or a dtype drift, without comparing values
+    (which legitimately differ per rank)."""
+    if payload is None:
+        return "none"
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return "bytes"
+    if isinstance(payload, int):
+        return "i32"
+    dtype = getattr(payload, "dtype", None)
+    if dtype is not None:
+        return f"{dtype}[{getattr(payload, 'ndim', '?')}d]"
+    return type(payload).__name__
+
+
+class CollectiveTraceSanitizer:
+    """Verifier over per-rank collective event sequences.
+
+    Normally driven by ``run_simulated_processes`` (on by default);
+    usable standalone against any ``{rank: [TraceEvent, ...]}``."""
+
+    @staticmethod
+    def verify(traces: Mapping[int, Sequence[TraceEvent]],
+               *, context: str = "", strict_sites: bool = True) -> None:
+        """Raise :class:`CollectiveTraceMismatch` unless every rank's
+        trace is a prefix of the longest trace.
+
+        ``strict_sites=False`` relaxes SITE comparison for *status*
+        (barrier) events, comparing only op + payload kind. This is the
+        failure-path mode: a CollectiveGuard that catches a local
+        exception reports it through a barrier that deliberately pairs
+        with whatever barrier the healthy peers reach next — the tags
+        differ by design, and only the op/kind stream must still align.
+        Clean runs keep ``strict_sites=True`` so two processes sitting
+        in different *phases* (same op shape, different site) are
+        caught."""
+        if not traces:
+            return
+        ranks = sorted(traces)
+        ref_rank = max(ranks, key=lambda r: (len(traces[r]), -r))
+        ref = list(traces[ref_rank])
+
+        def mismatch(a: TraceEvent, b: TraceEvent) -> bool:
+            if a == b:
+                return False
+            op_a, site_a, desc_a = a
+            op_b, site_b, desc_b = b
+            if op_a != op_b or desc_a != desc_b:
+                return True
+            return strict_sites and site_a != site_b
+
+        for rank in ranks:
+            if rank == ref_rank:
+                continue
+            seq = list(traces[rank])
+            for step, event in enumerate(seq):
+                if mismatch(event, ref[step]):
+                    raise CollectiveTraceMismatch(
+                        CollectiveTraceSanitizer._explain(
+                            step, ref_rank, ref[step], rank, event,
+                            context))
+
+    @staticmethod
+    def _explain(step: int, ref_rank: int, ref_event: TraceEvent,
+                 rank: int, event: TraceEvent, context: str) -> str:
+        op_a, site_a, desc_a = event
+        op_b, site_b, desc_b = ref_event
+        where = f" [{context}]" if context else ""
+        return (
+            f"collective sequence mismatch at step {step}{where}: "
+            f"process {rank} issued {op_a} at site '{site_a}' "
+            f"(payload {desc_a}) while process {ref_rank} issued "
+            f"{op_b} at site '{site_b}' (payload {desc_b}); the ranks' "
+            "collective streams have diverged — under the real "
+            "multi-controller runtime these calls would pair up and "
+            "exchange garbage or deadlock")
+
+
+class CompileSanitizer:
+    """Assert compile counters stay flat across a block.
+
+    Counters are callables returning an int, or objects exposing a
+    ``compile_count`` attribute (``ScoringSession``)::
+
+        with CompileSanitizer(session, label="serving ladder") as san:
+            for _ in range(110):
+                session.score_rows(...)
+            san.check("steady state")      # optional mid-block anchor
+
+        with CompileSanitizer(re_solver_compile_count, max_new=0):
+            cd.run(dataset)
+
+    ``max_new`` admits a known number of fresh executables (e.g. a lazy
+    first-touch) while still bounding the block.
+    """
+
+    def __init__(self, *counters, max_new: int = 0, label: str = ""):
+        if not counters:
+            raise ValueError("CompileSanitizer needs at least one counter")
+        self._fns: List[Tuple[str, Callable[[], int]]] = []
+        for c in counters:
+            self._fns.append(self._resolve(c))
+        self.max_new = int(max_new)
+        self.label = label
+        self._start: Optional[List[int]] = None
+
+    @staticmethod
+    def _resolve(c) -> Tuple[str, Callable[[], int]]:
+        if callable(c):
+            name = getattr(c, "__name__", type(c).__name__)
+            return (name, lambda: int(c()))
+        if hasattr(c, "compile_count"):
+            name = f"{type(c).__name__}.compile_count"
+            return (name, lambda: int(c.compile_count))
+        raise TypeError(
+            f"counter must be callable or expose .compile_count, got "
+            f"{type(c).__name__}")
+
+    def __enter__(self) -> "CompileSanitizer":
+        self._start = [fn() for _name, fn in self._fns]
+        return self
+
+    @property
+    def new_compiles(self) -> int:
+        if self._start is None:
+            raise RuntimeError("CompileSanitizer used outside its block")
+        return sum(fn() - s
+                   for (_n, fn), s in zip(self._fns, self._start))
+
+    def check(self, moment: str = "") -> None:
+        """Assert flatness right now (mid-block anchor: per sweep, per
+        swap, per request wave)."""
+        assert self._start is not None, "check() before __enter__"
+        for (name, fn), start in zip(self._fns, self._start):
+            now = fn()
+            if now - start > self.max_new:
+                at = f" at {moment}" if moment else ""
+                tag = f" [{self.label}]" if self.label else ""
+                raise CompileSanitizerError(
+                    f"compile counter '{name}'{tag} moved "
+                    f"{start} -> {now}{at} (allowed new: "
+                    f"{self.max_new}): the hot path recompiled")
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self.check("block exit")
+        return False

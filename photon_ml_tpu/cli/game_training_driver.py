@@ -574,12 +574,17 @@ def main(argv: Sequence[str] | None = None) -> int:
         if resume_from:
             warm = load_game_model(resume_from)
             logger.log("auto_resume", checkpoint=resume_from)
-        if distributed:
-            # every process must have adopted the checkpoint before any
-            # enters training's first collective; the health barrier
-            # doubles as the ordering sync and surfaces a peer whose
-            # marker load failed
-            resilience.health_barrier("auto_resume_loaded")
+    if args.auto_resume and distributed:
+        # every process must have adopted the checkpoint (or observed
+        # its absence) before any enters training's first collective;
+        # the health barrier doubles as the ordering sync and surfaces
+        # a peer whose marker load failed. It runs UNCONDITIONALLY of
+        # resume.exists(): that is a process-LOCAL filesystem probe, and
+        # a marker visible on only some hosts (eventual-consistency
+        # shared FS mid-write) would otherwise send part of the job to
+        # this barrier while the rest proceeds to training — diverging
+        # the collective sequences (photon-check PC102).
+        resilience.health_barrier("auto_resume_loaded")
 
     evaluators = args.evaluators
     if evaluators is None:

@@ -73,7 +73,7 @@ from photon_ml_tpu.parallel.entity_shard import (
     exchange_score_updates,
 )
 from photon_ml_tpu.parallel.mesh import make_mesh
-from photon_ml_tpu.parallel.resilience import CollectiveGuard
+from photon_ml_tpu.parallel.resilience import CollectiveGuard, health_barrier
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures, margins as _margins
 
 
@@ -727,6 +727,14 @@ class _FixedState:
             outs.append(np.asarray(pending))
         s0, s1 = self._score_span
         local = np.concatenate(outs)[: s1 - s0]
+        # The reassembly allgather is a collective boundary and must
+        # follow the PR-1 contract: pre-gather health barrier so a peer
+        # whose streamed pass failed aborts every process here instead
+        # of wedging the gather. train_scores is also reachable OUTSIDE
+        # the sweep guard (warm start / initial scoring in run()), so
+        # the barrier lives at the gather, not only in the caller.
+        fault_injection.check("cd.score_gather")
+        health_barrier("cd.score_gather")
         # out-of-core block parts are contiguous but not span_of-aligned:
         # reassemble via the parts' recorded row spans
         if getattr(self, "_ooc_part_spans", None) is not None:

@@ -60,6 +60,7 @@ import numpy as np
 
 from photon_ml_tpu.parallel import fault_injection
 from photon_ml_tpu.parallel.resilience import (
+    collective_site,
     current_transport,
     default_timeout,
     health_barrier,
@@ -261,7 +262,8 @@ def _guarded_gather(blob: bytes, *, tag: str,
     tp = current_transport()
     if tp.process_count() > 1:
         health_barrier(f"entity_shard.exchange:{tag}", timeout=timeout)
-    blobs = allgather_blobs(blob, timeout=timeout)
+    with collective_site(tag):  # trace label for the sanitizer
+        blobs = allgather_blobs(blob, timeout=timeout)
     if stats is not None:
         stats.exchanges += 1
         stats.bytes_sent += len(blob)

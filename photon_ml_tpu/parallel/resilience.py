@@ -60,6 +60,7 @@ __all__ = [
     "health_barrier", "CollectiveGuard", "guarded", "retry_transient",
     "ResumeManager", "current_transport", "use_transport",
     "current_process_index", "default_timeout",
+    "collective_site", "current_collective_site",
     "CODE_OK", "CODE_ERROR", "CODE_DEVICE_LOSS", "CODE_DATA",
 ]
 
@@ -191,6 +192,28 @@ def current_process_index() -> int:
     return jax.process_index()
 
 
+def current_collective_site() -> str:
+    """The ambient label of the collective being issued on this thread.
+
+    Purely observational: the collective-trace sanitizer
+    (``analysis/sanitizers.py``) records it per simulated process so a
+    sequence mismatch can name the SITE that diverged, not just a step
+    number. Empty when no labeled collective is in flight."""
+    return getattr(_tls, "collective_site", "")
+
+
+@contextlib.contextmanager
+def collective_site(tag: str):
+    """Thread-locally label the collective(s) issued inside the block
+    (the trace hook the barrier and the entity-shard exchange use)."""
+    prev = getattr(_tls, "collective_site", "")
+    _tls.collective_site = tag
+    try:
+        yield
+    finally:
+        _tls.collective_site = prev
+
+
 @contextlib.contextmanager
 def use_transport(transport):
     """Thread-locally override the transport (simulated processes install
@@ -217,7 +240,8 @@ def health_barrier(tag: str, failure: Optional[BaseException] = None,
             raise failure
         return
     code = CODE_OK if failure is None else code_for(failure)
-    codes = tp.allgather_status(code, timeout or default_timeout())
+    with collective_site(tag):
+        codes = tp.allgather_status(code, timeout or default_timeout())
     failed = {i: c for i, c in enumerate(codes) if c != CODE_OK}
     if not failed:
         return

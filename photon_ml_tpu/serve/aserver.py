@@ -182,7 +182,9 @@ class AsyncScoringServer:
                     pass  # non-main thread / platforms without support
             await self.start()
             if ready_callback is not None:
-                ready_callback(self)
+                # ready callbacks are opaque and the driver's write
+                # JSONL logs — file IO stays off the loop (PB303)
+                await loop.run_in_executor(None, ready_callback, self)
             await stop.wait()
             await self.aclose(drain_timeout_s)
 
@@ -373,7 +375,9 @@ class AsyncFrontDoor:
                     pass
             await self.start()
             if ready_callback is not None:
-                ready_callback(self)
+                # same contract as AsyncScoringServer.run_forever: the
+                # driver's ready callback logs to disk — executor it
+                await loop.run_in_executor(None, ready_callback, self)
             await stop.wait()
             await self.aclose()
 

@@ -195,6 +195,17 @@ class _SimGroup:
         self.gen = 0
         self.slots: Dict[int, Dict[int, int]] = {}
         self.results: Dict[int, List[int]] = {}
+        # per-rank collective event sequences, recorded at CALL time (a
+        # process that dies inside a rendezvous still recorded its
+        # intent) and verified at join by the collective-trace sanitizer
+        self.traces: Dict[int, list] = {i: [] for i in range(n)}
+
+    def record(self, rank: int, op: str, payload) -> None:
+        from photon_ml_tpu.analysis.sanitizers import describe_payload
+        from photon_ml_tpu.parallel.resilience import current_collective_site
+
+        self.traces[rank].append(
+            (op, current_collective_site(), describe_payload(payload)))
 
     def exchange(self, rank: int, code: int, timeout: float) -> List[int]:
         from photon_ml_tpu.parallel.resilience import WatchdogTimeout
@@ -235,6 +246,7 @@ class ThreadTransport:
         return self._group.n
 
     def allgather_status(self, code: int, timeout: float) -> List[int]:
+        self._group.record(self._rank, "status", code)
         return self._group.exchange(self._rank, code, timeout)
 
     def allgather_payload(self, payload, timeout: float) -> list:
@@ -245,17 +257,28 @@ class ThreadTransport:
         stay SPMD-ordered exactly like the real runtime's in-order
         collective stream — and a peer that never arrives surfaces as
         WatchdogTimeout here too."""
+        self._group.record(self._rank, "payload", payload)
         return self._group.exchange(self._rank, payload, timeout)
 
 
 def run_simulated_processes(n: int, fn: Callable, *,
-                            join_timeout: float = 120.0) -> list:
+                            join_timeout: float = 120.0,
+                            verify_collectives: bool = True) -> list:
     """Run ``fn(process_index)`` on ``n`` simulated processes (threads,
     each under its own resilience transport + fault-injection process
     context) and return the per-process OUTCOMES: the return value,
     the raised exception object, or :class:`Dropped` for a process that
     died silently / never finished. Exceptions are captured, not raised —
-    fault tests assert on the whole outcome vector."""
+    fault tests assert on the whole outcome vector.
+
+    ``verify_collectives`` (default on) runs the collective-trace
+    sanitizer at join: every process's recorded collective sequence
+    (op, site, payload kind) must be a prefix of the longest one —
+    fail-stop processes stop early, but a process must never issue a
+    DIFFERENT collective. Divergence raises
+    :class:`~photon_ml_tpu.analysis.sanitizers.CollectiveTraceMismatch`
+    naming the step, sites, and ranks. Skipped when a thread is still
+    alive at ``join_timeout`` (its trace is still moving)."""
     from photon_ml_tpu.parallel import fault_injection, resilience
 
     group = _SimGroup(n)
@@ -279,4 +302,19 @@ def run_simulated_processes(n: int, fn: Callable, *,
     deadline = time.monotonic() + join_timeout
     for t in threads:
         t.join(max(0.0, deadline - time.monotonic()))
+    if verify_collectives and not any(t.is_alive() for t in threads):
+        from photon_ml_tpu.analysis.sanitizers import (
+            CollectiveTraceSanitizer,
+        )
+
+        # Site labels are compared strictly only on CLEAN runs: a
+        # guard reporting a local failure pairs its barrier with
+        # whatever barrier the healthy peers reach next (tags differ
+        # by design there), but op/payload-kind streams must align
+        # regardless.
+        clean = not any(isinstance(o, (BaseException, Dropped))
+                        for o in outcomes)
+        CollectiveTraceSanitizer.verify(
+            group.traces, context=f"{n} simulated processes",
+            strict_sites=clean)
     return outcomes

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Static-analysis gate: photon-check over the package (baseline-gated —
+# any NEW violation fails) plus the fault-site coverage audit. Distinct
+# exit codes so CI can tell the failure class apart from the tier-1
+# (ci_tier1.sh) and bench-smoke (ci_bench_smoke.sh, exits 7/8) gates:
+#   9   lint findings not covered by the justified baseline
+#  10   a registered fault-injection site has no tier-1 test arming it
+cd "$(dirname "$0")/.."
+set -o pipefail
+
+echo "== photon-check lint =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
+    --baseline photon-check-baseline.json || exit 9
+
+echo "== photon-check fault-site audit =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
+    --fault-sites || exit 10
+
+echo "ci_lint OK"

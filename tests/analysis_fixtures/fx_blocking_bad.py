@@ -1,0 +1,22 @@
+"""photon-check fixture: known-BAD event-loop blocking patterns."""
+
+import json
+import time
+
+
+def _read_manifest(path):
+    with open(path) as f:  # the blocking leaf PB302 must chase down
+        return json.load(f)
+
+
+async def sleepy_handler(request):
+    time.sleep(0.5)  # ANCHOR:PB301
+    return request
+
+
+async def loop_blocking_read(path):
+    return _read_manifest(path)  # ANCHOR:PB302
+
+
+async def run_ready(ready_callback, server):
+    ready_callback(server)  # ANCHOR:PB303

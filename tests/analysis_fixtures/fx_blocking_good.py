@@ -1,0 +1,31 @@
+"""photon-check fixture: known-GOOD event-loop patterns (zero findings)."""
+
+import asyncio
+import json
+import time
+
+
+def _read_manifest(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def sync_worker(path):
+    # blocking is fine OFF the loop (batcher worker, watcher thread)
+    time.sleep(0.01)
+    return _read_manifest(path)
+
+
+async def executor_read(path):
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, _read_manifest, path)
+
+
+async def executor_callback(ready_callback, server):
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, ready_callback, server)
+
+
+async def pure_async(reader):
+    data = await reader.readexactly(4)
+    return json.loads(data)

@@ -1,0 +1,30 @@
+"""photon-check fixture: known-BAD collective-alignment patterns.
+
+Never imported — parsed by the lint only. ``# ANCHOR:CODE`` comments
+mark the exact line each finding must anchor to; the tests resolve them
+to line numbers so the assertions survive edits above."""
+
+
+def process_allgather(x):  # stand-in for multihost_utils'
+    return [x]
+
+
+def health_barrier(tag):
+    pass
+
+
+def unguarded_gather(partials):
+    # no CollectiveGuard, no preceding barrier: a dead peer wedges this
+    return process_allgather(partials)  # ANCHOR:PC101
+
+
+def rank_conditioned_gather(transport, partials):
+    health_barrier("pre")
+    if transport.process_index() == 0:
+        return process_allgather(partials)  # ANCHOR:PC102
+    return [partials]
+
+
+def marker_probe_barrier(resume, distributed):
+    if resume.exists():
+        health_barrier("resume_loaded")  # ANCHOR:PC102b

@@ -1,0 +1,47 @@
+"""photon-check fixture: known-GOOD collective patterns (zero findings)."""
+
+
+class CollectiveGuard:
+    def __init__(self, tag):
+        self.tag = tag
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def process_allgather(x):
+    return [x]
+
+
+def health_barrier(tag):
+    pass
+
+
+def guarded_gather(partials):
+    with CollectiveGuard("stream.fg"):
+        return process_allgather(partials)
+
+
+def barrier_then_gather(partials):
+    health_barrier("phase")
+    return process_allgather(partials)
+
+
+def uniform_branch_gather(num_shards, partials):
+    # process_count/num_shards are job-uniform: every process takes the
+    # same branch, no divergence
+    health_barrier("phase")
+    if num_shards > 1:
+        return process_allgather(partials)
+    return [partials]
+
+
+def aligned_branches(transport, partials):
+    health_barrier("phase")
+    if transport.process_index() == 0:
+        return process_allgather(partials)
+    else:
+        return process_allgather(partials)

@@ -1,0 +1,9 @@
+"""photon-check fixture: a fault-injection site no test ever arms —
+the audit must list it as uncovered."""
+
+from photon_ml_tpu.parallel import fault_injection
+
+
+def risky_phase():
+    fault_injection.check("fixture.never_exercised_site")
+    fault_injection.check("cd.step")  # a covered site for contrast

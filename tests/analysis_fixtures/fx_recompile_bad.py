@@ -1,0 +1,30 @@
+"""photon-check fixture: known-BAD recompile-hazard patterns."""
+
+import jax
+import jax.numpy as jnp
+
+score_jit = jax.jit(lambda x: x)
+static_fn = jax.jit(lambda cfg, x: x, static_argnums=(0,))
+
+
+def per_call_jit(batch):
+    @jax.jit  # ANCHOR:PH201
+    def kernel(x):
+        return jnp.sum(x)
+
+    return kernel(batch)
+
+
+@jax.jit
+def concretizing_kernel(x, n):
+    scale = float(n)  # ANCHOR:PH202
+    peek = x.item()  # ANCHOR:PH202b
+    return x * scale + peek
+
+
+def unbucketed_call(rows):
+    return score_jit(jnp.zeros((len(rows), 4)))  # ANCHOR:PH203
+
+
+def object_static_arg(x):
+    return static_fn([1, 2, 3], x)  # ANCHOR:PH204

@@ -1,0 +1,40 @@
+"""photon-check fixture: known-GOOD recompile patterns (zero findings)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def bucketize(n, ladder):
+    return n
+
+
+score_jit = jax.jit(lambda x: x)
+
+
+@jax.jit
+def module_level_kernel(x):
+    return jnp.sum(x)
+
+
+@functools.lru_cache(maxsize=64)
+def memoized_solver(width):
+    return jax.jit(lambda x: x * width)
+
+
+class Session:
+    def __init__(self):
+        self._compiled = {}
+
+    def executable(self, dim):
+        fn = self._compiled.get(dim)
+        if fn is None:
+            fn = jax.jit(lambda x: x + dim)
+            self._compiled[dim] = fn
+        return fn
+
+
+def bucketed_call(rows, ladder):
+    width = bucketize(len(rows), ladder)
+    return score_jit(jnp.zeros((width, 4)))

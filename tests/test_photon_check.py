@@ -1,0 +1,282 @@
+"""photon-check: the analysis passes against known-good/known-bad
+fixture modules (exact finding codes + file:line anchors), the
+baseline/pragma suppression contract, the fault-site coverage audit,
+and — the meta-gate — the repo itself staying clean under its own lint.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from photon_ml_tpu.analysis import __version__ as pcheck_version
+from photon_ml_tpu.analysis.core import (
+    BaselineError,
+    load_baseline,
+    run_check,
+)
+from photon_ml_tpu.analysis.fault_sites import (
+    audit_fault_sites,
+    registered_sites,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _anchors(path):
+    """``# ANCHOR:tag`` comment -> line number, so the exact-line
+    assertions survive edits elsewhere in the fixture."""
+    out = {}
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            m = re.search(r"#\s*ANCHOR:(\w+)", line)
+            if m:
+                out[m.group(1)] = i
+    return out
+
+
+def _run(paths, **kw):
+    kw.setdefault("hot_paths", ["*"])
+    kw.setdefault("blocking_scope", ["*"])
+    report = run_check(paths, repo_root=REPO_ROOT, **kw)
+    return report["findings"]
+
+
+def _by_code(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.code, []).append(f)
+    return out
+
+
+# -- collectives pass -------------------------------------------------------
+def test_collectives_bad_fixture_exact_codes_and_lines():
+    path = _fx("fx_collectives_bad.py")
+    anchors = _anchors(path)
+    by = _by_code(_run([path], passes=["collectives"]))
+    assert set(by) == {"PC101", "PC102"}
+    assert [f.line for f in by["PC101"]] == [anchors["PC101"]]
+    assert sorted(f.line for f in by["PC102"]) == sorted(
+        [anchors["PC102"], anchors["PC102b"]])
+    (pc101,) = by["PC101"]
+    assert pc101.path.endswith("fx_collectives_bad.py")
+    assert "process_allgather" in pc101.message
+    assert "CollectiveGuard" in pc101.hint
+    markers = {f.line: f.message for f in by["PC102"]}
+    assert "process_index()" in markers[anchors["PC102"]]
+    assert "exists()" in markers[anchors["PC102b"]]
+
+
+def test_collectives_good_fixture_clean():
+    assert _run([_fx("fx_collectives_good.py")],
+                passes=["collectives"]) == []
+
+
+# -- recompile pass ---------------------------------------------------------
+def test_recompile_bad_fixture_exact_codes_and_lines():
+    path = _fx("fx_recompile_bad.py")
+    anchors = _anchors(path)
+    by = _by_code(_run([path], passes=["recompile"]))
+    assert set(by) == {"PH201", "PH202", "PH203", "PH204"}
+    assert [f.line for f in by["PH201"]] == [anchors["PH201"] + 1]
+    # (a decorated def anchors at its `def` line, under the decorator)
+    assert sorted(f.line for f in by["PH202"]) == sorted(
+        [anchors["PH202"], anchors["PH202b"]])
+    assert [f.line for f in by["PH203"]] == [anchors["PH203"]]
+    assert [f.line for f in by["PH204"]] == [anchors["PH204"]]
+    assert "item()" in " ".join(f.message for f in by["PH202"])
+    assert "len()" in by["PH203"][0].message
+
+
+def test_recompile_good_fixture_clean():
+    assert _run([_fx("fx_recompile_good.py")], passes=["recompile"]) == []
+
+
+def test_recompile_cold_path_modules_skip_ph201():
+    """PH201/PH203 are hot-path-scoped: the same bad module produces no
+    construction findings when it is not in the hot-path set."""
+    path = _fx("fx_recompile_bad.py")
+    findings = _run([path], passes=["recompile"], hot_paths=["nothing.py"])
+    codes = {f.code for f in findings}
+    assert "PH201" not in codes and "PH203" not in codes
+    assert "PH202" in codes  # traced concretization flags everywhere
+
+
+# -- blocking pass ----------------------------------------------------------
+def test_blocking_bad_fixture_exact_codes_and_lines():
+    path = _fx("fx_blocking_bad.py")
+    anchors = _anchors(path)
+    by = _by_code(_run([path], passes=["blocking"]))
+    assert set(by) == {"PB301", "PB302", "PB303"}
+    assert [f.line for f in by["PB301"]] == [anchors["PB301"]]
+    assert [f.line for f in by["PB302"]] == [anchors["PB302"]]
+    assert [f.line for f in by["PB303"]] == [anchors["PB303"]]
+    assert "time.sleep" in by["PB301"][0].message
+    assert "_read_manifest" in by["PB302"][0].message
+    assert "ready_callback" in by["PB303"][0].message
+
+
+def test_blocking_good_fixture_clean():
+    assert _run([_fx("fx_blocking_good.py")], passes=["blocking"]) == []
+
+
+# -- suppression: pragma + baseline ----------------------------------------
+def test_inline_pragma_requires_reason(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "def process_allgather(x):\n    return [x]\n\n\n"
+        "def gather_a(p):\n"
+        "    return process_allgather(p)  "
+        "# photon-check: allow[PC101] guarded by caller X\n\n\n"
+        "def gather_b(p):\n"
+        "    return process_allgather(p)  # photon-check: allow[PC101]\n")
+    findings = _run([str(bad)], passes=["collectives"])
+    # the reasoned pragma suppresses; the reasonless one does not
+    assert [f.line for f in findings] == [10]
+
+
+def test_baseline_suppresses_by_snippet_and_reports_stale(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("def process_allgather(x):\n    return [x]\n\n\n"
+                   "def gather(p):\n    return process_allgather(p)\n")
+    base = tmp_path / "baseline.json"
+    rel = os.path.relpath(str(mod), REPO_ROOT).replace(os.sep, "/")
+    base.write_text(json.dumps({"entries": [
+        {"code": "PC101", "path": rel,
+         "snippet": "return process_allgather(p)",
+         "justification": "fixture: guarded one frame up"},
+        {"code": "PC101", "path": rel, "snippet": "not in the file",
+         "justification": "stale entry"},
+    ]}))
+    report = run_check([str(mod)], baseline=load_baseline(str(base)),
+                       repo_root=REPO_ROOT, passes=["collectives"])
+    assert report["findings"] == []
+    assert [(f.code, via) for f, via in report["suppressed"]] == [
+        ("PC101", "baseline")]
+    assert [e.snippet for e in report["stale_baseline"]] == [
+        "not in the file"]
+
+
+def test_baseline_rejects_missing_justification(tmp_path):
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"entries": [
+        {"code": "PC101", "path": "x.py", "snippet": "s",
+         "justification": "  TODO "},
+    ]}))
+    with pytest.raises(BaselineError, match="justification"):
+        load_baseline(str(base))
+
+
+# -- the repo under its own lint -------------------------------------------
+def test_repo_is_clean_under_photon_check():
+    """The acceptance gate, in tier-1: zero unsuppressed findings over
+    the package, no stale baseline entries, every entry justified."""
+    baseline = load_baseline(
+        os.path.join(REPO_ROOT, "photon-check-baseline.json"))
+    report = run_check([os.path.join(REPO_ROOT, "photon_ml_tpu")],
+                       baseline=baseline, repo_root=REPO_ROOT)
+    assert report["findings"] == [], "\n".join(
+        f.render() for f in report["findings"])
+    assert report["stale_baseline"] == [], [
+        (e.code, e.path, e.snippet) for e in report["stale_baseline"]]
+    assert report["files_checked"] > 50
+    assert pcheck_version
+
+
+# -- fault-site audit -------------------------------------------------------
+def test_fault_site_audit_detects_uncovered_site(tmp_path):
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_fake.py").write_text(
+        "def test_x():\n    site = 'cd.step'\n")
+    audit = audit_fault_sites(_fx("fx_fault_site.py"), str(tests_dir))
+    assert set(audit.registered) == {"fixture.never_exercised_site",
+                                     "cd.step"}
+    assert audit.exercised == {"cd.step"}
+    assert audit.uncovered == ["fixture.never_exercised_site"]
+    assert not audit.ok
+    assert "MISSING" in audit.render()
+
+
+def test_fault_site_registry_covers_known_sites():
+    reg = registered_sites(os.path.join(REPO_ROOT, "photon_ml_tpu"))
+    for site in ("cd.step", "entity_shard.exchange", "cd.score_gather",
+                 "chunk_cache.spill", "stream.block_payload",
+                 "registry.publish_prepared"):
+        assert site in reg, sorted(reg)
+
+
+def test_repo_fault_sites_all_exercised():
+    """Every registered fault-injection site is armed by some tier-1
+    test — the audit ci_lint.sh runs, enforced in-tree too."""
+    audit = audit_fault_sites(os.path.join(REPO_ROOT, "photon_ml_tpu"),
+                              os.path.dirname(__file__))
+    assert audit.ok, f"uncovered fault sites: {audit.uncovered}"
+
+
+# -- the new cd.score_gather site is genuinely exercisable ------------------
+def test_score_gather_fault_site_fires_on_streamed_cd(tmp_path):
+    """Arm a fault at the streamed score-reassembly collective boundary:
+    the injected failure must surface (single-process: unchanged
+    propagation) instead of the gather running past a failed peer."""
+    np = pytest.importorskip("numpy")
+    pytest.importorskip("jax")
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        GameDataset,
+    )
+    from photon_ml_tpu.io.data_reader import (
+        read_training_examples,
+        write_training_examples,
+    )
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.stream_source import AvroChunkSource
+    from photon_ml_tpu.parallel import fault_injection
+
+    rng = np.random.default_rng(5)
+    n, vocab = 96, 12
+    rows = []
+    for _ in range(n):
+        cols = rng.choice(vocab, size=3, replace=False)
+        rows.append([(f"f{c}", "", float(rng.normal())) for c in cols])
+    labels = rng.integers(0, 2, n).astype(float)
+    path = str(tmp_path / "train.avro")
+    write_training_examples(path, rows, labels, block_size=48)
+    imap = IndexMap({f"f{c}": c for c in range(vocab)},
+                    add_intercept=True)
+    feats, labels_r, offsets, weights, _, _ = read_training_examples(
+        path, {"global": imap})
+    users = rng.integers(0, 4, n).astype(str)
+    configs = [
+        CoordinateConfig("fixed", "fixed", feature_shard="global",
+                         streaming=True, chunk_rows=48, max_iters=3,
+                         reg_type="l2", reg_weight=0.5),
+        CoordinateConfig("per-user", "random", feature_shard="re",
+                         entity_column="userId", max_iters=3,
+                         reg_type="l2", reg_weight=1.0),
+    ]
+
+    def run():
+        ds = GameDataset(
+            {"re": feats["global"]}, labels_r, weights, offsets,
+            {"userId": users},
+            feature_sources={"global": AvroChunkSource(
+                path, imap, chunk_rows=48)})
+        return CoordinateDescent(configs, n_iterations=1).run(ds)
+
+    run()  # clean run reaches the site
+    fault_injection.install([fault_injection.Fault(
+        site="cd.score_gather", kind="raise")])
+    try:
+        with pytest.raises(fault_injection.InjectedFault,
+                           match="cd.score_gather"):
+            run()
+    finally:
+        fault_injection.clear()

@@ -1,0 +1,208 @@
+"""Runtime sanitizers: collective-trace alignment over the simulated
+multi-controller harness, and the CompileSanitizer flat-counter
+contract."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from photon_ml_tpu.analysis.sanitizers import (
+    CollectiveTraceMismatch,
+    CollectiveTraceSanitizer,
+    CompileSanitizer,
+    CompileSanitizerError,
+    describe_payload,
+)
+from photon_ml_tpu.testing import run_simulated_processes
+
+
+# -- trace verifier (pure) --------------------------------------------------
+def test_verify_accepts_aligned_and_prefix_traces():
+    a = [("status", "p1", "i32"), ("payload", "x", "bytes")]
+    CollectiveTraceSanitizer.verify({0: a, 1: list(a)})
+    # fail-stop: a dead process's shorter trace is a clean prefix
+    CollectiveTraceSanitizer.verify({0: a, 1: a[:1], 2: []})
+
+
+def test_verify_names_step_site_and_ranks_on_divergence():
+    traces = {
+        0: [("status", "p1", "i32"), ("payload", "extra", "bytes")],
+        1: [("status", "p1", "i32"), ("status", "p2", "i32")],
+    }
+    with pytest.raises(CollectiveTraceMismatch) as err:
+        CollectiveTraceSanitizer.verify(traces, context="unit")
+    msg = str(err.value)
+    assert "step 1" in msg and "unit" in msg
+    assert "'extra'" in msg and "'p2'" in msg
+    assert "process 0" in msg and "process 1" in msg
+
+
+def test_describe_payload_kinds():
+    assert describe_payload(b"xx") == "bytes"
+    assert describe_payload(3) == "i32"
+    assert describe_payload(np.zeros((2, 3))) == "float64[2d]"
+    assert describe_payload(None) == "none"
+
+
+# -- wired into the simulated harness --------------------------------------
+def test_simulated_aligned_collectives_pass():
+    """Barriers + a payload exchange on every rank: the default-on trace
+    verification accepts the run (the 4-process legs the entity-shard
+    and resilience tests run stay green under the sanitizer)."""
+    from photon_ml_tpu.parallel import resilience
+    from photon_ml_tpu.parallel.entity_shard import exchange_score_updates
+
+    def fn(rank):
+        resilience.health_barrier("phase1", timeout=10.0)
+        rows = np.arange(rank + 1, dtype=np.int32)  # rank-varying SIZE ok
+        got = exchange_score_updates(
+            [rows, rows.astype(np.float64)], tag="t", timeout=10.0)
+        resilience.health_barrier("phase2", timeout=10.0)
+        return len(got)
+
+    outcomes = run_simulated_processes(4, fn, join_timeout=30.0)
+    assert outcomes == [4, 4, 4, 4]
+
+
+def test_simulated_rank_conditioned_extra_allgather_detected():
+    """THE acceptance fixture: one rank issues an extra collective
+    behind a rank condition. The generations pair up mismatched ops —
+    exactly the silent corruption the sanitizer exists to catch — and
+    verification at join reports site + ranks."""
+    from photon_ml_tpu.parallel import resilience
+
+    def fn(rank):
+        resilience.health_barrier("phase1", timeout=5.0)
+        if rank == 0:  # process-divergent collective (PC102 at runtime)
+            tp = resilience.current_transport()
+            tp.allgather_payload(b"rogue", 2.0)
+        resilience.health_barrier("phase2", timeout=2.0)
+
+    with pytest.raises(CollectiveTraceMismatch) as err:
+        run_simulated_processes(4, fn, join_timeout=30.0)
+    msg = str(err.value)
+    assert "payload" in msg and "'phase2'" in msg
+    assert "process" in msg and "diverged" in msg
+
+
+def test_simulated_failstop_prefix_tolerated():
+    """A process that dies locally stops issuing collectives; peers
+    coordinate the abort at the next barrier. Traces diverge in LENGTH
+    only — the sanitizer must not flag fail-stop."""
+    from photon_ml_tpu.parallel import fault_injection, resilience
+
+    def fn(rank):
+        resilience.health_barrier("phase1", timeout=10.0)
+        fault_injection.check("sanitizer.work")
+        resilience.health_barrier("phase2", timeout=10.0)
+        return "ok"
+
+    fault_injection.install([fault_injection.Fault(
+        site="sanitizer.work", kind="raise", process=2)])
+    try:
+        outcomes = run_simulated_processes(
+            3, lambda r: _guarded(fn, r), join_timeout=30.0)
+    finally:
+        fault_injection.clear()
+    assert isinstance(outcomes[2], resilience.PeerFailure)  # reporter
+    assert isinstance(outcomes[0], resilience.PeerFailure)
+    assert isinstance(outcomes[1], resilience.PeerFailure)
+
+
+def _guarded(fn, rank):
+    from photon_ml_tpu.parallel.resilience import CollectiveGuard
+
+    with CollectiveGuard("sanitizer.step", timeout=10.0):
+        return fn(rank)
+
+
+def test_simulated_divergent_phase_tags_detected_on_clean_run():
+    """Two processes sitting in DIFFERENT phases whose barriers happen
+    to pair up (same op, same payload kind, both report OK) complete
+    'successfully' — the classic silent phase skew. On a clean run the
+    sanitizer compares sites strictly and catches it."""
+    from photon_ml_tpu.parallel import resilience
+
+    def fn(rank):
+        resilience.health_barrier("phase1", timeout=5.0)
+        resilience.health_barrier("warmup" if rank == 0 else "train",
+                                  timeout=5.0)
+        return "ok"
+
+    with pytest.raises(CollectiveTraceMismatch) as err:
+        run_simulated_processes(2, fn, join_timeout=30.0)
+    assert "'warmup'" in str(err.value) and "'train'" in str(err.value)
+
+
+def test_verify_collectives_can_be_disabled():
+    from photon_ml_tpu.parallel import resilience
+
+    def fn(rank):
+        if rank == 0:
+            tp = resilience.current_transport()
+            tp.allgather_payload(b"rogue", 1.0)
+
+    outcomes = run_simulated_processes(2, fn, join_timeout=15.0,
+                                       verify_collectives=False)
+    # rank 1 exits without collectives; rank 0's rogue gather times out
+    assert outcomes[1] is None
+
+
+# -- CompileSanitizer -------------------------------------------------------
+class _FakeSession:
+    def __init__(self):
+        self.compile_count = 0
+
+
+def test_compile_sanitizer_flat_block_passes():
+    session = _FakeSession()
+    with CompileSanitizer(session, label="fake") as san:
+        san.check("mid")
+        assert san.new_compiles == 0
+
+
+def test_compile_sanitizer_raises_with_label_and_moment():
+    session = _FakeSession()
+    with pytest.raises(CompileSanitizerError) as err:
+        with CompileSanitizer(session, label="serving ladder") as san:
+            session.compile_count += 2
+            san.check("request wave 3")
+    msg = str(err.value)
+    assert "serving ladder" in msg and "request wave 3" in msg
+    assert "0 -> 2" in msg
+
+
+def test_compile_sanitizer_checks_at_exit_and_max_new():
+    session = _FakeSession()
+    with pytest.raises(CompileSanitizerError, match="block exit"):
+        with CompileSanitizer(session):
+            session.compile_count += 1
+    # an allowed lazy first-touch budget
+    session = _FakeSession()
+    with CompileSanitizer(session, max_new=1):
+        session.compile_count += 1
+
+
+def test_compile_sanitizer_callable_counter_and_multi():
+    counts = {"a": 0, "b": 0}
+    with CompileSanitizer(lambda: counts["a"], lambda: counts["b"]) as san:
+        assert san.new_compiles == 0
+    with pytest.raises(CompileSanitizerError):
+        with CompileSanitizer(lambda: counts["a"], lambda: counts["b"]):
+            counts["b"] += 1
+
+
+def test_compile_sanitizer_does_not_mask_body_exception():
+    session = _FakeSession()
+    with pytest.raises(ValueError, match="body"):
+        with CompileSanitizer(session):
+            session.compile_count += 5  # would fail the exit check
+            raise ValueError("body")  # but the body error wins
+
+
+def test_compile_sanitizer_rejects_bad_counter():
+    with pytest.raises(TypeError, match="compile_count"):
+        CompileSanitizer(object())
+    with pytest.raises(ValueError, match="at least one"):
+        CompileSanitizer()

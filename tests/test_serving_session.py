@@ -64,20 +64,23 @@ def test_session_per_coordinate_parity(saved_game_model):
 
 def test_no_steady_state_recompiles(saved_game_model):
     """After warmup, 100+ requests of varying sizes inside the bucket
-    ladder leave the compile-cache miss counter flat."""
+    ladder leave the compile-cache miss counter flat (enforced by the
+    shared CompileSanitizer, not a hand-rolled counter)."""
+    from photon_ml_tpu.analysis.sanitizers import CompileSanitizer
     from photon_ml_tpu.serve import ScoringSession
 
     model_dir, bundle = saved_game_model
     session = ScoringSession(model_dir, dtype="float64", max_batch=32)
-    warm = session.compile_count
-    assert warm == len(session.row_ladder)  # one fixed coord, full ladder
+    assert session.compile_count == len(session.row_ladder)
+    # one fixed coord, full ladder pre-compiled at warmup
     rng = np.random.default_rng(3)
-    for _ in range(110):
-        n = int(rng.integers(1, 33))  # every size within the ladder
-        idx = rng.integers(0, len(bundle["uid"]), n)
-        session.score_rows(serving_rows(bundle, idx))
-    assert session.compile_count == warm, (
-        "steady-state request sizes within the ladder must never compile")
+    with CompileSanitizer(session, label="serving steady state") as san:
+        for i in range(110):
+            n = int(rng.integers(1, 33))  # every size within the ladder
+            idx = rng.integers(0, len(bundle["uid"]), n)
+            session.score_rows(serving_rows(bundle, idx))
+            if i % 25 == 0:
+                san.check(f"request {i}")
     assert session.metrics.compile_cache_hits >= 110
     assert session.fixed_eager_batches == 0
 
