@@ -20,6 +20,13 @@ and ad-hoc per-test counters. This package makes them machine-checked:
   - ``blocking``     — PB301 (blocking primitive on the asyncio event
     loop), PB302 (call into a sync function that transitively blocks),
     PB303 (opaque callable parameter invoked synchronously on the loop).
+  - ``concurrency``  — PT401 (cross-thread attribute write without a
+    common owning lock), PT402 (inconsistent nested lock-acquisition
+    order in the static lock graph — ``photon-check --lock-graph``
+    dumps it as DOT), PT403 (thread started with no reachable bounded
+    ``join(timeout)``), PT404 (timeout-less blocking
+    ``Queue.get()``/``wait()`` in a worker loop), PT405 (callback
+    invoked while holding a lock).
 
 * **Fault-site audit** (``photon-check --fault-sites``): every
   ``fault_injection`` site registered in the package must be exercised
@@ -29,8 +36,12 @@ and ad-hoc per-test counters. This package makes them machine-checked:
 * **Runtime sanitizers** (:mod:`.sanitizers`): the collective-trace
   sanitizer asserts per-process collective-sequence alignment in the
   simulated multi-controller harness (a race detector for SPMD code),
-  and :class:`~.sanitizers.CompileSanitizer` subsumes the ad-hoc
-  flat-compile counters in the serving/CD tests.
+  :class:`~.sanitizers.CompileSanitizer` subsumes the ad-hoc
+  flat-compile counters in the serving/CD tests,
+  :class:`~.sanitizers.LockOrderSanitizer` raises on acquisition-order
+  cycles with both stacks (deadlock detection without deadlocking), and
+  :class:`~.sanitizers.ThreadLeakSanitizer` asserts no photon-named
+  thread outlives its block.
 
 Findings carry ``path:line`` + a fix hint. Accepted findings are
 suppressed by the checked-in ``photon-check-baseline.json`` (every entry
@@ -54,12 +65,18 @@ from photon_ml_tpu.analysis.sanitizers import (  # noqa: F401
     CollectiveTraceSanitizer,
     CompileSanitizer,
     CompileSanitizerError,
+    LockOrderSanitizer,
+    LockOrderViolation,
+    ThreadLeakError,
+    ThreadLeakSanitizer,
 )
 
 __all__ = [
     "__version__", "Finding", "PASS_CATALOG", "run_check", "load_baseline",
     "CollectiveTraceSanitizer", "CollectiveTraceMismatch",
-    "CompileSanitizer", "CompileSanitizerError", "repo_report",
+    "CompileSanitizer", "CompileSanitizerError",
+    "LockOrderSanitizer", "LockOrderViolation",
+    "ThreadLeakSanitizer", "ThreadLeakError", "repo_report",
 ]
 
 _REPO_REPORT_CACHE: dict = {}
@@ -89,6 +106,11 @@ def repo_report(root: str | None = None) -> dict:
             "files_checked": report["files_checked"],
             "findings": len(report["findings"]),
             "suppressed": len(report["suppressed"]),
+            # the concurrency passes' share (PT4xx), so a bench result
+            # records the threading-lint posture it was measured under
+            "concurrency_findings": sum(
+                1 for f in report["findings"]
+                if f.code.startswith("PT4")),
         }
     except Exception as e:  # bench must never die on a lint bug
         out = {"version": __version__, "error": str(e)}

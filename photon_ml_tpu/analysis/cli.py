@@ -14,6 +14,8 @@ Usage:
                                        filled in before CI accepts it)
   photon-check --json                  machine-readable report
   photon-check --list-passes           finding-code catalogue
+  photon-check --lock-graph            dump the inferred lock
+                                       acquisition-order graph as DOT
 """
 
 from __future__ import annotations
@@ -65,7 +67,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="tests root for --fault-sites (default: "
                         "<repo-root>/tests)")
     p.add_argument("--passes", default=None,
-                   help="comma list: collectives,recompile,blocking")
+                   help="comma list: collectives,recompile,blocking,"
+                        "concurrency")
+    p.add_argument("--lock-graph", action="store_true", dest="lock_graph",
+                   help="print the static lock acquisition-order graph "
+                        "(PT402's model) as DOT instead of linting")
     p.add_argument("--json", action="store_true", dest="as_json")
     p.add_argument("--list-passes", action="store_true")
     p.add_argument("--version", action="version",
@@ -130,6 +136,22 @@ def _lint(args, repo_root: str) -> int:
     return 0
 
 
+def _lock_graph(args, repo_root: str) -> int:
+    from photon_ml_tpu.analysis.concurrency import lock_graph_dot
+    from photon_ml_tpu.analysis.core import iter_python_files, parse_module
+
+    paths = args.paths or [os.path.join(repo_root, "photon_ml_tpu")]
+    modules = []
+    for path in iter_python_files(paths):
+        tree, lines = parse_module(path)
+        if tree is None:
+            continue
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        modules.append((path, rel, tree, lines))
+    print(lock_graph_dot(modules))
+    return 0
+
+
 def _fault_audit(args, repo_root: str) -> int:
     pkg = (args.paths[0] if args.paths
            else os.path.join(repo_root, "photon_ml_tpu"))
@@ -155,6 +177,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{code}  {desc}\n       fix: {hint}")
         return 0
     repo_root = args.repo_root or _default_repo_root()
+    if args.lock_graph:
+        return _lock_graph(args, repo_root)
     if args.fault_sites:
         return _fault_audit(args, repo_root)
     return _lint(args, repo_root)

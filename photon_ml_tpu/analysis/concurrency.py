@@ -1,0 +1,672 @@
+"""Concurrency lint (PT401-PT405) for the threaded serving/streaming stack.
+
+The production-QPS path spans ~15 locks and a dozen daemon threads (the
+batcher worker, the paged-table installer, the registry watcher, the
+prefetch ring, ThreadTransport, the asyncio front door). A data race or
+a lock-order inversion there silently corrupts a hot swap or hangs a
+replica — exactly the failure class the PR-1 fail-stop runtime exists
+to eliminate, and invisible to the collectives/recompile/blocking
+passes. Five shapes:
+
+* **PT401** — an instance attribute written from a ``threading.Thread``
+  target (or any method reachable from one via ``self`` calls) and
+  accessed elsewhere in the class, with the two sides not both under
+  the owning ``with self._lock``. ``__init__`` accesses are exempt
+  (they happen-before ``start()``), as are attributes that ARE
+  synchronizers (locks, events, queues — internally synchronized).
+* **PT402** — inconsistent nested lock-acquisition order: a per-class /
+  per-module static lock graph records every ``with A: ... with B:``
+  nesting (including one ``self``-call hop: ``with A: self.m()`` where
+  ``m`` acquires ``B``); any edge whose reverse is reachable is a
+  deadlock window. ``photon-check --lock-graph`` dumps the graph as
+  DOT.
+* **PT403** — a ``Thread(...)``/``Timer(...)`` started with no
+  reachable bounded ``join(timeout)``: bound to ``self.X``, the class
+  must join ``X`` with a timeout somewhere; bound locally, the
+  enclosing function must; anonymous ``Thread(...).start()`` always
+  flags. The leak class ``producer_join_timeouts`` already warns about
+  at runtime, caught statically.
+* **PT404** — a timeout-less blocking ``Queue.get()`` /
+  ``Condition.wait()`` / ``Event.wait()`` in a worker loop (inside a
+  ``while``, or directly in a thread-target function). A wedged
+  producer/consumer then hangs the worker forever instead of failing
+  stop — the hang hazard against PR 1's guarantee. ``await``-ed waits
+  (asyncio primitives) are exempt.
+* **PT405** — a callback invoked while holding a lock: an opaque
+  ``on_*`` / ``*_callback`` / ``cb`` callable called lexically inside a
+  ``with <lock>`` block. A callback that re-enters the class (or just
+  blocks) self-deadlocks — the shape ``PendingRequest._fire_callbacks``
+  deliberately avoids by draining the list under ``_cb_lock`` and
+  firing outside it.
+
+Scope: modules that use ``threading`` (content-detected), which is the
+serve/ + streaming/ + resilience + driver set today and follows the
+code as it grows. Like every pass here the analysis is lexical: lock
+identity resolves only for ``self`` attributes and module-level names,
+and the PT402 call hop follows ``self`` methods one level — guards and
+joins living across objects are what the justified baseline is for.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from photon_ml_tpu.analysis.core import (
+    PASS_CATALOG,
+    Finding,
+    ancestors,
+    call_name,
+    enclosing_function,
+    parent,
+    snippet_at,
+)
+
+__all__ = ["check_modules", "build_lock_graph", "lock_graph_dot"]
+
+# Constructors whose product is a lock (a `with` on it is an acquisition).
+_LOCK_CONSTRUCTORS = {"Lock", "RLock", "Condition"}
+# Constructors whose product is internally synchronized: attributes
+# holding these are not PT401 data (mutating them IS the safe pattern).
+_SYNC_CONSTRUCTORS = _LOCK_CONSTRUCTORS | {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "local",
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Thread",
+    "Timer", "deque",
+}
+_THREAD_CONSTRUCTORS = {"Thread", "Timer"}
+
+# Fallback lock naming for `with` targets whose constructor is not
+# visible in the module (e.g. a lock passed in): name says lock.
+_LOCKISH_RE = re.compile(
+    r"(^|_)(lock|rlock|mutex|cond|condition)s?$", re.IGNORECASE)
+
+_CALLBACK_NAME_RE = re.compile(
+    r"^(on_[a-z0-9_]+|cb|cbs|hook|hooks|callback|callbacks"
+    r"|.*(_cb|_cbs|_callback|_callbacks|_hook|_hooks))$")
+# registration/maintenance APIs are not invocations
+_CALLBACK_EXEMPT_PREFIXES = ("add_", "register_", "set_", "remove_",
+                             "clear_", "fire_", "_fire")
+
+
+def _queueish(name: str) -> bool:
+    low = name.lower()
+    return low == "q" or low.endswith("_q") or "queue" in low
+
+
+def _terminal(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _finding(code: str, rel: str, lines, lineno: int, message: str
+             ) -> Finding:
+    return Finding(code=code, path=rel, line=lineno, message=message,
+                   hint=PASS_CATALOG[code][1],
+                   snippet=snippet_at(lines, lineno))
+
+
+def _select(modules, scope: Optional[Sequence[str]]):
+    """Default scope is content-based: any module that touches
+    ``threading`` is part of the threaded stack and gets scanned."""
+    if scope is None:
+        return [m for m in modules
+                if any("threading" in ln for ln in m[3])]
+    if "*" in scope:
+        return list(modules)
+    return [m for m in modules if any(s in m[1] for s in scope)]
+
+
+# -- lock identity ----------------------------------------------------------
+# A lock id is (owner, name): owner is the class name for self attrs,
+# "" for module-level names. Everything else is unresolvable (lexical
+# pass: no cross-object aliasing).
+LockId = Tuple[str, str]
+
+
+def _fmt_lock(lock: LockId) -> str:
+    owner, name = lock
+    return f"{owner}.{name}" if owner else name
+
+
+class _ModuleLocks:
+    """Lock/synchronizer bindings visible in one module."""
+
+    def __init__(self, tree: ast.Module):
+        self.class_locks: Dict[str, Set[str]] = {}
+        self.class_sync_attrs: Dict[str, Set[str]] = {}
+        self.module_locks: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Call):
+                continue
+            ctor = call_name(node.value)
+            if ctor not in _SYNC_CONSTRUCTORS:
+                continue
+            for target in node.targets:
+                if _is_self_attr(target):
+                    cls = _enclosing_class(node)
+                    if cls is None:
+                        continue
+                    self.class_sync_attrs.setdefault(
+                        cls.name, set()).add(target.attr)
+                    if ctor in _LOCK_CONSTRUCTORS:
+                        self.class_locks.setdefault(
+                            cls.name, set()).add(target.attr)
+                elif (isinstance(target, ast.Name)
+                      and ctor in _LOCK_CONSTRUCTORS
+                      and _enclosing_class(node) is None
+                      and enclosing_function(node) is None):
+                    self.module_locks.add(target.id)
+
+    def lock_id_of(self, expr: ast.AST, cls_name: str) -> Optional[LockId]:
+        """Resolve a ``with`` target to a lock id, or None when it is
+        not a lock (or not resolvable)."""
+        if _is_self_attr(expr):
+            name = expr.attr
+            if (name in self.class_locks.get(cls_name, ())
+                    or _LOCKISH_RE.search(name)):
+                return (cls_name, name)
+            return None
+        if isinstance(expr, ast.Name):
+            if (expr.id in self.module_locks
+                    or _LOCKISH_RE.search(expr.id)):
+                return ("", expr.id)
+        return None
+
+
+def _enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    for anc in ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # keep climbing: methods live inside the class
+            continue
+    return None
+
+
+def _methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _self_calls(fn) -> Set[str]:
+    """Names of ``self.m(...)`` calls inside ``fn`` (nested defs
+    included: worker closures call back into the class)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and _is_self_attr(node.func)):
+            out.add(node.func.attr)
+    return out
+
+
+def _thread_target_methods(cls: ast.ClassDef) -> Set[str]:
+    """Method names passed as ``target=self.m`` to Thread/Timer inside
+    the class."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Call)
+                and call_name(node) in _THREAD_CONSTRUCTORS):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target" and _is_self_attr(kw.value):
+                out.add(kw.value.attr)
+    return out
+
+
+def _module_thread_targets(tree: ast.Module) -> Set[str]:
+    """Every name passed as ``target=`` to a Thread/Timer anywhere in
+    the module (plain functions and methods alike) — the PT404
+    worker-loop context for loop-less thread bodies."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and call_name(node) in _THREAD_CONSTRUCTORS):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                name = _terminal(kw.value)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _under_lock(node: ast.AST, mlocks: _ModuleLocks, cls_name: str
+                ) -> Set[LockId]:
+    """Lock ids held lexically at ``node`` (enclosing ``with`` blocks
+    within the same function)."""
+    held: Set[LockId] = set()
+    fn = enclosing_function(node)
+    for anc in ancestors(node):
+        if anc is fn:
+            break
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                lid = mlocks.lock_id_of(item.context_expr, cls_name)
+                if lid is not None:
+                    held.add(lid)
+    return held
+
+
+# -- PT401: unlocked cross-thread attribute ---------------------------------
+def _attr_accesses(fn, *, writes_only: bool) -> List[Tuple[str, int, bool]]:
+    """(attr, line, is_write) for ``self.X`` accesses in ``fn``.
+    Subscript stores (``self.X[k] = v``) count as writes — they mutate
+    the shared object."""
+    out: List[Tuple[str, int, bool]] = []
+    for node in ast.walk(fn):
+        if not (_is_self_attr(node) and isinstance(node, ast.Attribute)):
+            continue
+        is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+        if not is_write:
+            par = parent(node)
+            if (isinstance(par, ast.Subscript)
+                    and isinstance(par.ctx, (ast.Store, ast.Del))
+                    and par.value is node):
+                is_write = True
+        if writes_only and not is_write:
+            continue
+        out.append((node.attr, node.lineno, is_write))
+    return out
+
+
+def _check_pt401(rel, lines, cls: ast.ClassDef, mlocks: _ModuleLocks
+                 ) -> List[Finding]:
+    targets = _thread_target_methods(cls)
+    if not targets:
+        return []
+    methods = _methods(cls)
+    # reachable-from-thread-target set via self calls
+    reach: Set[str] = set()
+    frontier = [t for t in targets if t in methods]
+    while frontier:
+        m = frontier.pop()
+        if m in reach:
+            continue
+        reach.add(m)
+        frontier.extend(c for c in _self_calls(methods[m])
+                        if c in methods and c not in reach)
+
+    sync_attrs = mlocks.class_sync_attrs.get(cls.name, set())
+    # thread-side writes: attr -> (line, locks held)
+    thread_writes: Dict[str, Tuple[int, Set[LockId]]] = {}
+    write_nodes: Dict[str, ast.AST] = {}
+    for m in reach:
+        fn = methods[m]
+        for node in ast.walk(fn):
+            if not (_is_self_attr(node)
+                    and isinstance(node, ast.Attribute)):
+                continue
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            par = parent(node)
+            if (not is_write and isinstance(par, ast.Subscript)
+                    and isinstance(par.ctx, (ast.Store, ast.Del))
+                    and par.value is node):
+                is_write = True
+            if not is_write or node.attr in sync_attrs:
+                continue
+            if node.attr not in thread_writes:
+                thread_writes[node.attr] = (
+                    node.lineno, _under_lock(node, mlocks, cls.name))
+                write_nodes[node.attr] = node
+    if not thread_writes:
+        return []
+
+    findings: List[Finding] = []
+    for attr, (w_line, w_locks) in sorted(thread_writes.items()):
+        # accesses outside the thread-reachable set, __init__ exempt
+        other: List[Tuple[int, Set[LockId]]] = []
+        for name, fn in methods.items():
+            if name in reach or name == "__init__":
+                continue
+            for node in ast.walk(fn):
+                if (_is_self_attr(node)
+                        and isinstance(node, ast.Attribute)
+                        and node.attr == attr):
+                    other.append(
+                        (node.lineno, _under_lock(node, mlocks,
+                                                  cls.name)))
+        if not other:
+            continue
+        # both sides under a common lock -> disciplined
+        unlocked_other = [ln for ln, locks in other if not locks]
+        common = (set.intersection(w_locks, *[locks for _ln, locks
+                                              in other])
+                  if w_locks and all(locks for _ln, locks in other)
+                  else set())
+        if common:
+            continue
+        where = unlocked_other[0] if unlocked_other else other[0][0]
+        findings.append(_finding(
+            "PT401", rel, lines, w_line,
+            f"'{cls.name}.{attr}' is written on the thread target path "
+            f"here but accessed at line {where} without both sides "
+            "holding the same lock: cross-thread data race"))
+    return findings
+
+
+# -- PT402: lock-order graph + inversions -----------------------------------
+# edge key (src, dst) -> list of (rel, line, via) sites
+EdgeMap = Dict[Tuple[LockId, LockId], List[Tuple[str, int, str]]]
+
+
+def _method_locks(fn, mlocks: _ModuleLocks, cls_name: str
+                  ) -> Set[LockId]:
+    """Every lock ``fn`` acquires lexically anywhere in its body."""
+    out: Set[LockId] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lid = mlocks.lock_id_of(item.context_expr, cls_name)
+                if lid is not None:
+                    out.add(lid)
+    return out
+
+
+def _scan_lock_nesting(rel, tree, mlocks: _ModuleLocks, edges: EdgeMap,
+                       callbacks_out: List[Tuple[ast.Call, LockId]]
+                       ) -> None:
+    """One walk serving PT402 (nesting edges + one self-call hop) and
+    PT405 (callback calls under a lock)."""
+
+    def visit(node, held: List[LockId], cls_name: str,
+              methods: Dict[str, ast.FunctionDef]):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[LockId] = []
+            for item in node.items:
+                lid = mlocks.lock_id_of(item.context_expr, cls_name)
+                if lid is None:
+                    continue
+                for h in held + acquired:
+                    if h != lid:
+                        edges.setdefault((h, lid), []).append(
+                            (rel, node.lineno, "nested with"))
+                acquired.append(lid)
+            for child in node.body:
+                visit(child, held + acquired, cls_name, methods)
+            return
+        if isinstance(node, ast.ClassDef):
+            m = _methods(node)
+            for child in node.body:
+                visit(child, [], node.name, m)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if not isinstance(node, ast.Lambda) else []
+            for child in body:
+                visit(child, list(held), cls_name, methods)
+            return
+        if held and isinstance(node, ast.Call):
+            # PT405 candidate
+            callbacks_out.append((node, held[-1]))
+            # one-hop: with A held, self.m() acquiring B => A -> B
+            if _is_self_attr(node.func):
+                callee = methods.get(node.func.attr)
+                if callee is not None:
+                    for lid in _method_locks(callee, mlocks, cls_name):
+                        for h in held:
+                            if h != lid:
+                                edges.setdefault((h, lid), []).append(
+                                    (rel, node.lineno,
+                                     f"via self.{node.func.attr}()"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, cls_name, methods)
+
+    for stmt in tree.body:
+        visit(stmt, [], "", {})
+
+
+def _reachable(edges: EdgeMap, src: LockId, dst: LockId) -> bool:
+    adj: Dict[LockId, Set[LockId]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+    seen: Set[LockId] = set()
+    stack = [src]
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(adj.get(cur, ()))
+    return False
+
+
+def _check_pt402(rel, lines, edges: EdgeMap) -> List[Finding]:
+    findings: List[Finding] = []
+    for (a, b), sites in sorted(edges.items(),
+                                key=lambda kv: kv[1][0][1]):
+        # an edge is an inversion when the OPPOSITE order is reachable
+        # with this edge removed (a 2-cycle needs the b->a edge itself)
+        rest: EdgeMap = {k: v for k, v in edges.items() if k != (a, b)}
+        if not _reachable(rest, b, a):
+            continue
+        opposite = rest.get((b, a))
+        opp = (f" (opposite order at "
+               f"{opposite[0][0]}:{opposite[0][1]})" if opposite else
+               " (reverse path exists in the acquisition graph)")
+        site_rel, site_line, via = sites[0]
+        findings.append(_finding(
+            "PT402", site_rel, lines, site_line,
+            f"lock '{_fmt_lock(b)}' acquired while holding "
+            f"'{_fmt_lock(a)}' ({via}), but the opposite order also "
+            f"exists{opp}: lock-order inversion, a deadlock window"))
+    return findings
+
+
+# -- PT403: unjoined threads ------------------------------------------------
+def _bounded_join_calls(scope_node) -> List[str]:
+    """Receiver names of ``X.join(<bounded>)`` calls inside
+    ``scope_node`` (a join with at least one argument)."""
+    out: List[str] = []
+    for node in ast.walk(scope_node):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and (node.args or node.keywords)):
+            out.append(_terminal(node.func.value))
+    return out
+
+
+def _check_pt403(rel, lines, tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and call_name(node) in _THREAD_CONSTRUCTORS):
+            continue
+        # `threading.Timer` vs a local def named Thread: require the
+        # threading module (or bare name from `from threading import`)
+        dotted_ok = True
+        if isinstance(node.func, ast.Attribute):
+            dotted_ok = _terminal(node.func.value) == "threading"
+        if not dotted_ok:
+            continue
+        binding: Optional[str] = None
+        bound_to_self = False
+        assign = None
+        for anc in ancestors(node):
+            if isinstance(anc, ast.Assign):
+                assign = anc
+                break
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                break
+        if assign is not None and len(assign.targets) == 1:
+            target = assign.targets[0]
+            if _is_self_attr(target):
+                binding, bound_to_self = target.attr, True
+            elif isinstance(target, ast.Name):
+                binding = target.id
+        joined = False
+        if bound_to_self:
+            cls = _enclosing_class(node)
+            if cls is not None and binding in _bounded_join_calls(cls):
+                joined = True
+        elif binding is not None:
+            fn = enclosing_function(node)
+            scope_node = fn if fn is not None else tree
+            # local bindings flow through lists/comprehensions; accept
+            # any bounded join in the same function scope
+            if _bounded_join_calls(scope_node):
+                joined = True
+        if joined:
+            continue
+        what = (f"bound to 'self.{binding}'" if bound_to_self
+                else f"bound to '{binding}'" if binding
+                else "anonymous (started inline)")
+        findings.append(_finding(
+            "PT403", rel, lines, node.lineno,
+            f"thread {what} is started with no reachable bounded "
+            "join(timeout): on shutdown it leaks (or wedges an "
+            "unbounded join) instead of failing stop"))
+    return findings
+
+
+# -- PT404: timeout-less blocking waits in worker loops ---------------------
+def _unbounded_get(node: ast.Call) -> bool:
+    if any(kw.arg == "timeout" and not (
+            isinstance(kw.value, ast.Constant)
+            and kw.value.value is None) for kw in node.keywords):
+        return False
+    if len(node.args) >= 2:  # get(block, timeout)
+        return isinstance(node.args[1], ast.Constant) \
+            and node.args[1].value is None
+    if len(node.args) == 1:  # get(key) is dict.get; get(True) blocks
+        return (isinstance(node.args[0], ast.Constant)
+                and node.args[0].value is True)
+    return not any(kw.arg == "timeout" for kw in node.keywords)
+
+
+def _in_worker_loop(node: ast.AST, thread_targets: Set[str]) -> bool:
+    fn = enclosing_function(node)
+    for anc in ancestors(node):
+        if anc is fn:
+            break
+        if isinstance(anc, ast.While):
+            return True
+    return fn is not None and fn.name in thread_targets
+
+
+def _check_pt404(rel, lines, tree, thread_targets: Set[str]
+                 ) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        if isinstance(parent(node), ast.Await):
+            continue  # asyncio primitives; PB3xx territory
+        attr = node.func.attr
+        recv = _terminal(node.func.value)
+        if attr == "get":
+            if not _queueish(recv) or not _unbounded_get(node):
+                continue
+            kind = f"'{recv}.get()'"
+        elif attr == "wait":
+            if node.args or node.keywords:
+                continue
+            kind = f"'{recv}.wait()'"
+        else:
+            continue
+        if not _in_worker_loop(node, thread_targets):
+            continue
+        findings.append(_finding(
+            "PT404", rel, lines, node.lineno,
+            f"timeout-less blocking {kind} in a worker loop: a wedged "
+            "producer/consumer hangs this thread forever instead of "
+            "failing stop (PR-1 discipline: bound every wait)"))
+    return findings
+
+
+# -- PT405: callback under a lock -------------------------------------------
+def _callbackish(name: str) -> bool:
+    return (bool(_CALLBACK_NAME_RE.match(name))
+            and not name.startswith(_CALLBACK_EXEMPT_PREFIXES))
+
+
+def _check_pt405(rel, lines,
+                 calls_under_lock: List[Tuple[ast.Call, LockId]]
+                 ) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for node, lock in calls_under_lock:
+        name = _terminal(node.func)
+        if not name or not _callbackish(name):
+            continue
+        if node.lineno in seen:
+            continue
+        seen.add(node.lineno)
+        findings.append(_finding(
+            "PT405", rel, lines, node.lineno,
+            f"callback '{name}' invoked while holding "
+            f"'{_fmt_lock(lock)}': a callback that re-enters this "
+            "class (or merely blocks) self-deadlocks every caller of "
+            "the lock"))
+    return findings
+
+
+# -- entry points -----------------------------------------------------------
+def check_modules(modules, *, scope: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    for _path, rel, tree, lines in _select(modules, scope):
+        mlocks = _ModuleLocks(tree)
+        edges: EdgeMap = {}
+        under_lock: List[Tuple[ast.Call, LockId]] = []
+        _scan_lock_nesting(rel, tree, mlocks, edges, under_lock)
+        thread_targets = _module_thread_targets(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings += _check_pt401(rel, lines, node, mlocks)
+        findings += _check_pt402(rel, lines, edges)
+        findings += _check_pt403(rel, lines, tree)
+        findings += _check_pt404(rel, lines, tree, thread_targets)
+        findings += _check_pt405(rel, lines, under_lock)
+    return findings
+
+
+def build_lock_graph(modules, *, scope: Optional[Sequence[str]] = None
+                     ) -> Dict[Tuple[str, str], List[Tuple[str, int, str]]]:
+    """The inferred acquisition-order graph over ``modules``:
+    ``(src, dst) -> [(path, line, via), ...]`` with lock names already
+    rendered (``Class.attr`` / module-level name)."""
+    out: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+    for _path, rel, tree, _lines in _select(modules, scope):
+        mlocks = _ModuleLocks(tree)
+        edges: EdgeMap = {}
+        _scan_lock_nesting(rel, tree, mlocks, edges, [])
+        for (a, b), sites in edges.items():
+            out.setdefault((_fmt_lock(a), _fmt_lock(b)), []).extend(
+                sites)
+    return out
+
+
+def lock_graph_dot(modules, *, scope: Optional[Sequence[str]] = None
+                   ) -> str:
+    """DOT rendering of :func:`build_lock_graph` (what
+    ``photon-check --lock-graph`` prints; docs/analysis.md embeds it)."""
+    graph = build_lock_graph(modules, scope=scope)
+    nodes = sorted({n for edge in graph for n in edge})
+    lines = ["digraph lock_order {", "  rankdir=LR;",
+             '  node [shape=box, fontname="monospace"];']
+    for n in nodes:
+        lines.append(f'  "{n}";')
+    for (a, b), sites in sorted(graph.items()):
+        rel, line, _via = sites[0]
+        label = f"{rel}:{line}"
+        if len(sites) > 1:
+            label += f" (+{len(sites) - 1})"
+        lines.append(f'  "{a}" -> "{b}" [label="{label}"];')
+    lines.append("}")
+    return "\n".join(lines)

@@ -85,6 +85,38 @@ PASS_CATALOG: Dict[str, Tuple[str, str]] = {
         "invoke callbacks via loop.run_in_executor(None, cb, ...) unless "
         "the callback is documented non-blocking",
     ),
+    "PT401": (
+        "instance attribute written on a thread-target path and accessed "
+        "elsewhere in the class without a common owning lock",
+        "put both sides under the same `with self._lock`, or make the "
+        "attribute a synchronizer (Event/Queue) that owns its state",
+    ),
+    "PT402": (
+        "inconsistent nested lock-acquisition order (the opposite "
+        "nesting exists in the static lock graph: deadlock window)",
+        "pick one global order and restructure the losing site — or "
+        "drop to a single lock; `photon-check --lock-graph` dumps the "
+        "inferred acquisition graph as DOT",
+    ),
+    "PT403": (
+        "thread started with no reachable bounded join(timeout)",
+        "keep a handle to the thread and join it with a timeout at "
+        "shutdown, logging + counting expiry like "
+        "producer_join_timeouts does",
+    ),
+    "PT404": (
+        "timeout-less blocking Queue.get()/Condition.wait()/Event.wait() "
+        "in a worker loop (a wedged peer hangs the thread forever)",
+        "use get(timeout=...)/wait(timeout) in a loop that rechecks a "
+        "stop event (and producer liveness) each expiry — fail stop, "
+        "never hang",
+    ),
+    "PT405": (
+        "callback invoked while holding a lock (a callback that "
+        "re-enters the class self-deadlocks)",
+        "snapshot the callback list under the lock, release it, then "
+        "fire — the PendingRequest._fire_callbacks pattern",
+    ),
 }
 
 
@@ -273,17 +305,23 @@ def run_check(roots: Sequence[str], *,
               repo_root: Optional[str] = None,
               passes: Optional[Sequence[str]] = None,
               hot_paths: Optional[Sequence[str]] = None,
-              blocking_scope: Optional[Sequence[str]] = None) -> dict:
+              blocking_scope: Optional[Sequence[str]] = None,
+              concurrency_scope: Optional[Sequence[str]] = None) -> dict:
     """Run the lint passes over ``roots``.
 
     Returns a report dict: ``findings`` (unsuppressed), ``suppressed``
     (finding, via) pairs, ``stale_baseline`` entries that matched
     nothing, and ``files_checked``. ``passes`` selects a subset by
-    module name (collectives/recompile/blocking); ``hot_paths`` /
-    ``blocking_scope`` override the per-pass file scopes (None = the
-    repo defaults; pass ``["*"]`` to scan every file — what the fixture
-    tests do)."""
-    from photon_ml_tpu.analysis import blocking, collectives, recompile
+    module name (collectives/recompile/blocking/concurrency);
+    ``hot_paths`` / ``blocking_scope`` / ``concurrency_scope`` override
+    the per-pass file scopes (None = the repo defaults; pass ``["*"]``
+    to scan every file — what the fixture tests do)."""
+    from photon_ml_tpu.analysis import (
+        blocking,
+        collectives,
+        concurrency,
+        recompile,
+    )
 
     files = iter_python_files(roots)
     modules = []
@@ -294,7 +332,7 @@ def run_check(roots: Sequence[str], *,
         modules.append((path, _relpath(path, repo_root), tree, lines))
 
     selected = set(passes) if passes is not None else {
-        "collectives", "recompile", "blocking"}
+        "collectives", "recompile", "blocking", "concurrency"}
     raw: List[Finding] = []
     if "collectives" in selected:
         raw += collectives.check_modules(modules)
@@ -302,6 +340,8 @@ def run_check(roots: Sequence[str], *,
         raw += recompile.check_modules(modules, hot_paths=hot_paths)
     if "blocking" in selected:
         raw += blocking.check_modules(modules, scope=blocking_scope)
+    if "concurrency" in selected:
+        raw += concurrency.check_modules(modules, scope=concurrency_scope)
     raw.sort(key=lambda f: (f.path, f.line, f.code))
 
     pragmas = {rel: pragma_map(lines) for _p, rel, _t, lines in modules}

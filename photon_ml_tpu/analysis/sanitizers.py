@@ -1,4 +1,4 @@
-"""Runtime sanitizers: collective-trace alignment + flat-compile checks.
+"""Runtime sanitizers: collective traces, compiles, locks, threads.
 
 Static analysis catches the lexical shapes of SPMD divergence; these
 two sanitizers catch the *dynamic* ones, in tier-1, with zero
@@ -27,15 +27,55 @@ stay flat" assertion that serving/CD tests previously each hand-rolled.
 Wrap the steady-state block; any counter movement beyond ``max_new``
 raises :class:`CompileSanitizerError` with the counter label and the
 moment it moved (``check()`` gives mid-block anchors, e.g. per sweep).
+
+**LockOrderSanitizer** — deadlock detection without deadlocking. While
+active, ``threading.Lock``/``threading.RLock`` construction from
+photon code (stdlib- and site-packages-created locks — ``queue.Queue``
+internals, ``Condition`` inner locks, jax — stay raw) returns an
+instrumented wrapper that maintains each thread's held-set and a global
+acquisition-order graph. A blocking acquire that would close a cycle in
+that graph — thread A holds X wanting Y while the graph already records
+Y held wanting X — raises :class:`LockOrderViolation` carrying BOTH
+acquisition stacks (the current one and the recorded opposing edge's),
+at the moment the inversion is *attempted*, whether or not the schedule
+would have deadlocked this run. Edges are recorded at blocking-acquire
+*intent* only; nonblocking probes (``acquire(False)``, Condition's
+``_is_owned``) are check-free so they can never fabricate an ordering.
+
+**ThreadLeakSanitizer** — a context manager asserting no NEW live
+photon-named thread (``photon-*``, ``avro-chunk-producer``,
+``stream-transfer``, ``sim-process-*``) outlives the block, after a
+bounded grace poll. The runtime companion to the PT403 lint: a
+shutdown path that forgets a bounded join fails the test that drove
+it, with the leaked threads named.
+
+Both are wired into ``run_simulated_processes`` (opt-out, like
+``verify_collectives``); the serving/streaming suites use them
+directly.
 """
 
 from __future__ import annotations
 
-from typing import Callable, List, Mapping, Optional, Sequence, Tuple
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import (
+    Callable,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 __all__ = [
     "CollectiveTraceMismatch", "CollectiveTraceSanitizer",
     "CompileSanitizer", "CompileSanitizerError", "describe_payload",
+    "LockOrderSanitizer", "LockOrderViolation",
+    "ThreadLeakSanitizer", "ThreadLeakError", "PHOTON_THREAD_PREFIXES",
 ]
 
 # One trace event: (op, site, payload descriptor), e.g.
@@ -200,3 +240,329 @@ class CompileSanitizer:
         if exc_type is None:
             self.check("block exit")
         return False
+
+
+# -- lock-order sanitizer ---------------------------------------------------
+class LockOrderViolation(AssertionError):
+    """A blocking acquire attempted a lock order whose reverse is
+    already recorded: a deadlock window, caught without deadlocking."""
+
+
+_STDLIB_DIR = os.path.dirname(threading.__file__)
+
+
+def _foreign_frame(filename: str) -> bool:
+    """Creation frames whose locks stay raw: the stdlib (queue.Queue
+    mutexes, Condition inner locks) and installed packages (jax)."""
+    return (filename.startswith(_STDLIB_DIR)
+            or "site-packages" in filename
+            or "dist-packages" in filename
+            or filename.startswith("<"))
+
+
+class _InstrumentedLock:
+    """``threading.Lock`` stand-in that reports acquisition intent to
+    the owning :class:`LockOrderSanitizer`."""
+
+    _reentrant = False
+
+    def __init__(self, inner, san: "LockOrderSanitizer", name: str):
+        self._inner = inner
+        self._san = san
+        self._name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            # intent BEFORE the (possibly deadlocking) wait: the cycle
+            # is reported even on schedules where the wait would hang
+            self._san._on_intent(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._san._on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._san._on_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<sanitized {self._name}>"
+
+
+class _InstrumentedRLock(_InstrumentedLock):
+    """RLock stand-in: reacquisition by the owner records nothing (no
+    new ordering), and the ``Condition`` protocol hooks
+    (``_is_owned``/``_release_save``/``_acquire_restore``) are
+    implemented so a Condition built over an instrumented RLock keeps
+    working — with its wait/notify reacquisition instrumented too."""
+
+    _reentrant = True
+
+    def __init__(self, inner, san: "LockOrderSanitizer", name: str):
+        super().__init__(inner, san, name)
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._owner == me:
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                self._count += 1
+            return got
+        if blocking:
+            self._san._on_intent(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._owner, self._count = me, 1
+            self._san._on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            self._san._on_release(self)
+        self._inner.release()
+
+    # Condition protocol (threading.Condition defers to these when the
+    # underlying lock provides them)
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def _release_save(self):
+        count, self._count = self._count, 0
+        self._owner = None
+        self._san._on_release(self)
+        return (count, self._inner._release_save())
+
+    def _acquire_restore(self, saved) -> None:
+        count, inner_state = saved
+        self._san._on_intent(self)
+        self._inner._acquire_restore(inner_state)
+        self._owner, self._count = threading.get_ident(), count
+        self._san._on_acquired(self)
+
+
+class LockOrderSanitizer:
+    """Instrument photon-created locks and flag acquisition-order
+    cycles with both stacks::
+
+        with LockOrderSanitizer() as san:
+            run_threaded_code()        # locks CREATED here are watched
+        san.check()                    # deferred mode (the default)
+
+    ``immediate=True`` raises :class:`LockOrderViolation` inside the
+    acquiring thread at the moment of the inversion — right for direct
+    use; the simulated-process harness uses the deferred default so a
+    violation in a worker cannot corrupt the harness's own outcome
+    collection, and calls ``check()`` after the join.
+
+    Only locks *constructed* while the sanitizer is active are
+    instrumented, so a long-lived singleton lock from before the block
+    is invisible — create the objects under test inside the block.
+    Patching ``threading.Lock``/``threading.RLock`` is process-global:
+    one active sanitizer at a time (enforced)."""
+
+    _active: Optional["LockOrderSanitizer"] = None
+
+    def __init__(self, *, immediate: bool = False):
+        self.immediate = immediate
+        self.violations: List[str] = []
+        # (src_name, dst_name) -> formatted stack at first observation
+        self.graph: Dict[Tuple[str, str], str] = {}
+        self._meta = threading.Lock()  # raw: guards the graph itself
+        self._held = threading.local()
+        self._counts: Dict[str, int] = {}
+        self._orig_lock = None
+        self._orig_rlock = None
+
+    # -- factory patching --------------------------------------------------
+    def _name_for(self, site: str) -> str:
+        n = self._counts.get(site, 0)
+        self._counts[site] = n + 1
+        return site if n == 0 else f"{site}#{n + 1}"
+
+    def _make(self, cls, orig_factory):
+        san = self
+
+        def factory():
+            frame = sys._getframe(1)
+            filename = frame.f_code.co_filename
+            if _foreign_frame(filename):
+                return orig_factory()
+            site = f"{os.path.basename(filename)}:{frame.f_lineno}"
+            with san._meta:
+                name = san._name_for(site)
+            return cls(orig_factory(), san, name)
+
+        return factory
+
+    def __enter__(self) -> "LockOrderSanitizer":
+        if LockOrderSanitizer._active is not None:
+            raise RuntimeError("a LockOrderSanitizer is already active "
+                               "(the threading patch is process-global)")
+        LockOrderSanitizer._active = self
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        threading.Lock = self._make(_InstrumentedLock, self._orig_lock)
+        threading.RLock = self._make(_InstrumentedRLock,
+                                     self._orig_rlock)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        threading.Lock = self._orig_lock
+        threading.RLock = self._orig_rlock
+        LockOrderSanitizer._active = None
+        return False
+
+    # -- acquisition bookkeeping -------------------------------------------
+    def _held_stack(self) -> List[_InstrumentedLock]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _on_intent(self, lock: _InstrumentedLock) -> None:
+        held = self._held_stack()
+        if not held:
+            return
+        here = "".join(traceback.format_stack(sys._getframe(2)))
+        with self._meta:
+            for h in held:
+                if h is lock:
+                    continue
+                edge = (h._name, lock._name)
+                path = self._path(lock._name, h._name)
+                if path is not None:
+                    self._violate(edge, path, here)
+                self.graph.setdefault(edge, here)
+
+    def _on_acquired(self, lock: _InstrumentedLock) -> None:
+        self._held_stack().append(lock)
+
+    def _on_release(self, lock: _InstrumentedLock) -> None:
+        held = self._held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """Lock names from ``src`` to ``dst`` through recorded edges
+        (caller holds ``_meta``), or None when unreachable."""
+        prev: Dict[str, str] = {}
+        stack = [src]
+        seen = {src}
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                names = [dst]
+                while names[-1] != src:
+                    names.append(prev[names[-1]])
+                return list(reversed(names))
+            for (a, b) in self.graph:
+                if a == cur and b not in seen:
+                    seen.add(b)
+                    prev[b] = a
+                    stack.append(b)
+        return None
+
+    def _violate(self, edge: Tuple[str, str], path: List[str],
+                 here: str) -> None:
+        opposing = self.graph.get((path[0], path[1]), "<unrecorded>")
+        chain = " -> ".join(path)
+        msg = (
+            f"lock-order inversion: acquiring '{edge[1]}' while holding "
+            f"'{edge[0]}', but the opposite order {chain} is already "
+            "recorded — two threads interleaving these paths deadlock."
+            f"\n--- this acquisition ({edge[0]} -> {edge[1]}) ---\n"
+            f"{here}"
+            f"--- recorded opposing acquisition "
+            f"({path[0]} -> {path[1]}) ---\n{opposing}")
+        self.violations.append(msg)
+        if self.immediate:
+            raise LockOrderViolation(msg)
+
+    def check(self) -> None:
+        """Raise the first deferred violation (after threads joined)."""
+        if self.violations:
+            raise LockOrderViolation(self.violations[0])
+
+
+# -- thread-leak sanitizer --------------------------------------------------
+# The stack's thread-name vocabulary (see PT403 in docs/analysis.md):
+# every photon-owned thread carries one of these prefixes, so a leak
+# check can ignore pytest/jax housekeeping threads.
+PHOTON_THREAD_PREFIXES: Tuple[str, ...] = (
+    "photon-", "avro-chunk-producer", "stream-transfer", "sim-process-",
+)
+
+
+class ThreadLeakError(AssertionError):
+    """Photon-named threads started inside the block outlived it."""
+
+
+class ThreadLeakSanitizer:
+    """Assert no NEW live photon-named thread survives the block::
+
+        with ThreadLeakSanitizer():
+            server = build()...
+            server.close()
+
+    Exit polls up to ``grace_s`` (threads legitimately take a moment to
+    unwind after a bounded join returns) and then raises
+    :class:`ThreadLeakError` naming the survivors. An exception already
+    propagating out of the block takes precedence — the leak check only
+    runs on clean exits."""
+
+    def __init__(self, prefixes: Sequence[str] = PHOTON_THREAD_PREFIXES,
+                 grace_s: float = 2.0, poll_s: float = 0.02):
+        self.prefixes = tuple(prefixes)
+        self.grace_s = float(grace_s)
+        self.poll_s = float(poll_s)
+        self._before: set = set()
+
+    def _leaked(self) -> List[threading.Thread]:
+        # membership by Thread OBJECT, not ident: idents are recycled,
+        # and a recycled ident would hide a genuine leak
+        return [t for t in threading.enumerate()
+                if t.is_alive() and t not in self._before
+                and t.name.startswith(self.prefixes)]
+
+    def __enter__(self) -> "ThreadLeakSanitizer":
+        self._before = set(threading.enumerate())
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            return False
+        self.check()
+        return False
+
+    def check(self) -> None:
+        deadline = time.monotonic() + self.grace_s
+        leaked = self._leaked()
+        while leaked and time.monotonic() < deadline:
+            time.sleep(self.poll_s)
+            leaked = self._leaked()
+        if leaked:
+            names = ", ".join(sorted(t.name for t in leaked))
+            raise ThreadLeakError(
+                f"{len(leaked)} photon thread(s) leaked past the block "
+                f"(still alive {self.grace_s:.1f}s after exit): {names} "
+                "— a shutdown path is missing its bounded join "
+                "(PT403's runtime twin)")

@@ -182,19 +182,43 @@ def install_signal_handlers(server, signals=(signal.SIGTERM, signal.SIGINT)):
     running); must be called from the main thread (CPython restriction
     on ``signal.signal``). Returns the handler's state dict
     (``state["signal"]`` is the signum that fired, for logging)."""
-    state = {"signal": None}
+    state = {"signal": None, "thread": None}
 
     def handler(signum, frame):
         if state["signal"] is not None:
             return
         state["signal"] = signum
-        threading.Thread(target=server._httpd.shutdown, daemon=True,
-                         name="photon-serve-shutdown").start()
+        # the helper's bounded join lives in join_shutdown_helper (run
+        # by main's finally) — it cannot happen here: a signal handler
+        # joining its own helper would stall the very drain it triggers
+        t = threading.Thread(target=server._httpd.shutdown, daemon=True,
+                             name="photon-serve-shutdown")
+        state["thread"] = t
+        t.start()
 
     for sig in signals:
         signal.signal(sig, handler)
     state["handler"] = handler
     return state
+
+
+def join_shutdown_helper(state, timeout_s: float = 5.0,
+                         logger=None) -> None:
+    """Bounded join of the signal handler's shutdown helper thread (the
+    PT403 discipline: no thread leaks without a counter and a log line).
+    By the time main's finally runs, ``serve_forever`` has returned, so
+    the ``shutdown()`` handshake has completed and the join is instant
+    in the healthy case."""
+    t = state.get("thread")
+    if t is None:
+        return
+    t.join(timeout_s)
+    if t.is_alive():
+        state["join_timeouts"] = state.get("join_timeouts", 0) + 1
+        if logger is not None:
+            logger.log("shutdown_helper_join_timeout",
+                       timeout_s=timeout_s,
+                       join_timeouts=state["join_timeouts"])
 
 
 def _maybe_watcher(args, registry, session, logger):
@@ -388,6 +412,7 @@ def main(argv: Sequence[str] | None = None) -> int:
             logger.log("draining", signal=int(stop["signal"]),
                        queue_depth=server.service.batcher.queue_depth)
         server.close(drain_timeout_s=args.drain_timeout_s)
+        join_shutdown_helper(stop, logger=logger)
         logger.log("driver_done", drained=True,
                    **server.service.metrics.snapshot())
         logger.close()

@@ -280,6 +280,9 @@ class AvroChunkSource:
                  process_part: Optional[Tuple[int, int]] = None):
         from photon_ml_tpu.io.data_reader import InputColumnsNames
 
+        # first, so close()/__del__ stay safe on a half-built instance
+        self._resolver_lock = threading.Lock()
+        self._resolver_cached = None  # built once, reused across passes
         self._paths = paths
         self._imap = index_map
         self.chunk_rows = int(chunk_rows)
@@ -341,7 +344,6 @@ class AvroChunkSource:
             raise ValueError(f"no records under {paths!r}")
         self.dim = index_map.size
         self._use_native = self._native_usable()
-        self._resolver_cached = None  # built once, reused across passes
         self._prog_cache: Dict[str, bytes] = {}
         # producer-side instrumentation (tests assert boundedness)
         self.chunks_produced = 0
@@ -401,18 +403,22 @@ class AvroChunkSource:
         """The native feature resolver, built ONCE and reused across every
         decode pass — for a plain in-memory IndexMap the build serializes
         the whole map into a temp mmap store (O(#features)), and a margin
-        fit makes several full passes per optimizer iteration."""
-        if getattr(self, "_resolver_cached", None) is None:
-            from photon_ml_tpu.io.native_reader import _Resolver
+        fit makes several full passes per optimizer iteration. Built
+        lazily on the producer THREAD but torn down by ``close()`` on
+        the caller's, so the cache slot is lock-owned."""
+        with self._resolver_lock:
+            if self._resolver_cached is None:
+                from photon_ml_tpu.io.native_reader import _Resolver
 
-            self._resolver_cached = _Resolver(self._imap)
-        return self._resolver_cached
+                self._resolver_cached = _Resolver(self._imap)
+            return self._resolver_cached
 
     def close(self) -> None:
         """Release the native resolver's temp store (idempotent)."""
-        r = getattr(self, "_resolver_cached", None)
-        if r is not None:
+        with self._resolver_lock:
+            r = self._resolver_cached
             self._resolver_cached = None
+        if r is not None:
             r.close()
 
     def __del__(self):  # best-effort; close() is the real API
@@ -595,6 +601,10 @@ class AvroChunkSource:
     # end-of-pass producer join timeout (seconds); a class attribute so
     # tests can shrink it without monkeypatching the iterator internals
     _join_timeout = 30.0
+    # consumer-side queue poll (seconds): each expiry rechecks producer
+    # liveness, so a decoder that dies without relaying its sentinel
+    # fails the pass instead of hanging the consumer forever
+    _consumer_poll_s = 0.5
 
     @staticmethod
     def _put_or_stop(q: queue.Queue, stop: threading.Event, item) -> bool:
@@ -665,7 +675,21 @@ class AvroChunkSource:
                 # consumer-side injection point: raise-at-chunk-N faults
                 # fire in the consuming (process-context-bearing) thread
                 fault_injection.check("stream.chunk")
-                item = q.get()
+                try:
+                    item = q.get(timeout=self._consumer_poll_s)
+                except queue.Empty:
+                    if t.is_alive():
+                        continue
+                    try:
+                        # the producer may have parked its last item /
+                        # sentinel between our timeout and its exit
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            "avro-chunk-producer thread died without "
+                            "delivering its end-of-pass sentinel "
+                            "(decoder crash hard enough to skip the "
+                            "BaseException relay?)") from None
                 if item is None:
                     break
                 if isinstance(item, BaseException):

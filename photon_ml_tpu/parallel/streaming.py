@@ -102,6 +102,12 @@ class StreamStats:
                 "chunks": self.chunks, "passes": self.passes}
 
 
+# consumer-side ring poll (seconds): each expiry rechecks transfer-thread
+# liveness so a producer that dies without relaying its sentinel fails
+# the pass instead of hanging the consumer forever
+_RING_POLL_S = 0.5
+
+
 def _ring_put(q: queue.Queue, stop: threading.Event, item) -> bool:
     """Stop-aware bounded put (chunks, sentinel and errors alike) so an
     abandoned consumer can never wedge the transfer thread — same contract
@@ -194,7 +200,21 @@ def iter_device_chunks(chunks, to_device: Callable, depth: Optional[int] = None,
     try:
         while True:
             t0 = time.perf_counter()
-            item = q.get()
+            try:
+                item = q.get(timeout=_RING_POLL_S)
+            except queue.Empty:
+                if t.is_alive():
+                    if stats is not None:
+                        stats.stall_s += time.perf_counter() - t0
+                    continue
+                try:
+                    # the thread may have parked its last item/sentinel
+                    # between our timeout and its exit
+                    item = q.get_nowait()
+                except queue.Empty:
+                    raise RuntimeError(
+                        "stream-transfer thread died without delivering "
+                        "its end-of-pass sentinel") from None
             if stats is not None:
                 stats.stall_s += time.perf_counter() - t0
             if item is None:

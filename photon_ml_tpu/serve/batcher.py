@@ -28,12 +28,15 @@ watchdog, for the same reason.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
 from photon_ml_tpu.parallel.resilience import WatchdogTimeout
+
+_log = logging.getLogger(__name__)
 
 __all__ = ["QueueFullError", "BatchWatchdogTimeout", "MicroBatcher",
            "PendingRequest"]
@@ -168,6 +171,10 @@ class MicroBatcher:
             maxsize=int(max_queue))
         self._metrics = metrics
         self._closed = False
+        self._stop = threading.Event()
+        # worker joins that outlived the drain grace (a wedged scoring
+        # execution); counted + logged, mirroring producer_join_timeouts
+        self.join_timeouts = 0
         self._carry: Optional[PendingRequest] = None  # worker-only state
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="photon-serve-batcher")
@@ -220,14 +227,31 @@ class MicroBatcher:
         return self._queue.qsize()
 
     def close(self, drain_timeout_s: float = 5.0) -> None:
-        """Stop admitting, let the worker drain, join it."""
+        """Stop admitting, let the worker drain queued requests, join it
+        with a bounded timeout; a worker that outlives the grace (wedged
+        execution) is counted and logged, never waited on forever."""
         if self._closed:
             return
         self._closed = True
-        self._queue.put(None)  # wake the worker for shutdown
+        try:
+            self._queue.put_nowait(None)  # wake the worker for shutdown
+        except queue.Full:
+            pass  # the stop event below wakes the idle poll instead
+        self._stop.set()
         self._worker.join(drain_timeout_s)
+        if self._worker.is_alive():
+            self.join_timeouts += 1
+            _log.warning(
+                "MicroBatcher: worker thread %r still alive %.1fs after "
+                "close() (wedged scoring execution?); leaking it as a "
+                "daemon (join timeouts so far: %d)",
+                self._worker.name, drain_timeout_s, self.join_timeouts)
 
     # -- worker ------------------------------------------------------------
+    # idle-poll interval (seconds) for the worker's first-request wait; a
+    # class attribute so tests can shrink it without monkeypatching
+    _idle_poll_s = 0.2
+
     def _expired(self, req: PendingRequest) -> bool:
         """Shed a queued request whose deadline passed (worker-side;
         returns True when the request was shed and must be skipped)."""
@@ -253,7 +277,15 @@ class MicroBatcher:
             if self._carry is not None:
                 first, self._carry = self._carry, None
             else:
-                first = self._queue.get()
+                try:
+                    # bounded idle poll: each expiry rechecks the stop
+                    # event, so a closed batcher can never leave the
+                    # worker parked in a blocking get forever
+                    first = self._queue.get(timeout=self._idle_poll_s)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return None
+                    continue
                 if first is None:
                     return None
             if self._expired(first):

@@ -178,6 +178,10 @@ class ScoringService:
 
     def close(self, drain_timeout_s: float = 5.0) -> None:
         self.batcher.close(drain_timeout_s)
+        # duck-typed test sessions may not carry the installer thread
+        close = getattr(self.session, "close", None)
+        if close is not None:
+            close()
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -65,6 +65,7 @@ scoring batches for as long as the process lives:
 
 from __future__ import annotations
 
+import logging
 import os
 import queue as _queue
 import threading
@@ -94,6 +95,8 @@ from photon_ml_tpu.serve.metrics import ServingMetrics
 from photon_ml_tpu.serve.paged_table import PagedCoefficientTable
 from photon_ml_tpu.types import SparseFeatures, margins as _margins
 from photon_ml_tpu.utils import resolve_dtype, transfer_budget
+
+_log = logging.getLogger(__name__)
 
 __all__ = ["ScoringSession", "bucket_ladder", "bucketize"]
 
@@ -226,6 +229,10 @@ class ScoringSession:
         # the faulting batch, residency arrives asynchronously ----------
         self._install_q: "_queue.Queue" = _queue.Queue(maxsize=256)
         self._install_drops = 0
+        self._install_stop = threading.Event()
+        # installer joins that outlived close()'s grace (a wedged device
+        # install); counted + logged, mirroring producer_join_timeouts
+        self.join_timeouts = 0
         self._installer = threading.Thread(
             target=self._install_worker, daemon=True,
             name="photon-serve-page-install")
@@ -455,9 +462,25 @@ class ScoringSession:
         return version
 
     # -- background page installer -----------------------------------------
+    # idle-poll interval (seconds) for the installer's queue wait; a
+    # class attribute so tests can shrink it without monkeypatching
+    _install_poll_s = 0.2
+
     def _install_worker(self) -> None:
         while True:
-            table, entries = self._install_q.get()
+            try:
+                # bounded idle poll: each expiry rechecks the stop
+                # event, so a closed session never leaves the installer
+                # parked in a blocking get forever
+                item = self._install_q.get(timeout=self._install_poll_s)
+            except _queue.Empty:
+                if self._install_stop.is_set():
+                    return
+                continue
+            if item is None:  # shutdown sentinel from close()
+                self._install_q.task_done()
+                return
+            table, entries = item
             try:
                 table.install(entries)
             except Exception:  # a bad install must not kill the worker
@@ -488,6 +511,29 @@ class ScoringSession:
                 return True
             time.sleep(0.002)
         return False
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop the background page installer with a bounded join
+        (idempotent). Pending installs are abandoned — residency is an
+        optimization, and the session keeps scoring correctly through
+        the host fault path regardless. An installer that outlives the
+        grace (wedged device install) is counted and logged, never
+        waited on forever."""
+        if self._install_stop.is_set():
+            return
+        self._install_stop.set()
+        try:
+            self._install_q.put_nowait(None)  # wake the idle poll now
+        except _queue.Full:
+            pass  # the stop event wakes the bounded poll instead
+        self._installer.join(timeout_s)
+        if self._installer.is_alive():
+            self.join_timeouts += 1
+            _log.warning(
+                "ScoringSession: installer thread %r still alive %.1fs "
+                "after close() (wedged device install?); leaking it as "
+                "a daemon (join timeouts so far: %d)",
+                self._installer.name, timeout_s, self.join_timeouts)
 
     # -- compile cache -----------------------------------------------------
     @property

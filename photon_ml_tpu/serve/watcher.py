@@ -20,11 +20,14 @@ serving; the watcher never tears down live state on a bad poll.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Callable, Optional
 
 __all__ = ["RegistryWatcher"]
+
+_log = logging.getLogger(__name__)
 
 
 class RegistryWatcher:
@@ -52,6 +55,9 @@ class RegistryWatcher:
         self.on_error = on_error
         self.errors = 0
         self.checks = 0
+        # stop() joins that expired (a poll wedged inside a swap);
+        # counted + logged, mirroring producer_join_timeouts
+        self.join_timeouts = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -92,7 +98,18 @@ class RegistryWatcher:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Signal the poll loop and join it with a bounded timeout; a
+        watcher wedged inside a swap (stuck registry IO, hung compile)
+        is counted and logged, never waited on forever."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(5.0)
+        if self._thread is None:
+            return
+        self._thread.join(timeout_s)
+        if self._thread.is_alive():
+            self.join_timeouts += 1
+            _log.warning(
+                "RegistryWatcher: poll thread %r still alive %.1fs "
+                "after stop() (wedged swap?); leaking it as a daemon "
+                "(join timeouts so far: %d)",
+                self._thread.name, timeout_s, self.join_timeouts)

@@ -263,7 +263,9 @@ class ThreadTransport:
 
 def run_simulated_processes(n: int, fn: Callable, *,
                             join_timeout: float = 120.0,
-                            verify_collectives: bool = True) -> list:
+                            verify_collectives: bool = True,
+                            verify_lock_order: bool = True,
+                            verify_thread_leaks: bool = True) -> list:
     """Run ``fn(process_index)`` on ``n`` simulated processes (threads,
     each under its own resilience transport + fault-injection process
     context) and return the per-process OUTCOMES: the return value,
@@ -278,7 +280,26 @@ def run_simulated_processes(n: int, fn: Callable, *,
     DIFFERENT collective. Divergence raises
     :class:`~photon_ml_tpu.analysis.sanitizers.CollectiveTraceMismatch`
     naming the step, sites, and ranks. Skipped when a thread is still
-    alive at ``join_timeout`` (its trace is still moving)."""
+    alive at ``join_timeout`` (its trace is still moving).
+
+    ``verify_lock_order`` (default on) arms the lock-order sanitizer
+    over the run: locks CREATED by ``fn`` (or anything it builds) are
+    instrumented, and an acquisition-order cycle across the simulated
+    processes raises
+    :class:`~photon_ml_tpu.analysis.sanitizers.LockOrderViolation` with
+    both stacks — after the outcomes are collected (deferred mode), so
+    a violation never corrupts the outcome vector itself.
+
+    ``verify_thread_leaks`` (default on) asserts no new live
+    photon-named thread outlives the run (after a bounded grace):
+    :class:`~photon_ml_tpu.analysis.sanitizers.ThreadLeakError` names
+    the survivors. Skipped when a sim thread itself is still alive at
+    ``join_timeout`` — the timeout is the finding there, and fault
+    tests that interrogate it opt out explicitly."""
+    from photon_ml_tpu.analysis.sanitizers import (
+        LockOrderSanitizer,
+        ThreadLeakSanitizer,
+    )
     from photon_ml_tpu.parallel import fault_injection, resilience
 
     group = _SimGroup(n)
@@ -295,14 +316,27 @@ def run_simulated_processes(n: int, fn: Callable, *,
         except BaseException as e:
             outcomes[rank] = e
 
-    threads = [threading.Thread(target=run, args=(i,), daemon=True,
-                                name=f"sim-process-{i}") for i in range(n)]
-    for t in threads:
-        t.start()
-    deadline = time.monotonic() + join_timeout
-    for t in threads:
-        t.join(max(0.0, deadline - time.monotonic()))
-    if verify_collectives and not any(t.is_alive() for t in threads):
+    leak_san = ThreadLeakSanitizer() if verify_thread_leaks else None
+    if leak_san is not None:
+        leak_san.__enter__()
+    lock_san = (LockOrderSanitizer(immediate=False)
+                if verify_lock_order else None)
+    if lock_san is not None:
+        lock_san.__enter__()
+    try:
+        threads = [threading.Thread(target=run, args=(i,), daemon=True,
+                                    name=f"sim-process-{i}")
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + join_timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+    finally:
+        if lock_san is not None:
+            lock_san.__exit__(None, None, None)
+    any_alive = any(t.is_alive() for t in threads)
+    if verify_collectives and not any_alive:
         from photon_ml_tpu.analysis.sanitizers import (
             CollectiveTraceSanitizer,
         )
@@ -317,4 +351,8 @@ def run_simulated_processes(n: int, fn: Callable, *,
         CollectiveTraceSanitizer.verify(
             group.traces, context=f"{n} simulated processes",
             strict_sites=clean)
+    if lock_san is not None:
+        lock_san.check()
+    if leak_san is not None and not any_alive:
+        leak_san.check()
     return outcomes

@@ -5,12 +5,28 @@
 # (ci_tier1.sh) and bench-smoke (ci_bench_smoke.sh, exits 7/8) gates:
 #   9   lint findings not covered by the justified baseline
 #  10   a registered fault-injection site has no tier-1 test arming it
+#  11   a concurrency finding (PT4xx): lock discipline / thread leak /
+#       hang hazard in the threaded serving+streaming stack
 cd "$(dirname "$0")/.."
 set -o pipefail
 
 echo "== photon-check lint =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
     --baseline photon-check-baseline.json || exit 9
+
+# The concurrency passes again, alone, under their own exit code: a
+# threading regression is a different on-call page than a collective or
+# recompile one. Only findings (exit 1) fail this leg — a pass-scoped
+# run necessarily reports the OTHER passes' baseline entries as stale
+# (exit 3), and staleness is already owned by the full run above.
+echo "== photon-check concurrency (PT401-PT405) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
+    --passes concurrency --baseline photon-check-baseline.json
+rc=$?
+[ "$rc" -eq 1 ] && exit 11
+
+echo "== photon-check lock graph (PT402's model, for the CI artifact) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli --lock-graph
 
 echo "== photon-check fault-site audit =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \

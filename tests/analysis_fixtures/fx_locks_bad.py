@@ -1,0 +1,91 @@
+"""Known-bad lock discipline: every PT401/PT402/PT405 shape, one each.
+
+Never imported — parsed by the concurrency pass in
+tests/test_photon_check_concurrency.py, which asserts the exact finding
+codes and ANCHOR line numbers below.
+"""
+
+import threading
+
+
+class RacyCounter:
+    """The PT401 shape: ``_total`` is written on the thread-target path
+    and read from ``snapshot()`` with neither side under ``_lock``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def stop(self):
+        self._worker.join(5.0)
+
+    def _run(self):
+        for _ in range(100):
+            self._total = self._total + 1  # ANCHOR:PT401
+
+    def snapshot(self):
+        return self._total
+
+
+class SwapInverted:
+    """The PT402 shape, direct nesting: swap() takes swap->compile,
+    warm_compile() takes compile->swap."""
+
+    def __init__(self):
+        self._swap_lock = threading.Lock()
+        self._compile_lock = threading.Lock()
+
+    def swap(self):
+        with self._swap_lock:
+            with self._compile_lock:  # ANCHOR:PT402a
+                pass
+
+    def warm_compile(self):
+        with self._compile_lock:
+            with self._swap_lock:  # ANCHOR:PT402b
+                pass
+
+
+class HopInverted:
+    """The PT402 shape through the one-hop call edge: forward() holds
+    ``_a_lock`` while calling a method that takes ``_b_lock``;
+    backward() nests the opposite order directly."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def touch_b(self):
+        with self._b_lock:
+            pass
+
+    def forward(self):
+        with self._a_lock:
+            self.touch_b()  # ANCHOR:PT402c
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:  # ANCHOR:PT402d
+                pass
+
+
+class Notifier:
+    """The PT405 shape: listeners fired while ``_cb_lock`` is held — a
+    callback that re-enters add_callback() self-deadlocks."""
+
+    def __init__(self):
+        self._cb_lock = threading.Lock()
+        self._callbacks = []
+
+    def add_callback(self, cb):
+        with self._cb_lock:
+            self._callbacks.append(cb)
+
+    def fire(self, value):
+        with self._cb_lock:
+            for callback in self._callbacks:
+                callback(value)  # ANCHOR:PT405

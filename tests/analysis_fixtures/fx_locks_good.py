@@ -1,0 +1,68 @@
+"""The disciplined twins of fx_locks_bad.py — same shapes, zero
+findings: both sides of the shared write hold the owning lock, nested
+acquisition keeps one global order, and callbacks fire after a
+snapshot-under-lock."""
+
+import threading
+
+
+class LockedCounter:
+    """PT401-clean: writer and reader both hold ``_lock``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def stop(self):
+        self._worker.join(5.0)
+
+    def _run(self):
+        for _ in range(100):
+            with self._lock:
+                self._total += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._total
+
+
+class SwapOrdered:
+    """PT402-clean: every path takes swap -> compile, never the
+    reverse."""
+
+    def __init__(self):
+        self._swap_lock = threading.Lock()
+        self._compile_lock = threading.Lock()
+
+    def swap(self):
+        with self._swap_lock:
+            with self._compile_lock:
+                pass
+
+    def warm_compile(self):
+        with self._swap_lock:
+            with self._compile_lock:
+                pass
+
+
+class SafeNotifier:
+    """PT405-clean: drain the list under the lock, fire outside it (the
+    PendingRequest._fire_callbacks pattern)."""
+
+    def __init__(self):
+        self._cb_lock = threading.Lock()
+        self._callbacks = []
+
+    def add_callback(self, cb):
+        with self._cb_lock:
+            self._callbacks.append(cb)
+
+    def fire(self, value):
+        with self._cb_lock:
+            callbacks = list(self._callbacks)
+        for callback in callbacks:
+            callback(value)

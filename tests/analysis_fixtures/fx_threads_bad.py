@@ -1,0 +1,60 @@
+"""Known-bad thread lifecycle + blocking waits: the PT403/PT404 shapes.
+
+Never imported — parsed by the concurrency pass in
+tests/test_photon_check_concurrency.py, which asserts the exact finding
+codes and ANCHOR line numbers below.
+"""
+
+import queue
+import threading
+
+
+def spawn_orphan():
+    """PT403: anonymous fire-and-forget thread, nothing can join it."""
+    threading.Thread(target=print, daemon=True).start()  # ANCHOR:PT403a
+
+
+class LeakyWatcher:
+    """PT403: ``_thread`` is only ever joined WITHOUT a timeout — a
+    wedged poll body turns stop() into a hang."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)  # ANCHOR:PT403b
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.1):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()  # unbounded: does not count as a join
+
+
+class BlockingWorker:
+    """PT404, all three wait primitives, each in a worker loop."""
+
+    def __init__(self):
+        self._queue = queue.Queue()
+        self._cond = threading.Condition()
+        self._event = threading.Event()
+
+    def drain(self):
+        while True:
+            item = self._queue.get()  # ANCHOR:PT404a
+            if item is None:
+                return
+
+    def sleep_on_cond(self):
+        while True:
+            with self._cond:
+                self._cond.wait()  # ANCHOR:PT404b
+                return
+
+    def gate(self):
+        while True:
+            self._event.wait()  # ANCHOR:PT404c
+            return

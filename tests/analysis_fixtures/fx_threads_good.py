@@ -1,0 +1,62 @@
+"""The disciplined twins of fx_threads_bad.py — zero findings: every
+thread has a reachable bounded join, every wait is bounded and rechecks
+its stop condition, dict-style ``get(key)`` and ``await``-ed waits are
+recognized as non-blocking."""
+
+import asyncio
+import queue
+import threading
+
+
+def spawn_and_join():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+    t.join(5.0)
+
+
+class StoppableWorker:
+    """The bounded-poll consumer loop the serving/streaming stack uses:
+    ``get(timeout=...)`` + stop-event recheck, close() joins with a
+    timeout."""
+
+    _poll_s = 0.2
+
+    def __init__(self):
+        self._queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            try:
+                item = self._queue.get(timeout=self._poll_s)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if item is None:
+                return
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(5.0)
+
+
+def head(queue_map):
+    """dict.get with a positional key is a lookup, not a wait."""
+    while queue_map:
+        return queue_map.get("k")
+
+
+async def served(stop):
+    """await-ed waits are asyncio primitives, not thread hangs."""
+    while True:
+        await stop.wait()
+        return
+
+
+def make_stop():
+    return asyncio.Event()

@@ -1,0 +1,276 @@
+"""LockOrderSanitizer + ThreadLeakSanitizer: seeded lock-order
+inversions and a seeded two-lock deadlock schedule detected with
+file:line lock names and both stacks, the Condition protocol over
+instrumented locks, foreign-lock exemption, and the
+run_simulated_processes wiring (deferred check + opt-out flags).
+
+Locks under test are created in THIS file on purpose: the sanitizer
+instruments locks by creation frame and deliberately leaves stdlib /
+site-packages / ``<stdin>`` frames raw.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from photon_ml_tpu.analysis.sanitizers import (
+    LockOrderSanitizer,
+    LockOrderViolation,
+    ThreadLeakError,
+    ThreadLeakSanitizer,
+)
+from photon_ml_tpu.testing import run_simulated_processes
+
+
+# -- lock-order: seeded inversion, deferred mode ----------------------------
+def test_seeded_inversion_dual_stack_report():
+    with LockOrderSanitizer() as san:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        def backward():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=forward)
+        t1.start()
+        t1.join(10.0)
+        t2 = threading.Thread(target=backward)
+        t2.start()
+        t2.join(10.0)
+
+    assert len(san.violations) == 1
+    with pytest.raises(LockOrderViolation) as ei:
+        san.check()
+    msg = str(ei.value)
+    assert "lock-order inversion" in msg
+    # lock names are creation sites in this file
+    assert "test_concurrency_sanitizers.py:" in msg
+    # both sides of the cycle carry a formatted stack
+    assert "--- this acquisition" in msg
+    assert "--- recorded opposing acquisition" in msg
+    assert msg.count('File "') >= 2
+    # the acquisition graph recorded both orders
+    edges = set(san.graph)
+    assert any(src != dst for src, dst in edges)
+    assert len(edges) >= 2
+
+
+def test_seeded_two_lock_deadlock_schedule_averted_immediate():
+    """The classic AB/BA deadlock, scheduled for real: thread 1 holds A
+    and will want B; thread 2 holds B and asks for A while A is held.
+    Without the sanitizer this blocks; immediate mode raises inside the
+    acquiring thread at the moment of intent, BEFORE the wait."""
+    with LockOrderSanitizer(immediate=True):
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:  # teach the sanitizer the A -> B order
+                pass
+
+        t1_has_a = threading.Event()
+        t2_done = threading.Event()
+        caught = []
+
+        def t1():
+            with a:
+                t1_has_a.set()
+                # next step in the deadlock schedule would be `with b:`
+                t2_done.wait(10.0)
+
+        def t2():
+            assert t1_has_a.wait(10.0)
+            with b:
+                try:
+                    with a:  # A is HELD by t1: the deadlock arm
+                        pass
+                except LockOrderViolation as e:
+                    caught.append(e)
+            t2_done.set()
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start()
+        th2.start()
+        th1.join(15.0)
+        th2.join(15.0)
+        assert not th1.is_alive() and not th2.is_alive()
+
+    assert len(caught) == 1
+    msg = str(caught[0])
+    assert "deadlock" in msg
+    assert "--- this acquisition" in msg
+
+
+def test_transitive_cycle_detected():
+    with LockOrderSanitizer() as san:
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:  # closes the 3-cycle a -> b -> c -> a
+                pass
+    with pytest.raises(LockOrderViolation, match="lock-order inversion"):
+        san.check()
+
+
+def test_consistent_order_stays_clean():
+    with LockOrderSanitizer() as san:
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    san.check()
+    assert san.violations == []
+
+
+def test_rlock_reentrancy_records_no_self_edge():
+    with LockOrderSanitizer() as san:
+        r = threading.RLock()
+        with r:
+            with r:  # reentrant: no new ordering
+                pass
+    san.check()
+    assert all(src != dst for src, dst in san.graph)
+
+
+def test_condition_over_instrumented_rlock_still_works():
+    """threading.Condition defers to _is_owned/_release_save/
+    _acquire_restore on the underlying lock — the instrumented RLock
+    implements the protocol, so wait/notify keeps working (and the
+    reacquisition after wait is itself watched)."""
+    with LockOrderSanitizer() as san:
+        cond = threading.Condition(threading.RLock())
+        ready = threading.Event()
+        results = []
+
+        def waiter():
+            with cond:
+                ready.set()
+                results.append(cond.wait(5.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        assert ready.wait(5.0)
+        with cond:
+            cond.notify_all()
+        t.join(10.0)
+        assert not t.is_alive()
+    san.check()
+    assert results == [True]
+
+
+def test_foreign_locks_stay_raw_and_exclusivity_enforced():
+    with LockOrderSanitizer():
+        mine = threading.Lock()
+        assert type(mine).__name__ == "_InstrumentedLock"
+        # queue.Queue's mutex is created from a stdlib frame: raw
+        q = queue.Queue()
+        assert "Instrumented" not in type(q.mutex).__name__
+        # the threading patch is process-global: one sanitizer at a time
+        with pytest.raises(RuntimeError, match="already active"):
+            LockOrderSanitizer().__enter__()
+    # after exit the factory is restored
+    assert type(threading.Lock()).__name__ != "_InstrumentedLock"
+
+
+# -- thread-leak sanitizer --------------------------------------------------
+def test_thread_leak_detected_and_named():
+    with pytest.raises(ThreadLeakError) as ei:
+        with ThreadLeakSanitizer(grace_s=0.3):
+            threading.Thread(target=time.sleep, args=(5.0,), daemon=True,
+                             name="photon-leaky").start()
+    msg = str(ei.value)
+    assert "photon-leaky" in msg
+    assert "PT403" in msg  # the static pass it mirrors
+
+
+def test_thread_leak_clean_when_joined_and_ignores_foreign_names():
+    with ThreadLeakSanitizer(grace_s=2.0):
+        t = threading.Thread(target=lambda: None, name="photon-brief")
+        t.start()
+        t.join(5.0)
+    with ThreadLeakSanitizer(grace_s=0.2):
+        # not photon-named: housekeeping threads are out of scope
+        threading.Thread(target=time.sleep, args=(1.0,), daemon=True,
+                         name="unrelated-worker").start()
+
+
+def test_thread_leak_check_waits_out_the_grace():
+    """A thread that finishes within the grace window is not a leak —
+    bounded joins legitimately return a beat before the target dies."""
+    with ThreadLeakSanitizer(grace_s=2.0):
+        threading.Thread(target=time.sleep, args=(0.2,), daemon=True,
+                         name="photon-straggler").start()
+
+
+# -- run_simulated_processes wiring -----------------------------------------
+def test_sim_harness_flags_cross_rank_lock_inversion():
+    """The acceptance shape: two simulated processes take the same two
+    locks in opposite orders; the harness's deferred sanitizer reports
+    it after the outcome join, with both stacks."""
+    locks = {}
+    ready = threading.Event()
+
+    def fn(rank):
+        if rank == 0:
+            # created inside the harness block => instrumented
+            locks["a"] = threading.Lock()
+            locks["b"] = threading.Lock()
+            with locks["a"]:
+                with locks["b"]:
+                    pass
+            ready.set()
+        else:
+            assert ready.wait(10.0)
+            with locks["b"]:
+                with locks["a"]:
+                    pass
+        return rank
+
+    with pytest.raises(LockOrderViolation) as ei:
+        run_simulated_processes(2, fn)
+    msg = str(ei.value)
+    assert "--- recorded opposing acquisition" in msg
+    assert "test_concurrency_sanitizers.py:" in msg
+
+    # explicit opt-out restores the pre-sanitizer behavior
+    ready.clear()
+    locks.clear()
+    assert run_simulated_processes(
+        2, fn, verify_lock_order=False) == [0, 1]
+
+
+def test_sim_harness_flags_thread_leak_and_opt_out():
+    def fn(rank):
+        if rank == 0:
+            threading.Thread(target=time.sleep, args=(4.0,), daemon=True,
+                             name="photon-sim-leak").start()
+        return rank
+
+    with pytest.raises(ThreadLeakError, match="photon-sim-leak"):
+        run_simulated_processes(2, fn)
+
+    def clean_fn(rank):
+        t = threading.Thread(target=lambda: None, name="photon-ok")
+        t.start()
+        t.join(5.0)
+        return rank
+
+    assert run_simulated_processes(2, clean_fn) == [0, 1]

@@ -353,3 +353,61 @@ def test_publish_driver_gate_exit_codes(gated_models, tmp_path, capsys):
     assert reg.read_latest() == "v000001"
     assert reg.list_versions() == ["v000001", "v000002"]
     capsys.readouterr()
+
+
+def test_watcher_stop_joins_poll_thread_without_leak():
+    """stop() reaps the poll thread with a bounded join — verified by
+    the thread-leak sanitizer (PT403's runtime twin)."""
+    import time
+
+    from photon_ml_tpu.analysis.sanitizers import ThreadLeakSanitizer
+
+    class _IdleRegistry:
+        def read_latest(self):
+            return None
+
+    class _FakeSession:
+        active_version = "v000001"
+
+    with ThreadLeakSanitizer():
+        watcher = RegistryWatcher(_IdleRegistry(), _FakeSession(),
+                                  interval_s=0.02)
+        watcher.start()
+        time.sleep(0.1)
+        watcher.stop()
+        assert not watcher._thread.is_alive()
+        assert watcher.join_timeouts == 0
+        assert watcher.checks >= 1
+
+
+def test_watcher_stop_bounded_join_on_wedged_poll(caplog):
+    """A poll wedged inside registry IO must not hang stop(): the
+    bounded join expires, the leak is counted and logged (the
+    producer_join_timeouts idiom), and the daemon is abandoned."""
+    import logging
+    import threading
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class _WedgedRegistry:
+        def read_latest(self):
+            entered.set()
+            release.wait(30.0)
+            return None
+
+    class _FakeSession:
+        active_version = "v000001"
+
+    watcher = RegistryWatcher(_WedgedRegistry(), _FakeSession(),
+                              interval_s=0.01)
+    watcher.start()
+    assert entered.wait(5.0)
+    with caplog.at_level(logging.WARNING,
+                         logger="photon_ml_tpu.serve.watcher"):
+        watcher.stop(timeout_s=0.1)
+    assert watcher.join_timeouts == 1
+    assert any("still alive" in r.getMessage() for r in caplog.records)
+    release.set()
+    watcher._thread.join(10.0)
+    assert not watcher._thread.is_alive()

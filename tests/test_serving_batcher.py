@@ -320,3 +320,62 @@ def test_done_callback_fires_on_resolution_any_order():
         assert req.error is None
     finally:
         b.close()
+
+
+def test_close_joins_worker_without_thread_leak():
+    """close() must actually reap the worker (bounded join, PT403's
+    runtime discipline) — verified by the thread-leak sanitizer."""
+    from photon_ml_tpu.analysis.sanitizers import ThreadLeakSanitizer
+    from photon_ml_tpu.serve import MicroBatcher
+
+    with ThreadLeakSanitizer():
+        b = MicroBatcher(_echo_score, max_batch=8, max_delay_ms=10.0,
+                         max_queue=8)
+        assert b.score(_rows(1.0), timeout=10.0)[0] == 1.0
+        b.close()
+        assert not b._worker.is_alive()
+        assert b.join_timeouts == 0
+        b.close()  # idempotent
+
+
+def test_close_idle_worker_wakes_from_bounded_poll():
+    """A worker that never saw a request parks in the bounded idle
+    poll; close() must still reap it promptly via the stop event."""
+    from photon_ml_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(_echo_score, max_batch=8, max_delay_ms=10.0)
+    t0 = time.monotonic()
+    b.close()
+    assert not b._worker.is_alive()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_close_times_out_on_wedged_scoring_and_warns(caplog):
+    """A wedged scoring execution must not wedge close(): the bounded
+    join expires, the leak is counted and logged (the
+    producer_join_timeouts idiom), and the request still resolves when
+    the execution finally returns."""
+    import logging
+
+    from photon_ml_tpu.serve import MicroBatcher
+
+    release = threading.Event()
+
+    def wedged(rows, per_coordinate=False):
+        release.wait(30.0)
+        return _echo_score(rows)
+
+    b = MicroBatcher(wedged, max_batch=8, max_delay_ms=1.0, max_queue=8)
+    req = b.submit(_rows(2.0))
+    deadline = time.monotonic() + 5.0
+    while b.queue_depth and time.monotonic() < deadline:
+        time.sleep(0.005)  # worker picked it up and is inside wedged()
+    with caplog.at_level(logging.WARNING,
+                         logger="photon_ml_tpu.serve.batcher"):
+        b.close(drain_timeout_s=0.1)
+    assert b.join_timeouts == 1
+    assert any("still alive" in r.getMessage() for r in caplog.records)
+    release.set()
+    assert req.result(10.0)[0] == 2.0
+    b._worker.join(10.0)
+    assert not b._worker.is_alive()

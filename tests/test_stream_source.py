@@ -454,3 +454,19 @@ def test_native_python_decode_parity_fuzz(tmp_path):
             np.testing.assert_allclose(a.weights, b.weights, rtol=1e-6)
 
     check()
+
+
+def test_producer_death_without_sentinel_fails_stop(tmp_path, rng,
+                                                    monkeypatch):
+    """A producer thread that dies without delivering its end-of-pass
+    sentinel must surface as a RuntimeError at the consumer's bounded
+    poll — never an unbounded q.get() hang (PT404's runtime contract)."""
+    path, imap = _write_dataset(tmp_path, rng, n=100, name="deadprod")
+    monkeypatch.setattr(AvroChunkSource, "_consumer_poll_s", 0.05)
+    # the producer "succeeds" while delivering nothing — the observable
+    # shape of a crash hard enough to skip the BaseException relay
+    monkeypatch.setattr(AvroChunkSource, "_put_or_stop",
+                        staticmethod(lambda q, stop, item: True))
+    src = AvroChunkSource(path, imap, chunk_rows=64)
+    with pytest.raises(RuntimeError, match="without delivering"):
+        list(src)

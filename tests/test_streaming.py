@@ -699,3 +699,20 @@ def test_stream_stats_attached_to_fit_result(rng):
     mem = fit_distributed(obj, batch, make_mesh(), jnp.zeros(dim), l2=0.5,
                           config=OptimizerConfig(max_iters=3))
     assert mem.stream_stats is None
+
+
+def test_transfer_thread_death_fails_stop_not_hangs(monkeypatch):
+    """A transfer thread that dies without delivering its end-of-pass
+    sentinel must surface as a RuntimeError at the consumer's bounded
+    poll — never an unbounded q.get() hang (PT404's runtime contract)."""
+    from photon_ml_tpu.parallel import streaming as streaming_mod
+    from photon_ml_tpu.parallel.streaming import iter_device_chunks
+
+    monkeypatch.setattr(streaming_mod, "_RING_POLL_S", 0.05)
+    # every put (chunks AND the sentinel) silently dropped: the producer
+    # exits having delivered nothing, as a hard crash would
+    monkeypatch.setattr(streaming_mod, "_ring_put",
+                        lambda q, stop, item: False)
+    with pytest.raises(RuntimeError, match="without delivering"):
+        list(iter_device_chunks([object(), object()],
+                                to_device=lambda c: c))
