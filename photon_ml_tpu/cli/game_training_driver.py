@@ -226,6 +226,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="capture a JAX profiler trace of training here "
                         "(view in TensorBoard/Perfetto)")
+    p.add_argument("--trace-dir", default=None,
+                   help="write photon-trace span files here (one "
+                        "trace-rankN.json per process, Chrome-trace "
+                        "format; merge with `photon-trace merge`). "
+                        "Also honors PHOTON_TRACE / PHOTON_TRACE_SAMPLE "
+                        "(obs/trace.py, docs/observability.md)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="fraction of traces recorded under --trace-dir")
     return p
 
 
@@ -280,6 +288,27 @@ def _read_dataset(paths, index_maps, entity_columns, columns=None) -> GameDatase
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    from photon_ml_tpu.obs import logging as obs_logging
+    from photon_ml_tpu.obs import trace as obs_trace
+
+    obs_logging.configure()
+    if args.trace_dir:
+        started = obs_trace.start(args.trace_dir, sample=args.trace_sample)
+    else:
+        started = obs_trace.maybe_start_from_env()
+    try:
+        return _run(args)
+    finally:
+        # every exit path (incl. the device-loss return 75) exports the
+        # trace files so a crashed run still leaves its spans behind.
+        # Only stop a tracer THIS invocation started: in the simulated
+        # harness several ranks run main() in one process and only one
+        # of them owns the process-wide tracer.
+        if started is not None:
+            obs_trace.stop()
+
+
+def _run(args) -> int:
     from photon_ml_tpu.parallel import resilience
     from photon_ml_tpu.parallel.multihost import initialize_multihost, runtime_info
 
@@ -291,7 +320,11 @@ def main(argv: Sequence[str] | None = None) -> int:
     # the controller process count
     entity_spec = None
     if args.entity_shards is not None:
-        pc = jax.process_count() if distributed else 1
+        # the transport's view, not jax's: identical in a real
+        # multi-controller run, and the simulated harness's per-thread
+        # transports report their group size here
+        tp = resilience.current_transport()
+        pc = tp.process_count()
         if args.entity_shards != pc:
             raise SystemExit(
                 f"--entity-shards {args.entity_shards} must equal the "
@@ -301,7 +334,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         from photon_ml_tpu.parallel.entity_shard import EntityShardSpec
 
         entity_spec = EntityShardSpec(
-            args.entity_shards, jax.process_index() if distributed else 0)
+            args.entity_shards, resilience.current_process_index())
     re_table_budget = (None if args.re_table_budget_mb is None
                        else int(args.re_table_budget_mb * 1e6))
     dtype = resolve_dtype(args.dtype)

@@ -170,6 +170,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--profile-dir", default=None,
                    help="capture a JAX profiler trace of training here")
+    p.add_argument("--trace-dir", default=None,
+                   help="write photon-trace span files here (one "
+                        "trace-rankN.json per process; merge with "
+                        "`photon-trace merge`; docs/observability.md)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="fraction of traces recorded under --trace-dir")
     return p
 
 
@@ -227,6 +233,24 @@ def _read(paths, fmt, index_map: Optional[IndexMap], add_intercept):
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    from photon_ml_tpu.obs import logging as obs_logging
+    from photon_ml_tpu.obs import trace as obs_trace
+
+    obs_logging.configure()
+    if args.trace_dir:
+        started = obs_trace.start(args.trace_dir, sample=args.trace_sample)
+    else:
+        started = obs_trace.maybe_start_from_env()
+    try:
+        return _run(args)
+    finally:
+        # every exit path exports the trace files; only stop a tracer
+        # this invocation started (simulated-harness ranks share one)
+        if started is not None:
+            obs_trace.stop()
+
+
+def _run(args) -> int:
     from photon_ml_tpu.parallel import fault_injection, resilience
     from photon_ml_tpu.parallel.multihost import initialize_multihost, runtime_info
 

@@ -116,6 +116,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "or registry root)")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "float64"])
+    p.add_argument("--trace-dir", default=None,
+                   help="write photon-trace span files here (replicas "
+                        "get per-replica subdirectories; merge with "
+                        "`photon-trace merge`; docs/observability.md)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="fraction of requests traced under --trace-dir")
     return p
 
 
@@ -298,6 +304,12 @@ def _replica_argv(args, port: int, log_dir: str) -> list:
             "--drain-timeout-s", str(args.drain_timeout_s),
             "--watch-interval-s", str(args.watch_interval_s),
             "--dtype", args.dtype, "--log-dir", log_dir]
+    if args.trace_dir:
+        # each replica process writes its own trace subdir; merge with
+        # `photon-trace merge` across replica-*/ afterwards
+        argv += ["--trace-dir",
+                 os.path.join(args.trace_dir, os.path.basename(log_dir)),
+                 "--trace-sample", str(args.trace_sample)]
     if args.no_paged_table:
         argv.append("--no-paged-table")
     if args.registry:
@@ -385,6 +397,25 @@ def _run_multi_replica(args, logger) -> int:
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_arg_parser().parse_args(argv)
+    from photon_ml_tpu.obs import logging as obs_logging
+    from photon_ml_tpu.obs import trace as obs_trace
+
+    obs_logging.configure()
+    started = None
+    if args.trace_dir and args.replicas == 1:
+        # single-replica: trace in-process; multi-replica runs trace in
+        # the replica processes (the front door stays untraced here)
+        started = obs_trace.start(args.trace_dir, sample=args.trace_sample)
+    elif args.replicas == 1:
+        started = obs_trace.maybe_start_from_env()
+    try:
+        return _serve(args)
+    finally:
+        if started is not None:  # only stop a tracer this call started
+            obs_trace.stop()
+
+
+def _serve(args) -> int:
     log_dir = args.log_dir or args.model_dir or args.registry
     os.makedirs(log_dir, exist_ok=True)
     logger = PhotonLogger(os.path.join(log_dir, "photon.log.jsonl"))

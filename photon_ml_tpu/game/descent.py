@@ -57,6 +57,8 @@ from photon_ml_tpu.models import (
     RandomEffectModel,
 )
 from photon_ml_tpu.ops.normalization import NormalizationContext
+from photon_ml_tpu.obs import metrics as obs_metrics
+from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.ops.regularization import RegularizationContext, RegularizationType
 from photon_ml_tpu.optimize import OptimizerConfig, get_optimizer
@@ -1015,7 +1017,9 @@ class CoordinateDescent:
                 # on every process at the step boundary, instead of letting
                 # the survivors deadlock in the next coordinate's
                 # collectives (parallel/resilience.py).
-                with CollectiveGuard(f"cd:{it}:{cfg.name}"):
+                with obs_trace.span("cd.coordinate", cat="train",
+                                    coordinate=cfg.name, iteration=it), \
+                        CollectiveGuard(f"cd:{it}:{cfg.name}"):
                     fault_injection.check("cd.step")
                     if cfg.name not in locked:
                         if cfg.coordinate_type == "fixed":
@@ -1073,8 +1077,13 @@ class CoordinateDescent:
                     record["seconds"] = time.time() - t0
                     record["score_delta"] = score_delta
                     sweep_deltas[cfg.name] = score_delta
+                obs_metrics.training_metrics().record_step(
+                    cfg.name, record["solve_seconds"],
+                    record["eval_seconds"], record["comm_seconds"])
+                # coordinate identity rides the record dict + the
+                # obs.logging rank/trace stamps, not a hand-rolled prefix
                 _log.log(logging.INFO if self.verbose else logging.DEBUG,
-                         "[CD] %s", record)
+                         "cd.step %s", record)
                 history.append(record)
             if checkpoint_callback is not None:
                 # coarse-grained per-outer-iteration checkpoint (the
@@ -1089,7 +1098,7 @@ class CoordinateDescent:
                 # solver passes entirely from here on)
                 stop_reason = "cd_tolerance"
                 _log.log(logging.INFO if self.verbose else logging.DEBUG,
-                         "[CD] early exit after sweep %d: max score delta "
+                         "cd.early_exit after sweep %d: max score delta "
                          "%.3g <= cd_tolerance %.3g", it,
                          max(sweep_deltas.values()), self.cd_tolerance)
                 break

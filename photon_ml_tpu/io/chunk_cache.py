@@ -336,11 +336,16 @@ class ChunkCacheSource:
                 shutil.rmtree(staging, ignore_errors=True)
 
     def __iter__(self) -> Iterator[HostChunk]:
+        from photon_ml_tpu.obs.metrics import training_metrics
+
         if self.enabled and self._try_open_warm():
             self.warm_passes += 1
+            training_metrics().record_chunk_cache_pass("warm")
             return self._iter_warm()
         if not self.enabled:
             self.fallthrough_passes += 1
+            training_metrics().record_chunk_cache_pass("fallthrough")
             return iter(self._src)
         self.cold_passes += 1
+        training_metrics().record_chunk_cache_pass("cold")
         return self._iter_cold()

@@ -58,6 +58,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.obs import metrics as obs_metrics
+from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.parallel import fault_injection
 from photon_ml_tpu.parallel.resilience import (
     collective_site,
@@ -261,14 +263,21 @@ def _guarded_gather(blob: bytes, *, tag: str,
     fault_injection.check("entity_shard.exchange")
     tp = current_transport()
     if tp.process_count() > 1:
-        health_barrier(f"entity_shard.exchange:{tag}", timeout=timeout)
-    with collective_site(tag):  # trace label for the sanitizer
-        blobs = allgather_blobs(blob, timeout=timeout)
+        with obs_trace.span("exchange.barrier", cat="collective",
+                            site=f"barrier:{tag}"):
+            health_barrier(f"entity_shard.exchange:{tag}", timeout=timeout)
+    with obs_trace.span("exchange.allgather", cat="collective",
+                        site=tag, bytes_sent=len(blob)):
+        with collective_site(tag):  # trace label for the sanitizer
+            blobs = allgather_blobs(blob, timeout=timeout)
     if stats is not None:
         stats.exchanges += 1
         stats.bytes_sent += len(blob)
         stats.bytes_gathered += sum(len(b) for b in blobs)
         stats.seconds += time.perf_counter() - t0
+        obs_metrics.training_metrics().record_exchange(
+            len(blob), sum(len(b) for b in blobs),
+            time.perf_counter() - t0)
     return blobs
 
 

@@ -41,6 +41,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.compat import shard_map
+from photon_ml_tpu.obs import metrics as obs_metrics
+from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.parallel import fault_injection
 from photon_ml_tpu.parallel.resilience import (
@@ -162,12 +164,14 @@ def iter_device_chunks(chunks, to_device: Callable, depth: Optional[int] = None,
     except Exception:
         fault_proc = None
 
+    tctx = obs_trace.current_context()  # handed off to the ring thread
+
     def produce():
         it = iter(chunks)
         ctx = (fault_injection.process_context(fault_proc)
                if fault_proc is not None else contextlib.nullcontext())
         try:
-            with use_transport(tp), ctx:
+            with use_transport(tp), ctx, obs_trace.use_context(tctx):
                 t_wait = time.perf_counter()
                 while True:
                     try:
@@ -177,7 +181,8 @@ def iter_device_chunks(chunks, to_device: Callable, depth: Optional[int] = None,
                     now = time.perf_counter()
                     if stop.is_set():
                         return
-                    dev = to_device(chunk)
+                    with obs_trace.span("stream.upload", cat="stream"):
+                        dev = to_device(chunk)
                     if stats is not None:
                         stats.decode_s += now - t_wait
                         stats.transfer_s += time.perf_counter() - now
@@ -841,6 +846,10 @@ def _finish_stream_result(res: OptimizationResult, stats: StreamStats,
         "%.3fs, transfer %.3fs, compute-stall %.3fs",
         optimizer, stats.passes, stats.chunks, stats.decode_s,
         stats.transfer_s, stats.stall_s)
+    # one StreamStats per fit, so the totals ARE this fit's delta
+    obs_metrics.training_metrics().record_prefetch(
+        stall_s=stats.stall_s, decode_s=stats.decode_s,
+        transfer_s=stats.transfer_s)
     return res._replace(stream_stats=stats.as_dict())
 
 

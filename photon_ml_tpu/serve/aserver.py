@@ -41,6 +41,8 @@ import signal
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from photon_ml_tpu.obs import trace as obs_trace
+from photon_ml_tpu.obs.metrics import escape_label_value
 from photon_ml_tpu.serve.server import ScoringService
 
 __all__ = ["AsyncScoringServer", "AsyncFrontDoor", "install_uvloop"]
@@ -115,6 +117,13 @@ async def _read_request(reader: asyncio.StreamReader):
         raise ValueError(f"bad content-length {length}")
     body = await reader.readexactly(length) if length else b""
     return method, path, headers, body
+
+
+def _request_id_from(headers: Dict[str, str]) -> str:
+    """Honor a client-supplied X-Request-Id (trimmed, bounded); assign
+    one otherwise — the same contract as the threaded handler."""
+    rid = (headers.get("x-request-id") or "").strip()
+    return rid[:128] if rid else obs_trace.new_request_id()
 
 
 class AsyncScoringServer:
@@ -211,7 +220,7 @@ class AsyncScoringServer:
                     return
                 method, path, headers, body = req
                 keep = headers.get("connection", "").lower() != "close"
-                data = await self._dispatch(method, path, body)
+                data = await self._dispatch(method, path, body, headers)
                 writer.write(data if keep else
                              data.replace(b"Connection: keep-alive",
                                           b"Connection: close", 1))
@@ -227,44 +236,59 @@ class AsyncScoringServer:
             except Exception:
                 pass
 
-    async def _dispatch(self, method: str, path: str, body: bytes) -> bytes:
+    async def _dispatch(self, method: str, path: str, body: bytes,
+                        headers: Optional[Dict[str, str]] = None) -> bytes:
         svc = self.service
+        rid = _request_id_from(headers or {})
+        rid_hdr = (("X-Request-Id", rid),)
         if method == "GET":
             if path == "/healthz":
                 status, payload = svc.handle_healthz()
                 payload["server"] = "asyncio"
-                return _encode_response(status, payload)
+                return _encode_response(status, payload,
+                                        extra_headers=rid_hdr)
             if path == "/metrics":
                 status, text = svc.handle_metrics()
                 return _encode_response(
-                    status, text, content_type="text/plain; version=0.0.4")
-            return _encode_response(404,
-                                    {"error": f"unknown path {path}"})
+                    status, text, content_type="text/plain; version=0.0.4",
+                    extra_headers=rid_hdr)
+            return _encode_response(404, {"error": f"unknown path {path}"},
+                                    extra_headers=rid_hdr)
         if method != "POST" or path not in ("/score", "/admin/reload"):
-            return _encode_response(404, {"error": f"unknown path {path}"})
+            return _encode_response(404, {"error": f"unknown path {path}"},
+                                    extra_headers=rid_hdr)
         try:
             payload = json.loads(body or b"null")
         except (ValueError, json.JSONDecodeError) as e:
-            return _encode_response(400, {"error": f"bad JSON: {e}"})
+            return _encode_response(
+                400, {"error": f"bad JSON: {e}", "requestId": rid},
+                extra_headers=rid_hdr)
         if path == "/admin/reload":
             # swaps take ms-seconds: off the loop, scores keep flowing
             status, resp = await asyncio.get_running_loop().run_in_executor(
                 None, svc.handle_reload, payload)
-            return _encode_response(status, resp)
-        status, resp = await self.score_async(payload)
-        extra = ()
+            return _encode_response(status, resp, extra_headers=rid_hdr)
+        # contextvars-ambient context: safe across the await (each
+        # asyncio task carries its own copy, no cross-request bleed)
+        with obs_trace.request_context(request_id=rid):
+            status, resp = await self.score_async(payload, request_id=rid)
+        extra = rid_hdr
         if status == 429 and isinstance(resp, dict):
             after = max(1, int(-(-float(resp.get("retryAfterS", 1.0)) // 1)))
-            extra = (("Retry-After", str(after)),)
+            extra = rid_hdr + (("Retry-After", str(after)),)
         return _encode_response(status, resp, extra_headers=extra)
 
-    async def score_async(self, payload) -> Tuple[int, dict]:
+    async def score_async(self, payload,
+                          request_id: Optional[str] = None
+                          ) -> Tuple[int, dict]:
         """``/score`` without blocking the loop: validate inline, admit
         through the batcher's non-blocking submit, await the worker's
         resolution via done-callback."""
         svc = self.service
         valid, err = svc.validate_score_payload(payload)
         if valid is None:
+            if request_id:
+                err = dict(err, requestId=request_id)
             return 400, err
         rows, per_coord = valid
         loop = asyncio.get_running_loop()
@@ -283,10 +307,13 @@ class AsyncScoringServer:
                 fut.set_result(req.result(0))
 
         try:
-            svc.batcher.submit(rows, per_coord).add_done_callback(_resolve)
+            with obs_trace.span("http.score", cat="serve", rows=len(rows)):
+                pending = svc.batcher.submit(rows, per_coord,
+                                             request_id=request_id)
+            pending.add_done_callback(_resolve)
             result = await asyncio.wait_for(fut, svc.request_timeout_s)
         except Exception as e:
-            return svc.score_error_response(e)
+            return svc.score_error_response(e, request_id=request_id)
         return 200, svc.score_body(rows, per_coord, result)
 
 
@@ -294,7 +321,8 @@ class _Backend:
     """One replica behind the front door: address, pooled connections,
     in-flight count, failure cool-down."""
 
-    __slots__ = ("host", "port", "inflight", "down_until", "pool")
+    __slots__ = ("host", "port", "inflight", "down_until", "pool",
+                 "picked", "cooldowns")
 
     def __init__(self, host: str, port: int):
         self.host = host
@@ -302,6 +330,8 @@ class _Backend:
         self.inflight = 0
         self.down_until = 0.0
         self.pool: List[tuple] = []  # (reader, writer) keep-alive pairs
+        self.picked = 0     # times selected to carry a proxied request
+        self.cooldowns = 0  # times put into failure cool-down
 
     @property
     def address(self) -> str:
@@ -393,11 +423,14 @@ class AsyncFrontDoor:
             return None
         if self.policy == "round_robin":
             self._rr += 1
-            return live[self._rr % len(live)]
-        best = min(b.inflight for b in live)
-        tied = [b for b in live if b.inflight == best]
-        self._rr += 1
-        return tied[self._rr % len(tied)]
+            chosen = live[self._rr % len(live)]
+        else:
+            best = min(b.inflight for b in live)
+            tied = [b for b in live if b.inflight == best]
+            self._rr += 1
+            chosen = tied[self._rr % len(tied)]
+        chosen.picked += 1
+        return chosen
 
     async def _backend_exchange(self, backend: _Backend,
                                 request: bytes) -> bytes:
@@ -445,11 +478,21 @@ class AsyncFrontDoor:
                 if req is None:
                     return
                 method, path, headers, body = req
+                rid = _request_id_from(headers)
+                rid_hdr = (("X-Request-Id", rid),)
                 if method == "GET" and path == "/fd/healthz":
-                    writer.write(_encode_response(200, self.stats()))
+                    writer.write(_encode_response(200, self.stats(),
+                                                  extra_headers=rid_hdr))
                     await writer.drain()
                     continue
-                data = await self._proxy(method, path, body)
+                if method == "GET" and path == "/fd/metrics":
+                    text = await self._fd_metrics()
+                    writer.write(_encode_response(
+                        200, text, content_type="text/plain; version=0.0.4",
+                        extra_headers=rid_hdr))
+                    await writer.drain()
+                    continue
+                data = await self._proxy(method, path, body, request_id=rid)
                 writer.write(data)
                 await writer.drain()
                 if headers.get("connection", "").lower() == "close":
@@ -462,39 +505,106 @@ class AsyncFrontDoor:
             except Exception:
                 pass
 
-    async def _proxy(self, method: str, path: str, body: bytes) -> bytes:
+    async def _proxy(self, method: str, path: str, body: bytes,
+                     request_id: Optional[str] = None) -> bytes:
+        rid = request_id or obs_trace.new_request_id()
         request = (
             f"{method} {path} HTTP/1.1\r\n"
             f"Host: backend\r\nContent-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n"
+            f"X-Request-Id: {rid}\r\n"
             f"Connection: keep-alive\r\n\r\n").encode("ascii") + body
         tried: set = set()
-        for _attempt in range(2):
-            backend = self._pick(tried)
-            if backend is None:
-                break
-            backend.inflight += 1
-            try:
-                data = await self._backend_exchange(backend, request)
-                self.proxied += 1
-                return data
-            except Exception:
-                tried.add(backend.address)
-                backend.down_until = (time.monotonic()
-                                      + self.retry_backend_s)
-                self.retried += 1
-            finally:
-                backend.inflight -= 1
+        with obs_trace.request_context(request_id=rid):
+            for _attempt in range(2):
+                backend = self._pick(tried)
+                if backend is None:
+                    break
+                backend.inflight += 1
+                try:
+                    with obs_trace.span("fd.proxy", cat="serve", path=path,
+                                        backend=backend.address):
+                        data = await self._backend_exchange(backend, request)
+                    self.proxied += 1
+                    return data
+                except Exception:
+                    tried.add(backend.address)
+                    backend.down_until = (time.monotonic()
+                                          + self.retry_backend_s)
+                    backend.cooldowns += 1
+                    self.retried += 1
+                finally:
+                    backend.inflight -= 1
         self.unavailable += 1
         return _encode_response(
-            503, {"error": "no live backend replica"})
+            503, {"error": "no live backend replica", "requestId": rid},
+            extra_headers=(("X-Request-Id", rid),))
+
+    async def _fd_metrics(self) -> str:
+        """Aggregate ``/metrics`` across replicas: each backend's samples
+        re-emitted with an injected ``replica="host:port"`` label
+        (``# TYPE`` lines deduplicated across replicas), followed by the
+        front door's own ``photon_fd_*`` counters. A backend that fails
+        the scrape is cooled down exactly like a failed proxy exchange
+        and simply omitted from this scrape."""
+        scrape = (b"GET /metrics HTTP/1.1\r\nHost: backend\r\n"
+                  b"Content-Length: 0\r\nConnection: keep-alive\r\n\r\n")
+        out: List[str] = []
+        seen_meta: set = set()
+        now = time.monotonic()
+        for b in self._backends:
+            if b.down_until > now:
+                continue
+            try:
+                data = await self._backend_exchange(b, scrape)
+            except Exception:
+                b.down_until = time.monotonic() + self.retry_backend_s
+                b.cooldowns += 1
+                continue
+            head, _, payload = data.partition(b"\r\n\r\n")
+            if b" 200 " not in head.split(b"\r\n", 1)[0]:
+                continue
+            replica = escape_label_value(b.address)
+            for line in payload.decode("utf-8", "replace").splitlines():
+                if not line:
+                    continue
+                if line.startswith("#"):
+                    if line not in seen_meta:
+                        seen_meta.add(line)
+                        out.append(line)
+                    continue
+                series, _, value = line.rpartition(" ")
+                if "{" in series:
+                    name, _, rest = series.partition("{")
+                    series = f'{name}{{replica="{replica}",{rest}'
+                else:
+                    series = f'{series}{{replica="{replica}"}}'
+                out.append(f"{series} {value}")
+        out.append("# TYPE photon_fd_proxied_total counter")
+        out.append(f"photon_fd_proxied_total {self.proxied}")
+        out.append("# TYPE photon_fd_retried_total counter")
+        out.append(f"photon_fd_retried_total {self.retried}")
+        out.append("# TYPE photon_fd_unavailable_total counter")
+        out.append(f"photon_fd_unavailable_total {self.unavailable}")
+        out.append("# TYPE photon_fd_backend_picked_total counter")
+        for b in self._backends:
+            out.append(f'photon_fd_backend_picked_total'
+                       f'{{backend="{escape_label_value(b.address)}"}} '
+                       f'{b.picked}')
+        out.append("# TYPE photon_fd_backend_cooldowns_total counter")
+        for b in self._backends:
+            out.append(f'photon_fd_backend_cooldowns_total'
+                       f'{{backend="{escape_label_value(b.address)}"}} '
+                       f'{b.cooldowns}')
+        return "\n".join(out) + "\n"
 
     def stats(self) -> Dict[str, object]:
         return {
             "policy": self.policy,
             "backends": [
                 {"address": b.address, "inflight": b.inflight,
-                 "down": b.down_until > time.monotonic()}
+                 "down": b.down_until > time.monotonic(),
+                 "picked": b.picked, "cooldowns": b.cooldowns}
                 for b in self._backends
             ],
             "proxied": self.proxied,

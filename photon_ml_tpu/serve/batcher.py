@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
+from photon_ml_tpu.obs import trace as obs_trace
+from photon_ml_tpu.obs.logging import SlowRequestLog
 from photon_ml_tpu.parallel.resilience import WatchdogTimeout
 
 _log = logging.getLogger(__name__)
@@ -79,9 +81,11 @@ class PendingRequest:
     ``loop.call_soon_threadsafe``)."""
 
     __slots__ = ("rows", "per_coordinate", "_event", "_result", "_error",
-                 "admitted_at", "_callbacks", "_cb_lock")
+                 "admitted_at", "_callbacks", "_cb_lock", "request_id",
+                 "trace_ctx")
 
-    def __init__(self, rows: Sequence[dict], per_coordinate: bool):
+    def __init__(self, rows: Sequence[dict], per_coordinate: bool,
+                 request_id: Optional[str] = None):
         self.rows = list(rows)
         self.per_coordinate = per_coordinate
         self._event = threading.Event()
@@ -90,6 +94,11 @@ class PendingRequest:
         self._callbacks: List[Callable] = []
         self._cb_lock = threading.Lock()
         self.admitted_at = time.monotonic()
+        # identity captured at admission: the submitting thread's trace
+        # context rides the request across the worker-thread handoff, so
+        # batcher/session/install spans land under the request's trace
+        self.request_id = request_id
+        self.trace_ctx = obs_trace.current_context()
 
     def set_result(self, value) -> None:
         self._result = value
@@ -175,6 +184,8 @@ class MicroBatcher:
         # worker joins that outlived the drain grace (a wedged scoring
         # execution); counted + logged, mirroring producer_join_timeouts
         self.join_timeouts = 0
+        # top-N slow-request exemplars (request id + queue/compute split)
+        self.slow_log = SlowRequestLog(top_n=10)
         self._carry: Optional[PendingRequest] = None  # worker-only state
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="photon-serve-batcher")
@@ -182,7 +193,8 @@ class MicroBatcher:
 
     # -- submission --------------------------------------------------------
     def submit(self, rows: Sequence[dict],
-               per_coordinate: bool = False) -> PendingRequest:
+               per_coordinate: bool = False,
+               request_id: Optional[str] = None) -> PendingRequest:
         """Admit a request (non-blocking). Raises :class:`QueueFullError`
         when the queue is at capacity and ValueError for oversized or
         empty requests; never blocks the caller on a full queue."""
@@ -195,7 +207,7 @@ class MicroBatcher:
             raise ValueError(
                 f"request of {len(rows)} rows exceeds max_batch="
                 f"{self.max_batch}; split it client-side")
-        req = PendingRequest(rows, per_coordinate)
+        req = PendingRequest(rows, per_coordinate, request_id=request_id)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -218,9 +230,11 @@ class MicroBatcher:
         return max(self.max_delay_s, batches_queued * self.max_delay_s)
 
     def score(self, rows: Sequence[dict], per_coordinate: bool = False,
-              timeout: Optional[float] = None):
+              timeout: Optional[float] = None,
+              request_id: Optional[str] = None):
         """Blocking convenience: submit + wait for the result."""
-        return self.submit(rows, per_coordinate).result(timeout)
+        return self.submit(rows, per_coordinate,
+                           request_id=request_id).result(timeout)
 
     @property
     def queue_depth(self) -> int:
@@ -331,10 +345,12 @@ class MicroBatcher:
         if self.watchdog_s is None:
             return self._score_fn(rows, per_coordinate)
         box: dict = {}
+        tctx = obs_trace.current_context()  # ride into the helper thread
 
         def run():
             try:
-                box["result"] = self._score_fn(rows, per_coordinate)
+                with obs_trace.use_context(tctx):
+                    box["result"] = self._score_fn(rows, per_coordinate)
             except BaseException as e:  # surfaced to the batch below
                 box["error"] = e
 
@@ -358,8 +374,19 @@ class MicroBatcher:
         t0 = time.monotonic()
         queue_waits = [(t0 - req.admitted_at) * 1e3 for req in batch]
         per_coord = any(r.per_coordinate for r in batch)
+        # adopt the first traced request's context so the batch's session
+        # and device-compute spans carry its trace/request id (a batch is
+        # one execution; per-request attribution is the args list below)
+        tctx = next((r.trace_ctx for r in batch
+                     if r.trace_ctx is not None), None)
         try:
-            result = self._score_with_watchdog(rows, per_coord)
+            with obs_trace.use_context(tctx), \
+                    obs_trace.span(
+                        "batch.execute", cat="serve", rows=len(rows),
+                        requests=len(batch),
+                        request_ids=[r.request_id for r in batch
+                                     if r.request_id]):
+                result = self._score_with_watchdog(rows, per_coord)
         except BaseException as e:
             for req in batch:
                 req.set_error(e)
@@ -387,4 +414,8 @@ class MicroBatcher:
                 self._metrics.record_request(
                     len(req.rows), (now - req.admitted_at) * 1e3,
                     queue_wait_ms=waited_ms, compute_ms=elapsed_ms)
+            self.slow_log.note(
+                req.request_id, (now - req.admitted_at) * 1e3,
+                queue_wait_ms=round(waited_ms, 3),
+                compute_ms=round(elapsed_ms, 3), rows=len(req.rows))
             start = end

@@ -1,321 +1,21 @@
-"""Serving metrics: counters, gauges, latency histograms, text exposition.
+"""Serving metrics — compatibility re-export.
 
-Stdlib-only (the serving stack adds no dependencies). The exposition
-format is the Prometheus text format's subset that covers counters,
-gauges, and cumulative histograms, so the ``/metrics`` endpoint scrapes
-directly; everything is also readable as a plain dict (``snapshot``) for
-the in-process tests and the bench harness.
-
-Thread-safety: one lock per :class:`ServingMetrics` instance — every
-recording site is a handful of float ops, and the handler threads +
-batcher worker all write here.
+The metrics core (histograms, counters, text exposition) moved to
+:mod:`photon_ml_tpu.obs.metrics` so training and the front door share
+the same primitives; this module keeps the historical import path and
+the exact classes the serving stack and its tests bind to. The
+``/metrics`` render is byte-identical to the pre-move output for every
+pre-existing series (``tests/test_obs_metrics.py`` pins it against a
+golden exposition captured before the move).
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, List, Optional, Tuple
-
-__all__ = ["Histogram", "ServingMetrics"]
-
-# Default latency buckets (milliseconds): log-ish spacing from sub-ms to
-# the watchdog regime. Cumulative counts, prometheus ``le`` semantics.
-DEFAULT_LATENCY_BUCKETS_MS = (
-    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
-    1000.0, 2500.0, 5000.0,
+from photon_ml_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS_MS,
+    Histogram,
+    ServingMetrics,
+    _fmt,
 )
 
-
-class Histogram:
-    """Fixed-bucket cumulative histogram (prometheus semantics): bucket
-    ``le=b`` counts observations ``<= b``, plus ``+Inf``/count/sum."""
-
-    def __init__(self, bounds: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_MS):
-        self.bounds = tuple(float(b) for b in bounds)
-        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
-        self.total = 0
-        self.sum = 0.0
-
-    def observe(self, value: float) -> None:
-        i = len(self.bounds)
-        for j, b in enumerate(self.bounds):
-            if value <= b:
-                i = j
-                break
-        self.counts[i] += 1
-        self.total += 1
-        self.sum += value
-
-    def quantile(self, q: float) -> float:
-        """Approximate quantile from bucket boundaries (upper bound of the
-        bucket the rank lands in; +Inf bucket reports the last bound)."""
-        if self.total == 0:
-            return 0.0
-        rank = q * self.total
-        seen = 0
-        for j, b in enumerate(self.bounds):
-            seen += self.counts[j]
-            if seen >= rank:
-                return b
-        return self.bounds[-1] if self.bounds else float("inf")
-
-    def render(self, name: str, out: List[str]) -> None:
-        out.append(f"# TYPE {name} histogram")
-        cum = 0
-        for j, b in enumerate(self.bounds):
-            cum += self.counts[j]
-            out.append(f'{name}_bucket{{le="{_fmt(b)}"}} {cum}')
-        out.append(f'{name}_bucket{{le="+Inf"}} {self.total}')
-        out.append(f"{name}_sum {_fmt(self.sum)}")
-        out.append(f"{name}_count {self.total}")
-
-
-def _fmt(v: float) -> str:
-    return repr(int(v)) if float(v).is_integer() else repr(float(v))
-
-
-class ServingMetrics:
-    """All serving-side instrumentation in one place.
-
-    Exported series (``photon_serve_`` prefix):
-      requests_total / rows_total / shed_total / errors_total — counters;
-      shed_queue_full_total / shed_deadline_total — the load-shedding
-        split by cause: admission-queue-at-capacity rejections vs
-        requests whose deadline expired while still queued (shed_total
-        stays the sum, for dashboards that predate the split);
-      request_latency_ms / batch_latency_ms — histograms (request latency
-        is admission -> response; batch latency is one scoring execution);
-      queue_wait_ms / compute_ms — the request-latency split: time a
-        request sat in the admission queue waiting for a batch slot vs
-        the scoring execution's wall time attributed to the request, so
-        the bench's stall accounting and /metrics agree on where time
-        goes (queue_wait + compute ~= request_latency per request);
-      queue_depth — gauge, current admission-queue occupancy;
-      batch_fill_ratio — gauge, rolling mean of rows/max_batch per batch;
-      compile_cache_{hits,misses}_total, coeff_cache_{hits,misses,
-        evictions}_total — cache counters (hit rates derive from these);
-      swaps_total / swap_latency_ms / active_version_info — the model-
-        lifecycle series: hot-swap count, build-to-install latency, and
-        a version-labeled info gauge (value constant 1; the label
-        carries the active version, the standard prometheus idiom for
-        string-valued state);
-      gate_{pass,fail}_total — promotion-gate verdicts observed by this
-        process (the gate tool and the reload path record here).
-    """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.requests_total = 0
-        self.rows_total = 0
-        self.shed_total = 0
-        self.shed_queue_full_total = 0
-        self.shed_deadline_total = 0
-        self.errors_total = 0
-        self.batches_total = 0
-        self.batch_rows_sum = 0
-        self.batch_fill_sum = 0.0
-        self.queue_depth = 0
-        self.request_latency_ms = Histogram()
-        self.batch_latency_ms = Histogram()
-        self.queue_wait_ms = Histogram()
-        self.compute_ms = Histogram()
-        # cache counters are owned here but incremented through the cache
-        # objects' stat hooks so the caches stay usable standalone
-        self.compile_cache_hits = 0
-        self.compile_cache_misses = 0
-        self.coeff_cache_hits = 0
-        self.coeff_cache_misses = 0
-        self.coeff_cache_evictions = 0
-        # device-resident paged coefficient table (serve/paged_table.py)
-        self.paged_installs = 0
-        self.paged_page_evictions = 0
-        self.paged_faults = 0
-        # model lifecycle (registry/ + ScoringSession.swap)
-        self.swaps_total = 0
-        self.swap_latency_ms = Histogram()
-        self.active_version = ""
-        self.gate_pass_total = 0
-        self.gate_fail_total = 0
-
-    # -- recording sites ---------------------------------------------------
-    def record_request(self, rows: int, latency_ms: float,
-                       queue_wait_ms: Optional[float] = None,
-                       compute_ms: Optional[float] = None) -> None:
-        with self._lock:
-            self.requests_total += 1
-            self.rows_total += rows
-            self.request_latency_ms.observe(latency_ms)
-            if queue_wait_ms is not None:
-                self.queue_wait_ms.observe(queue_wait_ms)
-            if compute_ms is not None:
-                self.compute_ms.observe(compute_ms)
-
-    def record_shed(self, cause: str = "queue_full") -> None:
-        with self._lock:
-            self.shed_total += 1
-            if cause == "deadline":
-                self.shed_deadline_total += 1
-            else:
-                self.shed_queue_full_total += 1
-
-    def record_error(self) -> None:
-        with self._lock:
-            self.errors_total += 1
-
-    def record_batch(self, rows: int, max_batch: int,
-                     latency_ms: float) -> None:
-        with self._lock:
-            self.batches_total += 1
-            self.batch_rows_sum += rows
-            self.batch_fill_sum += rows / max(max_batch, 1)
-            self.batch_latency_ms.observe(latency_ms)
-
-    def set_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth = depth
-
-    def record_compile(self, hit: bool) -> None:
-        with self._lock:
-            if hit:
-                self.compile_cache_hits += 1
-            else:
-                self.compile_cache_misses += 1
-
-    def record_coeff(self, hits: int = 0, misses: int = 0,
-                     evictions: int = 0) -> None:
-        with self._lock:
-            self.coeff_cache_hits += hits
-            self.coeff_cache_misses += misses
-            self.coeff_cache_evictions += evictions
-
-    def record_paged(self, installs: int = 0, page_evictions: int = 0,
-                     faults: int = 0) -> None:
-        with self._lock:
-            self.paged_installs += installs
-            self.paged_page_evictions += page_evictions
-            self.paged_faults += faults
-
-    def set_active_version(self, version: str) -> None:
-        with self._lock:
-            self.active_version = str(version)
-
-    def record_swap(self, version: str, latency_ms: float) -> None:
-        with self._lock:
-            self.swaps_total += 1
-            self.active_version = str(version)
-            self.swap_latency_ms.observe(latency_ms)
-
-    def record_gate(self, passed: bool) -> None:
-        with self._lock:
-            if passed:
-                self.gate_pass_total += 1
-            else:
-                self.gate_fail_total += 1
-
-    # -- views -------------------------------------------------------------
-    @staticmethod
-    def _rate(hits: int, misses: int) -> float:
-        total = hits + misses
-        return hits / total if total else 0.0
-
-    def snapshot(self) -> Dict[str, float]:
-        """Flat dict view (tests, bench, logs)."""
-        with self._lock:
-            return {
-                "requests_total": self.requests_total,
-                "rows_total": self.rows_total,
-                "shed_total": self.shed_total,
-                "shed_queue_full_total": self.shed_queue_full_total,
-                "shed_deadline_total": self.shed_deadline_total,
-                "errors_total": self.errors_total,
-                "batches_total": self.batches_total,
-                "queue_depth": self.queue_depth,
-                "batch_fill_ratio": (self.batch_fill_sum
-                                     / max(self.batches_total, 1)),
-                "request_latency_p50_ms":
-                    self.request_latency_ms.quantile(0.5),
-                "request_latency_p99_ms":
-                    self.request_latency_ms.quantile(0.99),
-                "queue_wait_p50_ms": self.queue_wait_ms.quantile(0.5),
-                "queue_wait_p99_ms": self.queue_wait_ms.quantile(0.99),
-                "compute_p50_ms": self.compute_ms.quantile(0.5),
-                "compute_p99_ms": self.compute_ms.quantile(0.99),
-                "compile_cache_hits": self.compile_cache_hits,
-                "compile_cache_misses": self.compile_cache_misses,
-                "compile_cache_hit_rate": self._rate(
-                    self.compile_cache_hits, self.compile_cache_misses),
-                "coeff_cache_hits": self.coeff_cache_hits,
-                "coeff_cache_misses": self.coeff_cache_misses,
-                "coeff_cache_evictions": self.coeff_cache_evictions,
-                "paged_installs": self.paged_installs,
-                "paged_page_evictions": self.paged_page_evictions,
-                "paged_faults": self.paged_faults,
-                "coeff_cache_hit_rate": self._rate(
-                    self.coeff_cache_hits, self.coeff_cache_misses),
-                "swaps_total": self.swaps_total,
-                "swap_latency_p50_ms": self.swap_latency_ms.quantile(0.5),
-                "active_version": self.active_version,
-                "gate_pass_total": self.gate_pass_total,
-                "gate_fail_total": self.gate_fail_total,
-            }
-
-    def render(self) -> str:
-        """Prometheus text exposition of every series."""
-        with self._lock:
-            out: List[str] = []
-
-            def counter(name, v):
-                out.append(f"# TYPE {name} counter")
-                out.append(f"{name} {_fmt(v)}")
-
-            def gauge(name, v):
-                out.append(f"# TYPE {name} gauge")
-                out.append(f"{name} {_fmt(v)}")
-
-            counter("photon_serve_requests_total", self.requests_total)
-            counter("photon_serve_rows_total", self.rows_total)
-            counter("photon_serve_shed_total", self.shed_total)
-            counter("photon_serve_shed_queue_full_total",
-                    self.shed_queue_full_total)
-            counter("photon_serve_shed_deadline_total",
-                    self.shed_deadline_total)
-            counter("photon_serve_errors_total", self.errors_total)
-            counter("photon_serve_batches_total", self.batches_total)
-            gauge("photon_serve_queue_depth", self.queue_depth)
-            gauge("photon_serve_batch_fill_ratio",
-                  self.batch_fill_sum / max(self.batches_total, 1))
-            self.request_latency_ms.render(
-                "photon_serve_request_latency_ms", out)
-            self.batch_latency_ms.render(
-                "photon_serve_batch_latency_ms", out)
-            self.queue_wait_ms.render("photon_serve_queue_wait_ms", out)
-            self.compute_ms.render("photon_serve_compute_ms", out)
-            counter("photon_serve_compile_cache_hits_total",
-                    self.compile_cache_hits)
-            counter("photon_serve_compile_cache_misses_total",
-                    self.compile_cache_misses)
-            gauge("photon_serve_compile_cache_hit_rate", self._rate(
-                self.compile_cache_hits, self.compile_cache_misses))
-            counter("photon_serve_coeff_cache_hits_total",
-                    self.coeff_cache_hits)
-            counter("photon_serve_coeff_cache_misses_total",
-                    self.coeff_cache_misses)
-            counter("photon_serve_coeff_cache_evictions_total",
-                    self.coeff_cache_evictions)
-            counter("photon_serve_paged_installs_total",
-                    self.paged_installs)
-            counter("photon_serve_paged_page_evictions_total",
-                    self.paged_page_evictions)
-            counter("photon_serve_paged_faults_total", self.paged_faults)
-            gauge("photon_serve_coeff_cache_hit_rate", self._rate(
-                self.coeff_cache_hits, self.coeff_cache_misses))
-            counter("photon_serve_swaps_total", self.swaps_total)
-            self.swap_latency_ms.render("photon_serve_swap_latency_ms", out)
-            out.append("# TYPE photon_serve_active_version_info gauge")
-            label = (self.active_version.replace("\\", "\\\\")
-                     .replace('"', '\\"'))
-            out.append(
-                f'photon_serve_active_version_info{{version="{label}"}} 1')
-            counter("photon_serve_gate_pass_total", self.gate_pass_total)
-            counter("photon_serve_gate_fail_total", self.gate_fail_total)
-            return "\n".join(out) + "\n"
+__all__ = ["Histogram", "ServingMetrics"]

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.serve.coeff_cache import CoeffEntry
 from photon_ml_tpu.utils import transfer_budget
 
@@ -242,14 +243,17 @@ class PagedCoefficientTable:
                 self.installs += installed
                 # page-wise functional refresh: new buffer per install
                 # burst, old snapshots stay valid for in-flight batches
-                buf = self._device
-                for page in sorted(touched):
-                    rows = transfer_budget.device_put(
-                        self._host[page * self.page_rows:
-                                   (page + 1) * self.page_rows],
-                        what=f"serve.paged_install[{self.name}]")
-                    buf = self._setter(buf, page, rows)
-                self._device = buf
+                with obs_trace.span("paged.page_refresh", cat="serve",
+                                    table=self.name,
+                                    pages=len(touched), rows=installed):
+                    buf = self._device
+                    for page in sorted(touched):
+                        rows = transfer_budget.device_put(
+                            self._host[page * self.page_rows:
+                                       (page + 1) * self.page_rows],
+                            what=f"serve.paged_install[{self.name}]")
+                        buf = self._setter(buf, page, rows)
+                    self._device = buf
         if installed and self._metrics is not None:
             self._metrics.record_paged(installs=installed)
         return installed

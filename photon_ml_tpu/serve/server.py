@@ -35,6 +35,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
+from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.serve.batcher import (
     BatchWatchdogTimeout,
     MicroBatcher,
@@ -82,17 +83,26 @@ class ScoringService:
         return (rows, bool(payload.get("perCoordinate"))), None
 
     @staticmethod
-    def score_error_response(e: BaseException) -> Tuple[int, dict]:
+    def score_error_response(e: BaseException,
+                             request_id: Optional[str] = None
+                             ) -> Tuple[int, dict]:
         """Map a scoring-path exception onto the status contract — ONE
-        definition for the threaded and asyncio transports."""
+        definition for the threaded and asyncio transports. Shed/error
+        bodies carry the request id so a client's 429/503 is greppable
+        against the server's slow-request and error logs."""
         if isinstance(e, QueueFullError):
-            return 429, {"error": str(e), "shed": True, "cause": e.cause,
-                         "retryAfterS": round(e.retry_after_s, 3)}
-        if isinstance(e, ValueError):
-            return 400, {"error": str(e)}
-        if isinstance(e, (BatchWatchdogTimeout, TimeoutError)):
-            return 504, {"error": str(e)}
-        return 503, {"error": f"scoring failed: {e}"}
+            body = {"error": str(e), "shed": True, "cause": e.cause,
+                    "retryAfterS": round(e.retry_after_s, 3)}
+            status = 429
+        elif isinstance(e, ValueError):
+            status, body = 400, {"error": str(e)}
+        elif isinstance(e, (BatchWatchdogTimeout, TimeoutError)):
+            status, body = 504, {"error": str(e)}
+        else:
+            status, body = 503, {"error": f"scoring failed: {e}"}
+        if request_id:
+            body["requestId"] = request_id
+        return status, body
 
     @staticmethod
     def score_body(rows, per_coord: bool, result) -> dict:
@@ -110,19 +120,25 @@ class ScoringService:
                 k: [float(x) for x in v] for k, v in parts.items()}
         return body
 
-    def handle_score(self, payload) -> Tuple[int, dict]:
+    def handle_score(self, payload,
+                     request_id: Optional[str] = None) -> Tuple[int, dict]:
         """``{"rows": [...], "perCoordinate": bool}`` -> scores. Each row
         as ``ScoringSession.score_rows`` documents (features /
-        entityIds / offset, plus an optional echoed ``uid``)."""
+        entityIds / offset, plus an optional echoed ``uid``).
+        ``request_id`` rides the pending request through the batcher and
+        appears in shed/error bodies."""
         valid, err = self.validate_score_payload(payload)
         if valid is None:
+            if request_id:
+                err = dict(err, requestId=request_id)
             return 400, err
         rows, per_coord = valid
         try:
             result = self.batcher.score(rows, per_coord,
-                                        timeout=self.request_timeout_s)
+                                        timeout=self.request_timeout_s,
+                                        request_id=request_id)
         except Exception as e:
-            return self.score_error_response(e)
+            return self.score_error_response(e, request_id=request_id)
         return 200, self.score_body(rows, per_coord, result)
 
     def handle_healthz(self) -> Tuple[int, dict]:
@@ -191,7 +207,8 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet; metrics carry the signal
         pass
 
-    def _reply(self, status: int, body, content_type="application/json"):
+    def _reply(self, status: int, body, content_type="application/json",
+               request_id=None):
         retry_after = (body.get("retryAfterS")
                        if status == 429 and isinstance(body, dict) else None)
         data = (body if isinstance(body, (bytes, str))
@@ -201,6 +218,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        if request_id is not None:
+            self.send_header("X-Request-Id", request_id)
         if retry_after is not None:
             # ceil to whole seconds: Retry-After is integral per RFC 9110
             self.send_header("Retry-After",
@@ -208,31 +227,46 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _request_id(self) -> str:
+        """Honor a client-supplied X-Request-Id (trimmed, bounded);
+        assign one otherwise — every response echoes it."""
+        rid = (self.headers.get("X-Request-Id") or "").strip()
+        return rid[:128] if rid else obs_trace.new_request_id()
+
     def do_GET(self):
+        rid = self._request_id()
         if self.path == "/healthz":
             status, body = self.service.handle_healthz()
-            self._reply(status, body)
+            self._reply(status, body, request_id=rid)
         elif self.path == "/metrics":
             status, text = self.service.handle_metrics()
-            self._reply(status, text, content_type="text/plain; version=0.0.4")
+            self._reply(status, text,
+                        content_type="text/plain; version=0.0.4",
+                        request_id=rid)
         else:
-            self._reply(404, {"error": f"unknown path {self.path}"})
+            self._reply(404, {"error": f"unknown path {self.path}"},
+                        request_id=rid)
 
     def do_POST(self):
+        rid = self._request_id()
         if self.path not in ("/score", "/admin/reload"):
-            self._reply(404, {"error": f"unknown path {self.path}"})
+            self._reply(404, {"error": f"unknown path {self.path}"},
+                        request_id=rid)
             return
         try:
             length = int(self.headers.get("Content-Length", "0"))
             payload = json.loads(self.rfile.read(length) or b"null")
         except (ValueError, json.JSONDecodeError) as e:
-            self._reply(400, {"error": f"bad JSON: {e}"})
+            self._reply(400, {"error": f"bad JSON: {e}",
+                              "requestId": rid}, request_id=rid)
             return
-        if self.path == "/admin/reload":
-            status, body = self.service.handle_reload(payload)
-        else:
-            status, body = self.service.handle_score(payload)
-        self._reply(status, body)
+        with obs_trace.request_context(request_id=rid):
+            if self.path == "/admin/reload":
+                status, body = self.service.handle_reload(payload)
+            else:
+                status, body = self.service.handle_score(
+                    payload, request_id=rid)
+        self._reply(status, body, request_id=rid)
 
 
 class ScoringServer:

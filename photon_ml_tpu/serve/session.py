@@ -91,6 +91,7 @@ from photon_ml_tpu.serve.coeff_cache import (
     LayeredCoefficientStore,
     ModelDirCoefficientStore,
 )
+from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.serve.metrics import ServingMetrics
 from photon_ml_tpu.serve.paged_table import PagedCoefficientTable
 from photon_ml_tpu.types import SparseFeatures, margins as _margins
@@ -480,9 +481,14 @@ class ScoringSession:
             if item is None:  # shutdown sentinel from close()
                 self._install_q.task_done()
                 return
-            table, entries = item
+            table, entries, tctx = item
             try:
-                table.install(entries)
+                # the enqueuer's trace context crosses the thread handoff
+                # so swap-prewarm installs land under the swap's trace
+                with obs_trace.use_context(tctx), \
+                        obs_trace.span("paged.install_async", cat="serve",
+                                       entries=len(entries)):
+                    table.install(entries)
             except Exception:  # a bad install must not kill the worker
                 pass
             finally:
@@ -498,7 +504,8 @@ class ScoringSession:
         if not entries:
             return
         try:
-            self._install_q.put_nowait((table, entries))
+            self._install_q.put_nowait(
+                (table, entries, obs_trace.current_context()))
         except _queue.Full:
             self._install_drops += 1
 
@@ -738,7 +745,8 @@ class ScoringSession:
                 f"batch of {n} rows exceeds max_batch={self.max_batch}; "
                 "split it (the micro-batcher never sends oversized "
                 "batches)")
-        host = self._resolve_all(rows, st)
+        with obs_trace.span("session.resolve", cat="serve", rows=n):
+            host = self._resolve_all(rows, st)
         offsets = np.asarray(
             [float(r.get("offset") or 0.0) for r in rows],
             np.dtype(self.dtype))
@@ -807,10 +815,13 @@ class ScoringSession:
             missing = [m for m in missing if m != _NO_ENTITY]
             if missing:
                 self.metrics.record_paged(faults=len(missing))
-                entries = st.coeff_caches[name].get_many(missing)
-                table.install(entries)
-                # re-read: fresh buffer + the installed entities' slots
-                buf, slots, still = table.lookup(ids)
+                with obs_trace.span("paged.fault_install", cat="serve",
+                                    coordinate=name,
+                                    entities=len(missing)):
+                    entries = st.coeff_caches[name].get_many(missing)
+                    table.install(entries)
+                    # re-read: fresh buffer + installed entities' slots
+                    buf, slots, still = table.lookup(ids)
                 still = set(still) - {_NO_ENTITY}
                 if still:
                     # batch entities exceed the table: host math for the
@@ -848,10 +859,12 @@ class ScoringSession:
         # production QPS those six dispatches were measurable)
         transfer_budget.charge(upload_bytes, "serve.fused_batch")
         run = self._fused_executable(B, st)
-        total_d, parts_d = run(
-            off, tuple(shard_idx), tuple(shard_val), fixed_w,
-            tuple(re_bufs), tuple(re_slots))
-        total = np.asarray(total_d)[:n]
+        with obs_trace.span("session.device_compute", cat="serve",
+                            rows=n, bucket=B):
+            total_d, parts_d = run(
+                off, tuple(shard_idx), tuple(shard_val), fixed_w,
+                tuple(re_bufs), tuple(re_slots))
+            total = np.asarray(total_d)[:n]
         if extras:
             total = total.copy()
             for _pos, extra in extras:

@@ -7,6 +7,9 @@
 #  10   a registered fault-injection site has no tier-1 test arming it
 #  11   a concurrency finding (PT4xx): lock discipline / thread leak /
 #       hang hazard in the threaded serving+streaming stack
+#  12   the photon-trace smoke failed: the tracer, the simulated
+#       multi-process harness, or the rank-merge/validate pipeline
+#       (obs/trace_cli.py smoke) regressed
 cd "$(dirname "$0")/.."
 set -o pipefail
 
@@ -24,6 +27,21 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
     --passes concurrency --baseline photon-check-baseline.json
 rc=$?
 [ "$rc" -eq 1 ] && exit 11
+
+# The observability package gets its own concurrency leg: the tracer's
+# export thread and the slow-request log are exactly the kind of
+# lock+thread code PT401-PT405 police, and a finding there must not
+# hide behind the package-wide baseline. Same rc contract as above.
+echo "== photon-check concurrency over obs/ =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
+    --passes concurrency --baseline photon-check-baseline.json \
+    photon_ml_tpu/obs
+rc=$?
+[ "$rc" -eq 1 ] && exit 11
+
+echo "== photon-trace smoke (2-rank record -> merge -> validate) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.obs.trace_cli smoke \
+    || exit 12
 
 echo "== photon-check lock graph (PT402's model, for the CI artifact) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli --lock-graph

@@ -305,7 +305,7 @@ def test_history_timing_split_and_logging(game_ds, caplog):
                 "score_delta"} <= set(r)
         assert r["seconds"] >= r["solve_seconds"] >= 0
         assert r["eval_seconds"] >= 0
-    assert any("[CD]" in rec.message for rec in caplog.records)
+    assert any("cd.step" in rec.message for rec in caplog.records)
 
 
 def test_tolerance_schedule():

@@ -1,0 +1,92 @@
+"""obs/logging.py: the context filter's rank/trace/request stamps, the
+idempotent driver-side configure, and the slow-request exemplar log."""
+
+import logging
+
+import pytest
+
+from photon_ml_tpu.obs import trace
+from photon_ml_tpu.obs.logging import (
+    DEFAULT_FORMAT,
+    ContextFilter,
+    SlowRequestLog,
+    configure,
+)
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off():
+    trace.stop()
+    yield
+    trace.stop()
+
+
+def _record(msg="m"):
+    return logging.LogRecord("photon_ml_tpu.test", logging.INFO,
+                             __file__, 1, msg, (), None)
+
+
+class TestContextFilter:
+    def test_untraced_record_gets_dash_stamps(self):
+        rec = _record()
+        assert ContextFilter().filter(rec) is True
+        assert rec.rank == 0
+        assert rec.trace_id == "-"
+        assert rec.request_id == "-"
+
+    def test_traced_record_carries_ambient_ids(self, tmp_path):
+        trace.start(str(tmp_path), export_thread=False)
+        with trace.request_context(request_id="req-log-1"):
+            rec = _record()
+            ContextFilter().filter(rec)
+            assert rec.request_id == "req-log-1"
+            assert rec.trace_id == trace.current_context().trace_id
+
+    def test_default_format_renders_stamped_record(self):
+        rec = _record("hello")
+        ContextFilter().filter(rec)
+        line = logging.Formatter(DEFAULT_FORMAT).format(rec)
+        assert "rank=0" in line
+        assert "trace=- req=-" in line
+        assert line.endswith("photon_ml_tpu.test: hello")
+
+
+class TestConfigure:
+    def test_idempotent_single_handler(self):
+        name = "photon_ml_tpu_test_cfg"
+        logger = configure(logger_name=name)
+        again = configure(logger_name=name)
+        assert again is logger
+        ours = [h for h in logger.handlers
+                if getattr(h, "_photon_obs_handler", False)]
+        assert len(ours) == 1
+        filters = [f for f in logger.filters
+                   if isinstance(f, ContextFilter)]
+        assert len(filters) == 1
+        for h in ours:
+            logger.removeHandler(h)
+
+
+class TestSlowRequestLog:
+    def test_top_n_kept_worst_first(self):
+        srl = SlowRequestLog(top_n=3)
+        for i, lat in enumerate([5.0, 50.0, 1.0, 20.0, 9.0]):
+            srl.note(f"r{i}", lat, rows=i)
+        snap = srl.snapshot()
+        assert [e["request_id"] for e in snap] == ["r1", "r3", "r4"]
+        assert [e["latency_ms"] for e in snap] == [50.0, 20.0, 9.0]
+
+    def test_entrants_logged_with_breakdown(self, caplog):
+        srl = SlowRequestLog(top_n=1,
+                             logger=logging.getLogger("photon_test_srl"))
+        with caplog.at_level(logging.INFO, logger="photon_test_srl"):
+            srl.note("slow-1", 100.0, queue_wait_ms=70.0, compute_ms=30.0)
+            srl.note("fast-1", 1.0)  # below the bar: not logged
+        assert len(caplog.records) == 1
+        msg = caplog.records[0].getMessage()
+        assert "slow-1" in msg and "queue_wait_ms" in msg
+
+    def test_none_request_id_becomes_dash(self):
+        srl = SlowRequestLog(top_n=2)
+        srl.note(None, 3.0)
+        assert srl.snapshot()[0]["request_id"] == "-"

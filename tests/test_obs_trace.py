@@ -1,0 +1,199 @@
+"""obs/trace.py: span recording, context propagation across thread
+handoffs, the off switch, sampling, and crash-safe export.
+
+Every test that installs a tracer uses ``export_thread=False`` and
+flushes explicitly — the tests own their files, and the thread-leak
+sanitizer stays quiet.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from photon_ml_tpu.obs import trace
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off():
+    """Every test starts and ends with the tracer uninstalled."""
+    trace.stop()
+    yield
+    trace.stop()
+
+
+class TestOffSwitch:
+    def test_disabled_span_is_shared_null_instance(self):
+        assert not trace.enabled()
+        s1 = trace.span("a", cat="app")
+        s2 = trace.span("b", cat="app", rows=9)
+        assert s1 is s2 is trace._NULL_SPAN
+
+    def test_null_span_nests_and_reenters(self):
+        with trace.span("outer") as o:
+            with trace.span("inner") as i:
+                assert o is i
+                assert i.set(rows=1) is i  # .set parity with _Span
+
+    def test_stop_without_start_is_noop(self):
+        trace.stop()
+        trace.stop()
+
+    def test_instant_disabled_is_noop(self):
+        trace.instant("marker", cat="app", hits=1)
+
+    def test_request_context_disabled_installs_nothing(self):
+        with trace.request_context(request_id="r1"):
+            assert trace.current_context() is None
+            assert trace.current_request_id() is None
+
+
+class TestRecording:
+    def test_nested_spans_share_trace_id(self, tmp_path):
+        t = trace.start(str(tmp_path), export_thread=False)
+        with trace.span("outer", cat="train"):
+            with trace.span("inner", cat="train"):
+                pass
+        evs = list(t._events)
+        assert [e["name"] for e in evs] == ["inner", "outer"]
+        tids = {e["args"]["trace_id"] for e in evs}
+        assert len(tids) == 1  # one root context covers both
+
+    def test_sibling_roots_get_distinct_trace_ids(self, tmp_path):
+        t = trace.start(str(tmp_path), export_thread=False)
+        with trace.span("a"):
+            pass
+        with trace.span("b"):
+            pass
+        evs = list(t._events)
+        assert evs[0]["args"]["trace_id"] != evs[1]["args"]["trace_id"]
+
+    def test_exception_recorded_and_propagated(self, tmp_path):
+        t = trace.start(str(tmp_path), export_thread=False)
+        with pytest.raises(ValueError):
+            with trace.span("boom", cat="serve"):
+                raise ValueError("x")
+        (ev,) = list(t._events)
+        assert ev["args"]["error"] == "ValueError"
+
+    def test_set_attaches_args_mid_span(self, tmp_path):
+        t = trace.start(str(tmp_path), export_thread=False)
+        with trace.span("batch", cat="serve") as s:
+            s.set(rows=64)
+        (ev,) = list(t._events)
+        assert ev["args"]["rows"] == 64
+
+    def test_ring_bound_counts_drops(self, tmp_path):
+        t = trace.start(str(tmp_path), ring_size=4, export_thread=False)
+        for i in range(10):
+            with trace.span(f"s{i}"):
+                pass
+        assert len(t._events) == 4
+        assert t._dropped == 6
+
+
+class TestThreadHandoff:
+    def test_captured_context_carries_request_id_across_threads(
+            self, tmp_path):
+        t = trace.start(str(tmp_path), export_thread=False)
+        with trace.request_context(request_id="req-1"):
+            ctx = trace.current_context()
+
+            def worker():
+                # the receiving side of every photon thread handoff
+                with trace.use_context(ctx):
+                    with trace.span("worker.step", cat="serve"):
+                        pass
+
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        (ev,) = list(t._events)
+        assert ev["args"]["request_id"] == "req-1"
+        assert ev["args"]["trace_id"] == ctx.trace_id
+
+    def test_use_context_none_is_transparent(self, tmp_path):
+        trace.start(str(tmp_path), export_thread=False)
+        with trace.request_context(request_id="req-2"):
+            with trace.use_context(None):
+                assert trace.current_request_id() == "req-2"
+
+    def test_context_does_not_leak_to_unrelated_thread(self, tmp_path):
+        trace.start(str(tmp_path), export_thread=False)
+        seen = []
+        with trace.request_context(request_id="req-3"):
+            th = threading.Thread(
+                target=lambda: seen.append(trace.current_context()))
+            th.start()
+            th.join()
+        assert seen == [None]
+
+
+class TestSampling:
+    def test_sampled_out_trace_records_nothing(self, tmp_path):
+        t = trace.start(str(tmp_path), sample=0.0, export_thread=False)
+        with trace.request_context(request_id="req-s"):
+            # nested spans under a sampled-out root are the null span:
+            # same cost as tracing-off
+            assert trace.span("inner") is trace._NULL_SPAN
+            with trace.span("also.skipped"):
+                pass
+            trace.instant("skipped.marker")
+        assert list(t._events) == []
+
+    def test_sample_one_always_records(self, tmp_path):
+        t = trace.start(str(tmp_path), sample=1.0, export_thread=False)
+        with trace.request_context(request_id="req-a"):
+            with trace.span("kept"):
+                pass
+        assert len(t._events) == 1
+
+
+class TestExport:
+    def test_flush_writes_complete_per_rank_file(self, tmp_path):
+        trace.start(str(tmp_path), export_thread=False)
+        with trace.span("fit", cat="train", rows=10):
+            pass
+        trace.stop()  # final flush
+        path = os.path.join(str(tmp_path), "trace-rank0.json")
+        with open(path) as f:
+            doc = json.load(f)
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert [e["name"] for e in spans] == ["fit"]
+        assert doc["metadata"]["rank"] == 0
+        # metadata events name the process and each thread
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {"process_name", "thread_name"} <= {e["name"] for e in metas}
+
+    def test_flush_leaves_no_temp_files(self, tmp_path):
+        trace.start(str(tmp_path), export_thread=False)
+        with trace.span("s"):
+            pass
+        trace.stop()
+        leftovers = [f for f in os.listdir(str(tmp_path)) if ".tmp-" in f]
+        assert leftovers == []
+
+    def test_restart_replaces_previous_tracer(self, tmp_path):
+        t1 = trace.start(str(tmp_path / "a"), export_thread=False)
+        t2 = trace.start(str(tmp_path / "b"), export_thread=False)
+        assert trace.active_tracer() is t2
+        assert t1 is not t2
+
+
+class TestEnvStart:
+    def test_env_off_values(self, monkeypatch):
+        for v in ("", "0", "false", "off", "no"):
+            monkeypatch.setenv("PHOTON_TRACE", v)
+            assert trace.maybe_start_from_env() is None
+
+    def test_env_path_value(self, monkeypatch, tmp_path):
+        d = str(tmp_path / "tr")
+        monkeypatch.setenv("PHOTON_TRACE", d)
+        monkeypatch.setenv("PHOTON_TRACE_SAMPLE", "0.5")
+        t = trace.maybe_start_from_env()
+        try:
+            assert t is not None and t.trace_dir == d
+            assert t.sample == 0.5
+        finally:
+            trace.stop()

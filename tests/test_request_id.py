@@ -1,0 +1,269 @@
+"""Request-id propagation end to end, over real sockets: the front
+door and both server flavors honor a client ``X-Request-Id`` (or assign
+one), echo it on EVERY response including 400/404/429/503 bodies, and —
+with tracing on — one request's spans line up under that id across
+front-door proxy, replica HTTP handling, batcher execution, and
+device compute."""
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from photon_ml_tpu.obs import trace
+from tests.conftest import serving_rows
+
+
+async def _http(host, port, method, path, payload=None, headers=None):
+    """Minimal HTTP/1.1 client returning (status, headers, body_json)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    body = b"" if payload is None else json.dumps(payload).encode()
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    writer.write(
+        (f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+         f"Content-Type: application/json\r\n{extra}"
+         f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+    await writer.drain()
+    head = await reader.readuntil(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    hdrs = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, _, v = line.partition(":")
+            hdrs[k.strip().lower()] = v.strip()
+    length = int(hdrs.get("content-length", "0"))
+    raw = await reader.readexactly(length) if length else b""
+    try:
+        parsed = json.loads(raw) if raw else None
+    except json.JSONDecodeError:
+        parsed = raw.decode()
+    writer.close()
+    return status, hdrs, parsed
+
+
+def _service(saved_game_model, **batcher_kw):
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    model_dir, bundle = saved_game_model
+    session = ScoringSession(model_dir, dtype="float64", max_batch=16,
+                             coeff_cache_entries=32)
+    batcher_kw.setdefault("max_batch", 16)
+    batcher_kw.setdefault("max_delay_ms", 2.0)
+    batcher = MicroBatcher(session.score_rows, metrics=session.metrics,
+                           **batcher_kw)
+    return ScoringService(session, batcher), bundle
+
+
+class TestAsyncServer:
+    def test_echo_and_assignment_on_every_path(self, saved_game_model):
+        from photon_ml_tpu.serve import AsyncScoringServer
+
+        service, bundle = _service(saved_game_model)
+        rows = serving_rows(bundle, [0, 1])
+        rid = {"X-Request-Id": "client-rid-1"}
+
+        async def run():
+            server = await AsyncScoringServer(service).start()
+            h, p = server.host, server.port
+            out = {
+                "score": await _http(h, p, "POST", "/score",
+                                     {"rows": rows}, headers=rid),
+                "assigned": await _http(h, p, "POST", "/score",
+                                        {"rows": rows}),
+                "health": await _http(h, p, "GET", "/healthz",
+                                      headers=rid),
+                "notfound": await _http(h, p, "GET", "/nope",
+                                        headers=rid),
+                "bad": await _http(h, p, "POST", "/score", {"rows": []},
+                                   headers=rid),
+            }
+            await server.aclose()
+            return out
+
+        out = asyncio.run(run())
+        for name in ("score", "health", "notfound", "bad"):
+            assert out[name][1]["x-request-id"] == "client-rid-1", name
+        # no client id -> the server assigns one and still echoes it
+        assigned = out["assigned"][1]["x-request-id"]
+        assert assigned and assigned != "client-rid-1"
+        # the 400 body names the request so client logs can correlate
+        assert out["bad"][0] == 400
+        assert out["bad"][2]["requestId"] == "client-rid-1"
+
+    def test_shed_429_body_carries_request_id(self, saved_game_model):
+        from photon_ml_tpu.serve import AsyncScoringServer
+
+        service, bundle = _service(saved_game_model, max_queue=2,
+                                   max_delay_ms=20.0)
+        rows = serving_rows(bundle, [0])
+
+        async def run():
+            server = await AsyncScoringServer(service).start()
+            h, p = server.host, server.port
+            results = await asyncio.gather(
+                *[_http(h, p, "POST", "/score", {"rows": rows},
+                        headers={"X-Request-Id": f"burst-{i}"})
+                  for i in range(30)])
+            await server.aclose()
+            return results
+
+        results = asyncio.run(run())
+        shed = [r for r in results if r[0] == 429]
+        assert shed, "burst over a 2-deep queue must shed"
+        for _s, headers, body in shed:
+            assert headers["x-request-id"].startswith("burst-")
+            assert body["requestId"] == headers["x-request-id"]
+            assert "retry-after" in headers
+
+
+class TestFrontDoor:
+    def test_proxy_echo_and_503_body(self, saved_game_model):
+        from photon_ml_tpu.serve import AsyncFrontDoor, AsyncScoringServer
+
+        service, bundle = _service(saved_game_model)
+        rows = serving_rows(bundle, [0, 1])
+        rid = {"X-Request-Id": "door-rid-9"}
+
+        async def run():
+            backend = await AsyncScoringServer(service).start()
+            door = await AsyncFrontDoor(
+                [f"127.0.0.1:{backend.port}"],
+                retry_backend_s=0.05).start()
+            ok = await _http(door.host, door.port, "POST", "/score",
+                             {"rows": rows}, headers=rid)
+            await backend.aclose()
+            dead = await _http(door.host, door.port, "POST", "/score",
+                               {"rows": rows}, headers=rid)
+            await door.aclose()
+            return ok, dead
+
+        ok, dead = asyncio.run(run())
+        # echoed back THROUGH the proxy: the replica saw the same id
+        assert ok[0] == 200
+        assert ok[1]["x-request-id"] == "door-rid-9"
+        assert dead[0] == 503
+        assert dead[1]["x-request-id"] == "door-rid-9"
+        assert dead[2]["requestId"] == "door-rid-9"
+
+    def test_fd_metrics_merges_replica_scrapes(self, saved_game_model):
+        from photon_ml_tpu.serve import AsyncFrontDoor, AsyncScoringServer
+
+        service_a, bundle = _service(saved_game_model)
+        service_b, _ = _service(saved_game_model)
+        rows = serving_rows(bundle, [0, 1, 2])
+
+        async def run():
+            a = await AsyncScoringServer(service_a).start()
+            b = await AsyncScoringServer(service_b).start()
+            door = await AsyncFrontDoor(
+                [f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"]).start()
+            for _ in range(8):
+                await _http(door.host, door.port, "POST", "/score",
+                            {"rows": rows})
+            got = await _http(door.host, door.port, "GET", "/fd/metrics")
+            await door.aclose()
+            await a.aclose()
+            await b.aclose()
+            return got, a.port, b.port
+
+        (status, headers, text), pa, pb = asyncio.run(run())
+        assert status == 200
+        assert headers["content-type"].startswith("text/plain")
+        # every replica's series appear, disambiguated by the label
+        assert f'replica="127.0.0.1:{pa}"' in text
+        assert f'replica="127.0.0.1:{pb}"' in text
+        assert 'photon_serve_requests_total{replica=' in text
+        # the door's own counters ride along
+        assert "photon_fd_proxied_total 8" in text
+        for port in (pa, pb):
+            assert (f'photon_fd_backend_picked_total{{'
+                    f'backend="127.0.0.1:{port}"}}') in text
+        # TYPE/HELP lines are deduped across replicas
+        assert text.count("# TYPE photon_serve_requests_total") == 1
+
+
+class TestThreadedServer:
+    def test_request_id_parity_with_async_flavor(self, saved_game_model):
+        """The blocking server honors the same header contract."""
+        from photon_ml_tpu.serve import ScoringServer
+
+        svc, bundle = _service(saved_game_model)
+        server = ScoringServer(svc, port=0).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            req = urllib.request.Request(
+                url + "/score",
+                data=json.dumps(
+                    {"rows": serving_rows(bundle, [0])}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "thr-1"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+                assert r.headers["X-Request-Id"] == "thr-1"
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=30) as r:
+                assert r.headers["X-Request-Id"]  # assigned
+            bad = urllib.request.Request(
+                url + "/score", data=b'{"rows": []}',
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "thr-2"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=30)
+            assert ei.value.code == 400
+            assert ei.value.headers["X-Request-Id"] == "thr-2"
+            assert json.loads(ei.value.read())["requestId"] == "thr-2"
+        finally:
+            server.close()
+
+
+class TestTraceCorrelation:
+    def test_one_request_spans_share_id_across_the_stack(
+            self, saved_game_model, tmp_path):
+        """The acceptance path: front-door proxy -> replica http.score
+        -> batch.execute -> session device compute, all in one process
+        here, every span stamped with the client's request id."""
+        from photon_ml_tpu.serve import AsyncFrontDoor, AsyncScoringServer
+
+        service, bundle = _service(saved_game_model)
+        rows = serving_rows(bundle, [0, 1])
+        tracer = trace.start(str(tmp_path), sample=1.0,
+                             export_thread=False)
+        try:
+            async def run():
+                backend = await AsyncScoringServer(service).start()
+                door = await AsyncFrontDoor(
+                    [f"127.0.0.1:{backend.port}"]).start()
+                got = await _http(door.host, door.port, "POST", "/score",
+                                  {"rows": rows},
+                                  headers={"X-Request-Id": "trace-me"})
+                await door.aclose()
+                await backend.aclose()
+                return got
+
+            status, headers, _ = asyncio.run(run())
+            assert status == 200
+            assert headers["x-request-id"] == "trace-me"
+            events = list(tracer._events)
+        finally:
+            trace.stop()
+
+        mine = [e for e in events
+                if e["args"].get("request_id") == "trace-me"
+                or "trace-me" in (e["args"].get("request_ids") or [])]
+        names = {e["name"] for e in mine}
+        assert {"fd.proxy", "http.score", "batch.execute",
+                "session.device_compute"} <= names, names
+        # cross-process correlation is by request id; WITHIN the
+        # replica, one trace id covers http handling through device
+        # compute (the door, a separate logical process, roots its own)
+        replica = {e["args"]["trace_id"] for e in mine
+                   if e["name"] in ("http.score", "batch.execute",
+                                    "session.device_compute")}
+        assert len(replica) == 1

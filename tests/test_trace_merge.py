@@ -1,0 +1,168 @@
+"""photon-trace merge: clock alignment on collective sites, schema
+validation, and the end-to-end 4-rank path through the real tracer and
+the real entity-sharded exchange (simulated multi-process harness).
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.obs import trace
+from photon_ml_tpu.obs.trace_cli import merge_traces, validate_trace
+from photon_ml_tpu.testing import run_simulated_processes
+
+
+def _span(name, ts, dur, pid, cat="app", **args):
+    return {"name": name, "cat": cat, "ph": "X", "ts": ts, "dur": dur,
+            "pid": pid, "tid": 1, "args": args}
+
+
+def _doc(rank, events):
+    return {"traceEvents": events, "metadata": {"rank": rank}}
+
+
+def _write(tmp_path, rank, events):
+    p = os.path.join(str(tmp_path), f"trace-rank{rank}.json")
+    with open(p, "w") as f:
+        json.dump(_doc(rank, events), f)
+    return p
+
+
+class TestAlignment:
+    def test_known_clock_offset_is_recovered(self, tmp_path):
+        # rank 1's perf_counter origin is 500µs behind rank 0: its copy
+        # of every rendezvous END reads 500 lower. The merge must shift
+        # rank 1 by +500.
+        r0 = [
+            _span("exchange", 100.0, 50.0, 0, cat="collective", site="x:0"),
+            _span("exchange", 300.0, 50.0, 0, cat="collective", site="x:1"),
+        ]
+        r1 = [
+            _span("exchange", -400.0, 50.0, 1, cat="collective", site="x:0"),
+            _span("exchange", -200.0, 50.0, 1, cat="collective", site="x:1"),
+        ]
+        p0 = _write(tmp_path, 0, r0)
+        p1 = _write(tmp_path, 1, r1)
+        merged = merge_traces([p0, p1])
+        assert merged["metadata"]["clock_shifts_us"] == {"0": 0.0,
+                                                         "1": 500.0}
+        ends = {e["pid"]: e["ts"] + e["dur"]
+                for e in merged["traceEvents"]
+                if e.get("args", {}).get("site") == "x:0"}
+        assert ends[0] == pytest.approx(ends[1])
+
+    def test_median_shift_is_robust_to_a_straggler_occurrence(
+            self, tmp_path):
+        # one late entry (rank 1 blocked 1000µs extra on site x:1) must
+        # not drag the whole shift: median over 3 matched ends ignores it
+        r0 = [_span("c", 100.0 * k, 10.0, 0, cat="collective",
+                    site=f"x:{k}") for k in range(3)]
+        r1 = [_span("c", 100.0 * k - 700.0, 10.0, 1, cat="collective",
+                    site=f"x:{k}") for k in range(3)]
+        r1[1]["ts"] -= 1000.0  # straggler: this end reads 1000 lower
+        p0 = _write(tmp_path, 0, r0)
+        p1 = _write(tmp_path, 1, r1)
+        merged = merge_traces([p0, p1])
+        assert merged["metadata"]["clock_shifts_us"]["1"] == 700.0
+
+    def test_repeated_site_matches_by_occurrence_index(self, tmp_path):
+        # the SAME site label twice (a loop over sweeps): k-th matches
+        # k-th, so the two occurrences contribute two deltas, not one
+        r0 = [_span("c", 100.0, 10.0, 0, cat="collective", site="loop"),
+              _span("c", 200.0, 10.0, 0, cat="collective", site="loop")]
+        r1 = [_span("c", 60.0, 10.0, 1, cat="collective", site="loop"),
+              _span("c", 160.0, 10.0, 1, cat="collective", site="loop")]
+        merged = merge_traces([_write(tmp_path, 0, r0),
+                               _write(tmp_path, 1, r1)])
+        assert merged["metadata"]["clock_shifts_us"]["1"] == 40.0
+
+    def test_rank_without_collectives_merges_unshifted_with_warning(
+            self, tmp_path):
+        r0 = [_span("c", 100.0, 10.0, 0, cat="collective", site="x:0")]
+        r1 = [_span("local", 50.0, 10.0, 1, cat="train")]
+        merged = merge_traces([_write(tmp_path, 0, r0),
+                               _write(tmp_path, 1, r1)])
+        assert merged["metadata"]["unaligned_ranks"] == [1]
+        local = [e for e in merged["traceEvents"] if e["name"] == "local"]
+        assert local[0]["ts"] == 50.0
+
+    def test_empty_input_raises(self):
+        with pytest.raises(ValueError):
+            merge_traces([])
+
+
+class TestValidate:
+    def test_valid_doc_passes(self, tmp_path):
+        assert validate_trace(_doc(0, [_span("a", 1.0, 2.0, 0)])) == []
+
+    def test_missing_fields_reported(self):
+        doc = {"traceEvents": [{"name": "a", "ph": "X", "ts": 1.0}]}
+        problems = validate_trace(doc)
+        assert any("pid" in p for p in problems)
+        assert any("dur" in p for p in problems)
+
+    def test_empty_events_reported(self):
+        assert validate_trace({"traceEvents": []}) == [
+            "traceEvents missing or empty"]
+
+    def test_metadata_only_doc_reported(self):
+        doc = {"traceEvents": [{"name": "process_name", "ph": "M",
+                                "pid": 0, "tid": 0}]}
+        assert "no complete ('X') span events" in validate_trace(doc)
+
+
+def _rank_fn(rank: int):
+    from photon_ml_tpu.parallel.entity_shard import exchange_score_updates
+
+    with trace.span("fit", cat="train", rank=rank):
+        for sweep in range(2):
+            rows = np.asarray([rank * 2, rank * 2 + 1], np.int64)
+            vals = np.asarray([float(rank), 1.0], np.float64)
+            exchange_score_updates((rows, vals), tag=f"sweep:{sweep}")
+
+
+class TestEndToEnd:
+    def test_four_rank_exchange_traces_merge_and_align(self, tmp_path):
+        """The acceptance path: 4 simulated ranks run the real sharded
+        exchange under the real tracer; per-rank files merge into one
+        schema-valid timeline whose collective spans overlap per site."""
+        trace.start(str(tmp_path), export_thread=False)
+        try:
+            outcomes = run_simulated_processes(4, _rank_fn)
+        finally:
+            trace.stop()
+        bad = [o for o in outcomes if isinstance(o, BaseException)]
+        assert not bad, bad
+
+        paths = sorted(glob.glob(
+            os.path.join(str(tmp_path), "trace-rank*.json")))
+        assert len(paths) == 4
+        merged = merge_traces(paths)
+        assert validate_trace(merged) == []
+        assert merged["metadata"]["ranks"] == [0, 1, 2, 3]
+        assert merged["metadata"]["unaligned_ranks"] == []
+
+        # per collective site: all 4 ranks present, intervals overlap
+        # pairwise (they leave the rendezvous together). Tolerance: the
+        # simulated ranks already share one clock, so the aligner's
+        # per-rank shift is pure scheduler wake jitter (median of
+        # end_0 - end_N) — µs-scale barrier spans can miss strict
+        # overlap by that jitter. 10ms still catches real misalignment.
+        jitter_us = 10_000.0
+        by_site = {}
+        for e in merged["traceEvents"]:
+            if e.get("cat") != "collective":
+                continue
+            site = (e.get("args") or {}).get("site")
+            if site:
+                by_site.setdefault(site, []).append(e)
+        assert by_site, "exchange produced no collective spans"
+        for site, evs in by_site.items():
+            assert {e["pid"] for e in evs} == {0, 1, 2, 3}, site
+            latest_start = max(e["ts"] for e in evs)
+            earliest_end = min(e["ts"] + e["dur"] for e in evs)
+            assert latest_start <= earliest_end + jitter_us, (
+                f"site {site}: rank intervals do not overlap")
