@@ -56,8 +56,18 @@ def _environment() -> dict:
             trace_pct = json.load(f).get("trace_off_overhead_pct_max")
     except Exception:
         pass
+    # whether the active runtime supports surviving-subset continuation
+    # after a rank loss (parallel/recovery.py): True single-process and on
+    # the sim transport, False on transports without in-job reform
+    try:
+        from photon_ml_tpu.parallel.recovery import recovery_supported
+
+        rec_sup = bool(recovery_supported())
+    except Exception:
+        rec_sup = None
     return {
         "cpu_cores": os.cpu_count() or 1,
+        "recovery_supported": rec_sup,
         "jax_version": jax.__version__,
         "platform": devs[0].platform,
         "device_kind": getattr(devs[0], "device_kind", ""),
@@ -1413,6 +1423,235 @@ def shard_main() -> None:
         sys.exit(8)
 
 
+def recovery_main() -> None:
+    """``python bench.py recovery`` — time-to-recover for in-job elastic
+    recovery vs the cold-restart comparator.
+
+    One synthetic mixed-effect dataset (EQUAL rows per entity, fully
+    dense RE features — the same bit-compatible shape discipline as the
+    shard bench), 4-process entity-sharded runs on the simulated
+    multi-controller runtime:
+
+    * warm-up runs compile BOTH shard ladders (the 4-shard layout and
+      the 3-shard survivor layout) so neither timed arm pays compiles —
+      the same warm-vs-warm discipline as every other mode here;
+    * a timed CLEAN 4-process run — the reference f64 coefficients and
+      the cold-restart comparator (a restart re-pays at least this);
+    * a clean run with per-sweep :class:`RecoveryManager` snapshots —
+      prices the steady-state snapshot overhead;
+    * the CRASHED run: ``fault_injection.crash_schedule`` drop-kills one
+      rank mid-sweep; the three survivors classify the failure, reform
+      onto a 3-shard owner map, redistribute the dead rank's entities
+      from the last committed snapshot, and finish in-job. Stats
+      ``recovery_seconds`` (failure detection -> recovered force-commit)
+      is the time-to-recover number.
+
+    Acceptance (exit 10, distinct from stream/cd/serving/shard/trace's
+    5/6/7/8/9): every survivor's f64 coefficients bit-equal to the clean
+    run's, at least one recovery recorded, and max survivor
+    time-to-recover <= 0.5x the clean-run wall-clock.
+
+    Writes ``BENCH_recovery.json`` and prints the same JSON. Sized by
+    ``BENCH_RECOVERY_ENTITIES`` (default 256) and
+    ``BENCH_RECOVERY_SWEEPS`` (default 10)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PHOTON_ML_TPU_BARRIER_TIMEOUT_S", "120")
+    import shutil
+    import tempfile
+
+    import jax
+
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
+    jax.config.update("jax_enable_x64", True)  # the bit-parity gate is f64
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        make_game_dataset,
+    )
+    from photon_ml_tpu.parallel import fault_injection
+    from photon_ml_tpu.parallel.entity_shard import EntityShardSpec
+    from photon_ml_tpu.parallel.recovery import RecoveryManager
+    from photon_ml_tpu.testing import Dropped, run_simulated_processes
+
+    rng = np.random.default_rng(0)
+    n_entities = int(os.environ.get("BENCH_RECOVERY_ENTITIES", 256))
+    n_sweeps = int(os.environ.get("BENCH_RECOVERY_SWEEPS", 10))
+    procs, victim = 4, 2
+    rows_per_entity, d_g, d_u = 4, 8, 32
+    w_fixed = rng.normal(size=d_g)
+    U = rng.normal(size=(n_entities, d_u)) * 1.2
+    Xg, Xu, y, uid = [], [], [], []
+    for u in range(n_entities):
+        xg = rng.normal(size=(rows_per_entity, d_g))
+        xu = rng.normal(size=(rows_per_entity, d_u))
+        marg = xg @ w_fixed + xu @ U[u]
+        y.append((rng.random(rows_per_entity)
+                  < 1 / (1 + np.exp(-marg))).astype(float))
+        Xg.append(xg)
+        Xu.append(xu)
+        uid.append(np.full(rows_per_entity, u))
+    Xg, Xu, y, uid = map(np.concatenate, (Xg, Xu, y, uid))
+    ds = make_game_dataset({"g": Xg, "u": Xu}, y, entity_ids={"userId": uid})
+
+    def coord_configs():
+        # lbfgs RE solver: bit-invariant to entity-batch width, so the
+        # survivor layout's re-bucketed solves stay on the reference
+        # trajectory (same reasoning as the shard bench)
+        return [
+            CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                             reg_weight=2.0, tolerance=1e-12),
+            CoordinateConfig("per-user", coordinate_type="random",
+                             feature_shard="u", entity_column="userId",
+                             reg_type="l2", reg_weight=2.0, tolerance=1e-11,
+                             optimizer="lbfgs", active_set=True,
+                             refresh_every=6, active_tol=1e-10),
+        ]
+
+    def coeff_map(model):
+        out = {}
+        for b in model.coordinates["per-user"].buckets:
+            proj = np.asarray(b.projection)
+            C = np.asarray(b.coefficients)
+            for r, eid in enumerate(b.entity_ids):
+                valid = proj[r] >= 0
+                w = np.zeros(d_u)
+                w[proj[r][valid]] = C[r][valid]
+                out[str(eid)] = w
+        return out
+
+    snap_root = tempfile.mkdtemp(prefix="bench-recovery-")
+
+    def run_ranks(n_procs, recovery_dir=None, kill_occurrence=None):
+        def fn(rank):
+            rec = None
+            if recovery_dir is not None:
+                rec = RecoveryManager(recovery_dir, max_rank_failures=1,
+                                      snapshot_every=1, backoff_s=0.01,
+                                      jitter=0.0)
+            cd = CoordinateDescent(
+                coord_configs(), task="logistic", n_iterations=n_sweeps,
+                dtype=jnp.float64,
+                entity_shard=EntityShardSpec(n_procs, rank), recovery=rec)
+            model, history = cd.run(ds)
+            # scalar fetch: the run has actually completed
+            float(np.asarray(
+                model.coordinates["fixed"].model.coefficients.means)[0])
+            return {"model": model,
+                    "recovery": rec.as_dict() if rec is not None else None}
+        if kill_occurrence is not None:
+            fault_injection.install(fault_injection.crash_schedule(
+                (victim, "cd.step", kill_occurrence)))
+        t0 = time.perf_counter()
+        try:
+            outs = run_simulated_processes(n_procs, fn, join_timeout=1800)
+        finally:
+            if kill_occurrence is not None:
+                fault_injection.clear()
+        return outs, time.perf_counter() - t0
+
+    try:
+        # warm BOTH ladders: the 4-shard layout and the survivor 3-shard
+        # layout the crashed run reforms onto
+        run_ranks(procs)
+        run_ranks(procs - 1)
+
+        outs, wall_clean = run_ranks(procs)
+        for o in outs:
+            assert isinstance(o, dict), f"clean run failed: {o!r}"
+        ref_coeffs = coeff_map(outs[0]["model"])
+        ref_fixed = np.asarray(outs[0]["model"].coordinates["fixed"]
+                               .model.coefficients.means)
+
+        outs, wall_snap = run_ranks(
+            procs, recovery_dir=os.path.join(snap_root, "clean"))
+        for o in outs:
+            assert isinstance(o, dict), f"snapshot run failed: {o!r}"
+        snap_stats = outs[0]["recovery"]
+
+        # kill the victim mid-run: cd.step fires once per coordinate per
+        # sweep (2 coordinates), so occurrence 2*s+1 dies inside sweep
+        # s's random-effect step
+        kill_occ = 2 * (n_sweeps // 2) + 1
+        outs, wall_crashed = run_ranks(
+            procs, recovery_dir=os.path.join(snap_root, "crashed"),
+            kill_occurrence=kill_occ)
+        survivors, recovery_s, recoveries = {}, [], []
+        for r, o in enumerate(outs):
+            if r == victim:
+                assert isinstance(o, (BaseException, Dropped)), (
+                    f"victim rank survived: {o!r}")
+                continue
+            assert isinstance(o, dict), f"survivor rank {r} failed: {o!r}"
+            got = coeff_map(o["model"])
+            d_re = max(float(np.max(np.abs(got[k_] - ref_coeffs[k_])))
+                       for k_ in ref_coeffs)
+            d_fx = float(np.max(np.abs(
+                np.asarray(o["model"].coordinates["fixed"]
+                           .model.coefficients.means) - ref_fixed)))
+            stats = o["recovery"]
+            survivors[str(r)] = {
+                "re_coeff_max_abs_diff": d_re,
+                "fixed_coeff_max_abs_diff": d_fx,
+                "recovery_seconds": stats["recovery_seconds"],
+                "recoveries": stats["recoveries"],
+                "rank_failures": stats["rank_failures"],
+                "members": stats["members"],
+            }
+            recovery_s.append(float(stats["recovery_seconds"]))
+            recoveries.append(int(stats["recoveries"]))
+    finally:
+        shutil.rmtree(snap_root, ignore_errors=True)
+
+    time_to_recover = max(recovery_s) if recovery_s else float("inf")
+    record = {
+        "environment": _environment(),
+        "metric": "recovery_vs_cold_restart",
+        "value": (round(time_to_recover / wall_clean, 4)
+                  if wall_clean else None),
+        "unit": (f"x of the clean {procs}-process wall-clock spent "
+                 "recovering in-job from one mid-sweep rank kill "
+                 f"({jax.devices()[0].platform}, f64, "
+                 f"entities={n_entities}, d_re={d_u}, sweeps={n_sweeps}; "
+                 "cold restart re-pays >= 1.0x; both shard ladders "
+                 "warmed so neither arm pays compiles)"),
+        "entities": n_entities,
+        "d_re": d_u,
+        "sweeps": n_sweeps,
+        "processes": procs,
+        "victim_rank": victim,
+        "kill_site": f"cd.step occurrence {kill_occ}",
+        "clean_wall_s": round(wall_clean, 3),
+        "snapshot_wall_s": round(wall_snap, 3),
+        "snapshot_overhead_pct": (
+            round((wall_snap - wall_clean) / wall_clean * 100.0, 2)
+            if wall_clean else None),
+        "snapshot_stats_clean": snap_stats,
+        "crashed_wall_s": round(wall_crashed, 3),
+        "time_to_recover_s": round(time_to_recover, 4),
+        "survivors": survivors,
+    }
+    ok = (bool(survivors)
+          and all(v["re_coeff_max_abs_diff"] == 0.0
+                  and v["fixed_coeff_max_abs_diff"] == 0.0
+                  for v in survivors.values())
+          and all(n >= 1 for n in recoveries)
+          and time_to_recover <= 0.5 * wall_clean)
+    record["acceptance_ok"] = ok
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_recovery.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+    if not ok:
+        print("recovery bench acceptance FAILED (survivor f64 bit parity "
+              "vs the clean run, >= 1 recovery recorded, time-to-recover "
+              "<= 0.5x the clean-run wall)", file=sys.stderr)
+        sys.exit(10)
+
+
 def trace_main() -> None:
     """``python bench.py trace`` — the observability off-switch gate.
 
@@ -1697,6 +1936,8 @@ if __name__ == "__main__":
         cd_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "shard":
         shard_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "recovery":
+        recovery_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "trace":
         trace_main()
     else:

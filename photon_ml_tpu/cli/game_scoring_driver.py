@@ -18,6 +18,7 @@ import numpy as np
 from photon_ml_tpu.game.scoring import score_game_model
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.data_reader import read_training_examples
+from photon_ml_tpu.io.durable import durable_replace
 from photon_ml_tpu.io.model_io import load_game_model
 from photon_ml_tpu.io.schemas import SCORING_RESULT_SCHEMA
 from photon_ml_tpu.evaluation import get_evaluator
@@ -214,7 +215,7 @@ def _write_scores_atomic(output_dir: str, records) -> None:
         with contextlib.suppress(OSError):
             os.remove(tmp)
         raise
-    os.replace(tmp, final)
+    durable_replace(tmp, final)
 
 def _score_out_of_core(args, model, index_maps, entity_columns, logger,
                        dtype) -> int:

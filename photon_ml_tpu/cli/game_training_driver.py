@@ -223,6 +223,23 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "MB: a coordinate whose LOCAL table exceeds it "
                         "fails fast with a pointer at --entity-shards "
                         "instead of silently exhausting host RAM")
+    p.add_argument("--max-rank-failures", type=int, default=0,
+                   help="in-job elastic recovery: tolerate up to this many "
+                        "cumulative rank losses by shrinking onto the "
+                        "surviving process set and redistributing the dead "
+                        "ranks' entities from the last committed per-sweep "
+                        "snapshot (transports that cannot resize — the "
+                        "production jax runtime — still get transient "
+                        "rollback-retry and escalate rank loss to the "
+                        "--auto-resume whole-job path). 0 (default) keeps "
+                        "the plain fail-stop behavior "
+                        "(parallel/recovery.py, docs/resilience.md)")
+    p.add_argument("--recovery-snapshot-every", type=_positive_int,
+                   default=1,
+                   help="commit a recovery snapshot every N CD sweeps "
+                        "(with --max-rank-failures > 0): a failure rolls "
+                        "back at most N sweeps; larger N trades snapshot "
+                        "time for replay time")
     p.add_argument("--profile-dir", default=None,
                    help="capture a JAX profiler trace of training here "
                         "(view in TensorBoard/Perfetto)")
@@ -314,7 +331,13 @@ def _run(args) -> int:
 
     distributed = initialize_multihost(args.coordinator_address,
                                        args.num_processes, args.process_id)
-    is_lead = (not distributed) or jax.process_index() == 0
+    # lead election through the ambient transport, not jax: identical in
+    # a real multi-controller run, and under the simulated harness every
+    # thread shares jax.process_index()==0 while the transport reports
+    # the true per-rank index — without this, all simulated ranks think
+    # they lead and race their saves to the shared output dir
+    is_lead = ((not distributed) or jax.process_index() == 0) \
+        and resilience.current_process_index() == 0
     # entity sharding is argv-validated HERE, before any data read: the
     # owner map assigns shard i to process i, so the shard count must be
     # the controller process count
@@ -623,12 +646,25 @@ def _run(args) -> int:
     if evaluators is None:
         evaluators = [TASK_DEFAULT_EVALUATOR[task]] if validation is not None else []
 
+    recovery_mgr = None
+    if args.max_rank_failures > 0:
+        from photon_ml_tpu.parallel.recovery import RecoveryManager
+
+        # same fingerprint discipline as the resume marker: a recovery
+        # snapshot from a run over different inputs must refuse to load
+        recovery_mgr = RecoveryManager(
+            os.path.join(args.output_dir, "recovery"),
+            fingerprint=resume.fingerprint,
+            max_rank_failures=args.max_rank_failures,
+            snapshot_every=args.recovery_snapshot_every)
+
     estimator = GameEstimator(
         task=task, n_iterations=args.n_iterations, evaluators=evaluators,
         dtype=dtype, cd_tolerance=args.cd_tolerance,
         solver_tol_schedule=args.solver_tol_schedule,
         entity_shard=entity_spec,
         entity_table_budget_bytes=re_table_budget,
+        recovery=recovery_mgr,
     )
     ckpt = None
     if args.checkpoint and is_lead:
@@ -682,6 +718,12 @@ def _run(args) -> int:
         print(f"device lost; resume marker written to {resume.path} "
               "(rerun with --auto-resume)", file=sys.stderr)
         return 75
+
+    if recovery_mgr is not None and recovery_mgr.stats["recoveries"]:
+        # the run survived at least one in-job recovery: record it in the
+        # run log (the supervisor never saw a restart, so this is the
+        # only durable trace of the event)
+        logger.log("in_job_recovery", **recovery_mgr.as_dict())
 
     if args.tuning_mode != "none":
         from photon_ml_tpu.tuning import tune_game

@@ -116,6 +116,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--auto-resume", action="store_true",
                    help="resume a lambda grid that died on device loss "
                         "(RESUME_GLM.npz marker / exit code 75)")
+    p.add_argument("--max-rank-failures", type=int, default=0,
+                   help="in-job recovery: retry a lambda fit that died in "
+                        "a TRANSIENT coordinated abort (every rank alive, "
+                        "generic local error) up to this many times, with "
+                        "jittered backoff and a re-aligning barrier. GLM "
+                        "coefficients are replicated, so there is nothing "
+                        "to redistribute: rank loss, device loss and data "
+                        "errors still escalate to the --auto-resume "
+                        "whole-job path (parallel/recovery.py)")
+    p.add_argument("--recovery-snapshot-every", type=int, default=1,
+                   help="accepted for CLI parity with photon-game-train; "
+                        "the GLM grid's recovery unit is one LAMBDA (every "
+                        "finished lambda is already persisted to the "
+                        "resume marker), so this knob has no finer "
+                        "granularity to select here")
     p.add_argument("--compute-variances", action="store_true",
                    help="diagonal-inverse-Hessian coefficient variances")
     p.add_argument("--summarize-features", action="store_true")
@@ -539,26 +554,46 @@ def _run(args) -> int:
                         opt_config,
                         tolerance=args.solver_tol_schedule.at(
                             li, args.tolerance))
-                if streaming:
-                    from photon_ml_tpu.parallel.streaming import fit_streaming
+                def _fit_lambda(lam=lam, run_config=run_config):
+                    if streaming:
+                        from photon_ml_tpu.parallel.streaming import (
+                            fit_streaming,
+                        )
 
-                    # distributed: chunks hold this process's span only and the
-                    # partials allgather-reduce across processes; chunk sharding
-                    # uses the process-LOCAL mesh so per-process partials stay
-                    # local sums while all local chips work each pass
-                    res = fit_streaming(
-                        objective, chunks, dim, w0=w, l2=reg.l2_weight(lam),
-                        l1=reg.l1_weight(lam), optimizer=optimizer,
-                        config=run_config, dtype=dtype, mesh=stream_mesh,
-                        prefetch_depth=args.prefetch_depth,
-                    )
-                else:
-                    res = fit_distributed(
+                        # distributed: chunks hold this process's span only
+                        # and the partials allgather-reduce across
+                        # processes; chunk sharding uses the process-LOCAL
+                        # mesh so per-process partials stay local sums
+                        # while all local chips work each pass
+                        return fit_streaming(
+                            objective, chunks, dim, w0=w,
+                            l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
+                            optimizer=optimizer, config=run_config,
+                            dtype=dtype, mesh=stream_mesh,
+                            prefetch_depth=args.prefetch_depth,
+                        )
+                    return fit_distributed(
                         objective, batch, mesh, w,
                         l2=reg.l2_weight(lam), l1=reg.l1_weight(lam),
                         optimizer=optimizer, config=run_config,
                         precomputed_csc=grid_csc,
                     )
+
+                if args.max_rank_failures > 0:
+                    # bounded collective rollback-retry: a transient
+                    # coordinated abort (every rank alive) re-runs this
+                    # lambda from the same warm start instead of killing
+                    # the whole grid; anything else propagates to the
+                    # device-loss/resume handling below
+                    from photon_ml_tpu.parallel.recovery import (
+                        retry_collective,
+                    )
+
+                    res = retry_collective(
+                        _fit_lambda, max_retries=args.max_rank_failures,
+                        tag=f"glm.lambda_retry:{li}")
+                else:
+                    res = _fit_lambda()
                 w = res.w  # warm start the next lambda
                 diag = {
                     "reg_weight": lam,

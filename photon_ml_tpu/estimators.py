@@ -50,6 +50,7 @@ class GameEstimator:
         solver_tol_schedule=None,
         entity_shard=None,
         entity_table_budget_bytes=None,
+        recovery=None,
     ):
         self.task = task
         self.n_iterations = n_iterations
@@ -66,6 +67,10 @@ class GameEstimator:
         # budget, passed straight to CoordinateDescent
         self.entity_shard = entity_shard
         self.entity_table_budget_bytes = entity_table_budget_bytes
+        # parallel.recovery.RecoveryManager (or None): in-job rollback /
+        # elastic-shrink recovery, shared across the whole grid (budgets
+        # bound the job; each CoordinateDescent.run resets the pointers)
+        self.recovery = recovery
 
     def fit(
         self,
@@ -100,6 +105,7 @@ class GameEstimator:
                 solver_tol_schedule=self.solver_tol_schedule,
                 entity_shard=self.entity_shard,
                 entity_table_budget_bytes=self.entity_table_budget_bytes,
+                recovery=self.recovery,
             )
             ckpt = None
             if checkpoint_callback is not None:

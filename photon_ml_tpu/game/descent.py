@@ -75,7 +75,11 @@ from photon_ml_tpu.parallel.entity_shard import (
     exchange_score_updates,
 )
 from photon_ml_tpu.parallel.mesh import make_mesh
-from photon_ml_tpu.parallel.resilience import CollectiveGuard, health_barrier
+from photon_ml_tpu.parallel.resilience import (
+    CollectiveGuard,
+    PeerFailure,
+    health_barrier,
+)
 from photon_ml_tpu.types import LabeledBatch, SparseFeatures, margins as _margins
 
 
@@ -821,6 +825,7 @@ class CoordinateDescent:
         solver_tol_schedule=None,
         entity_shard: Optional[EntityShardSpec] = None,
         entity_table_budget_bytes: Optional[int] = None,
+        recovery=None,
     ):
         names = [c.name for c in configs]
         if len(set(names)) != len(names):
@@ -857,6 +862,11 @@ class CoordinateDescent:
         self.entity_table_budget_bytes = entity_table_budget_bytes
         self._sharded = entity_shard is not None and entity_shard.active
         self._comm = ShardCommStats()
+        # parallel.recovery.RecoveryManager (or None): per-sweep shard
+        # snapshots + in-job rollback/shrink recovery from PeerFailure.
+        # One manager serves every grid point of an estimator fit
+        # (run() calls reset_for_run(); budgets are job-cumulative).
+        self.recovery = recovery
 
     # -- main loop -------------------------------------------------------
     def run(
@@ -883,37 +893,19 @@ class CoordinateDescent:
                 )
 
         states: Dict[str, object] = {}
+        val_states: Dict[str, object] = {}
+        val_feats: Dict[str, SparseFeatures] = {}
         for cfg in self.configs:
             if cfg.coordinate_type == "fixed":
                 states[cfg.name] = _FixedState(cfg, train, dtype, self.task, self.mesh)
-            else:
-                states[cfg.name] = _RandomState(
-                    cfg, train, dtype, cache=self.dataset_cache,
-                    entity_shard=self.entity_shard,
-                    table_budget_bytes=self.entity_table_budget_bytes)
-
-        val_states: Dict[str, object] = {}
-        val_feats: Dict[str, SparseFeatures] = {}
-        if validation is not None:
-            for cfg in self.configs:
-                if cfg.coordinate_type == "random":
-                    st: _RandomState = states[cfg.name]
-                    key = ("val_view", id(validation), id(st.train_data))
-                    cache = self.dataset_cache
-                    if cache is not None and key in cache:
-                        val_states[cfg.name] = cache[key][2]
-                    else:
-                        sp = validation.features[cfg.feature_shard]
-                        ids = validation.entity_ids[cfg.entity_column]
-                        val_states[cfg.name] = build_score_view(st.train_data, sp, ids)
-                        if cache is not None:
-                            # pin both keyed objects against id() recycling
-                            cache[key] = (validation, st.train_data,
-                                          val_states[cfg.name])
-                else:
+                if validation is not None:
                     val_feats[cfg.name] = _device_features(
                         validation.features[cfg.feature_shard], dtype
                     )
+        # random-effect states (and their validation score views) build
+        # through a helper so elastic recovery can REBUILD them against a
+        # shrunk owner map after a rank loss (_recovery_restore)
+        self._build_random_states(train, validation, states, val_states)
 
         # initialize scores (zeros, or from warm-start model)
         scores = {c.name: jnp.zeros((n,), dtype) for c in self.configs}
@@ -992,7 +984,12 @@ class CoordinateDescent:
               if validation is not None and evaluators else None)
         _eps = float(jnp.finfo(dtype).eps)
         stop_reason = "max_iterations"
-        for it in range(self.n_iterations):
+
+        def _one_sweep(it: int) -> bool:
+            # One full CD sweep; True means the cd_tolerance early exit
+            # fired. A closure (not a plain loop body) so the recovery
+            # wrapper below can re-run a sweep from a restored snapshot.
+            nonlocal stop_reason
             rt.resync(scores)
             if vt is not None:
                 vt.resync(val_scores)
@@ -1101,7 +1098,36 @@ class CoordinateDescent:
                          "cd.early_exit after sweep %d: max score delta "
                          "%.3g <= cd_tolerance %.3g", it,
                          max(sweep_deltas.values()), self.cd_tolerance)
-                break
+                return True
+            return False
+
+        recovery = self.recovery
+        if recovery is not None:
+            recovery.reset_for_run()
+        it = 0
+        while it < self.n_iterations:
+            try:
+                if recovery is not None:
+                    # sweep-start commit: the rollback target for any
+                    # failure inside this sweep (all-or-nothing barrier →
+                    # every survivor agrees on the committed sweep)
+                    recovery.commit(it, lambda: self._recovery_payload(
+                        states, scores, val_scores, validation))
+                stop = _one_sweep(it)
+                it += 1
+                if stop:
+                    break
+            except PeerFailure as exc:
+                if recovery is None:
+                    raise
+                # re-raises when the failure is fatal / budgets exhausted /
+                # nothing committed; a failure DURING recovery propagates
+                # out of on_failure or _recovery_restore as a coordinated
+                # abort (bounded by the barrier watchdog — no hangs)
+                plan = recovery.on_failure(exc)
+                it = self._recovery_restore(
+                    plan, train, validation, states, val_states,
+                    scores, val_scores, history, recovery)
         if history:
             history[-1]["stop_reason"] = stop_reason
 
@@ -1120,6 +1146,34 @@ class CoordinateDescent:
         return model, history
 
     # -- helpers ---------------------------------------------------------
+    def _build_random_states(self, train, validation, states, val_states):
+        """(Re)build every random coordinate's ``_RandomState`` and
+        validation score view against the CURRENT ``self.entity_shard``.
+        Used at run() entry and again by recovery after a shrink (the
+        dataset cache keys include the shard spec, so a remapped owner
+        map rebuilds rather than aliasing the stale layout)."""
+        for cfg in self.configs:
+            if cfg.coordinate_type != "random":
+                continue
+            states[cfg.name] = _RandomState(
+                cfg, train, self.dtype, cache=self.dataset_cache,
+                entity_shard=self.entity_shard,
+                table_budget_bytes=self.entity_table_budget_bytes)
+            if validation is not None:
+                st: _RandomState = states[cfg.name]
+                key = ("val_view", id(validation), id(st.train_data))
+                cache = self.dataset_cache
+                if cache is not None and key in cache:
+                    val_states[cfg.name] = cache[key][2]
+                else:
+                    sp = validation.features[cfg.feature_shard]
+                    ids = validation.entity_ids[cfg.entity_column]
+                    val_states[cfg.name] = build_score_view(st.train_data, sp, ids)
+                    if cache is not None:
+                        # pin both keyed objects against id() recycling
+                        cache[key] = (validation, st.train_data,
+                                      val_states[cfg.name])
+
     def _random_step(self, cfg, st, it, offs, run_cfg, scores, val_scores,
                      val_states, rt, vt, n, val_n, validation, entity_mesh,
                      eps, record) -> float:
@@ -1336,6 +1390,198 @@ class CoordinateDescent:
                 )
         return GameModel(coords, self.task)
 
+    # -- in-job recovery -------------------------------------------------
+    def _recovery_payload(self, states, scores, val_scores, validation):
+        """This rank's sweep-start shard snapshot: everything a survivor
+        set needs to resume the sweep bit-exactly. Replicated state (fixed
+        coefficients, global score vectors) plus this shard's random-effect
+        tables; sharded runs additionally record the bucket-level entity
+        table (ids + projections + coefficients) so a SHRUNK survivor set
+        can redistribute a dead rank's entities through the warm-start
+        remap. All values are host numpy copies (the npz ResumeManager
+        pickles them; device arrays must not leak into the marker)."""
+        fixed = {}
+        random = {}
+        for cfg in self.configs:
+            st = states[cfg.name]
+            if cfg.coordinate_type == "fixed":
+                fixed[cfg.name] = {
+                    "w": None if st.w is None else np.asarray(st.w),
+                    "variances": (None if st.variances is None
+                                  else np.asarray(st.variances)),
+                }
+                continue
+            buckets = None
+            if self._sharded and st.coeffs is not None:
+                buckets = []
+                for b, bucket in enumerate(st.train_data.buckets):
+                    lm0 = bucket.local_maps[0] if bucket.local_maps else None
+                    buckets.append({
+                        "entity_ids": np.asarray(bucket.entity_ids),
+                        "projection": (None if bucket.projection is None
+                                       else np.asarray(bucket.projection)),
+                        "coefficients": np.asarray(st.coeffs[b]),
+                        "frozen": (None if st.frozen is None
+                                   else np.asarray(st.frozen[b])),
+                        "sketch": (lm0 if isinstance(lm0, SketchProjection)
+                                   else None),
+                    })
+            random[cfg.name] = {
+                "coeffs": (None if st.coeffs is None
+                           else [np.asarray(c) for c in st.coeffs]),
+                "frozen": (None if st.frozen is None
+                           else [np.asarray(f) for f in st.frozen]),
+                "offs_snap": (None if st.offs_snap is None
+                              else np.array(st.offs_snap, copy=True)),
+                "local_scores": (
+                    None if getattr(st, "local_scores", None) is None
+                    else np.asarray(st.local_scores)),
+                "local_val_scores": (
+                    None if getattr(st, "local_val_scores", None) is None
+                    else np.asarray(st.local_val_scores)),
+                "buckets": buckets,
+            }
+        return {
+            "fixed": fixed,
+            "random": random,
+            "scores": {k: np.asarray(v) for k, v in scores.items()},
+            "val_scores": (None if validation is None else
+                           {k: np.asarray(v) for k, v in val_scores.items()}),
+        }
+
+    def _recovery_restore(self, plan, train, validation, states, val_states,
+                          scores, val_scores, history, recovery) -> int:
+        """Roll the run back to the plan's agreed committed sweep. Pure
+        rollback (same membership) restores every table from this rank's
+        own snapshot in place. A shrink additionally recomputes the
+        entity owner map over the survivors, rebuilds the random states
+        against it, and redistributes the dead rank's entities from the
+        old members' committed bucket tables via the warm-start remap
+        (bitwise-exact per the PR-7 roundtrip guarantee); local score
+        vectors are re-derived by scoring the redistributed coefficients,
+        which at a committed point bitwise-matches an uninterrupted run's
+        vectors on the new layout. Random-effect posterior variances are
+        NOT snapshotted (they are O(entities * dim^2)); a recovered run
+        recomputes them at its next solve (docs/resilience.md). Returns
+        the sweep index to resume from."""
+        dtype = self.dtype
+        n = train.num_samples
+        val_n = validation.num_samples if validation is not None else 0
+        own = plan.snapshots[plan.own_rank]
+        remap = plan.remapped and self._sharded
+        old_spec = self.entity_shard
+        if remap:
+            self.entity_shard = EntityShardSpec(plan.new_num_shards,
+                                                plan.new_shard_index)
+            self._sharded = self.entity_shard.active
+            self._build_random_states(train, validation, states, val_states)
+        for cfg in self.configs:
+            if cfg.coordinate_type != "fixed":
+                continue
+            snap = own["fixed"][cfg.name]
+            st = states[cfg.name]
+            st.w = None if snap["w"] is None else jnp.asarray(snap["w"])
+            st.variances = (None if snap["variances"] is None
+                            else jnp.asarray(snap["variances"]))
+        for name, arr in own["scores"].items():
+            scores[name] = jnp.asarray(arr)
+        if validation is not None and own.get("val_scores") is not None:
+            for name, arr in own["val_scores"].items():
+                val_scores[name] = jnp.asarray(arr)
+        for cfg in self.configs:
+            if cfg.coordinate_type != "random":
+                continue
+            st = states[cfg.name]
+            snap = own["random"][cfg.name]
+            st.variances = None
+            if not remap:
+                st.coeffs = (None if snap["coeffs"] is None
+                             else [np.asarray(c) for c in snap["coeffs"]])
+                st.frozen = (None if snap["frozen"] is None
+                             else [np.asarray(f) for f in snap["frozen"]])
+                st.offs_snap = (None if snap["offs_snap"] is None
+                                else np.array(snap["offs_snap"], copy=True))
+                if self._sharded:
+                    st.local_scores = (
+                        jnp.zeros((n,), dtype)
+                        if snap["local_scores"] is None
+                        else jnp.asarray(snap["local_scores"]))
+                    st.local_val_scores = (
+                        jnp.zeros((val_n,), dtype)
+                        if snap["local_val_scores"] is None
+                        else jnp.asarray(snap["local_val_scores"]))
+                continue
+            merged = []
+            for r in plan.old_members:
+                b = plan.snapshots[r]["random"][cfg.name]["buckets"]
+                if b:
+                    merged.extend(b)
+            if not merged:
+                # crashed before this coordinate's first solve: cold state
+                st.coeffs = None
+                st.frozen = None
+                st.offs_snap = None
+                st.local_scores = jnp.zeros((n,), dtype)
+                st.local_val_scores = jnp.zeros((val_n,), dtype)
+                continue
+            prev = RandomEffectModel(
+                cfg.name,
+                [RandomEffectBucket(
+                    entity_ids=b["entity_ids"],
+                    coefficients=b["coefficients"],
+                    projection=b["projection"],
+                    variances=None,
+                    sketch=b["sketch"]) for b in merged],
+                self.task, cfg.feature_shard,
+                entity_column=cfg.entity_column)
+            st.coeffs = _coeffs_from_prev(prev, st.train_data)
+            # active-set freeze flags travel per ENTITY (layout-free)
+            fmap = {}
+            for b in merged:
+                if b["frozen"] is None:
+                    continue
+                for eid, fz in zip(b["entity_ids"], b["frozen"]):
+                    fmap[str(eid)] = bool(fz)
+            st.frozen = (None if not fmap else [
+                np.asarray([fmap.get(str(e), False)
+                            for e in bucket.entity_ids], bool)
+                for bucket in st.train_data.buckets])
+            # each row's residual reference belongs to the entity's OLD
+            # owner: that shard solved the entity last, so its snapshot
+            # holds the row's value as of that solve (layout-independent)
+            snaps_offs = [plan.snapshots[r]["random"][cfg.name]["offs_snap"]
+                          for r in plan.old_members]
+            if any(o is None for o in snaps_offs):
+                st.offs_snap = None
+            else:
+                old_owner = old_spec.owner_of(
+                    train.entity_ids[cfg.entity_column])
+                merged_offs = np.array(np.asarray(snaps_offs[0]), copy=True)
+                for si in range(1, len(plan.old_members)):
+                    rows = old_owner == si
+                    merged_offs[rows] = np.asarray(snaps_offs[si])[rows]
+                st.offs_snap = merged_offs
+            if self._sharded:
+                st.local_scores = score_random_effect(
+                    st.train_view, st.coeffs, n, dtype)
+                st.local_val_scores = (
+                    score_random_effect(val_states[cfg.name], st.coeffs,
+                                        val_n, dtype)
+                    if validation is not None and cfg.name in val_states
+                    else jnp.zeros((val_n,), dtype))
+        history[:] = [r for r in history
+                      if r.get("iteration", -1) < plan.sweep]
+        # re-commit the restored state at the agreed sweep under the NEW
+        # membership: survivors re-enter the loop from an aligned,
+        # rollback-able point (this also closes the recovery timer)
+        recovery.commit(plan.sweep, lambda: self._recovery_payload(
+            states, scores, val_scores, validation), force=True)
+        _log.warning(
+            "recovery: restored to committed sweep %d on %d shard(s) after "
+            "%s; resuming", plan.sweep, len(plan.members),
+            plan.failure_class)
+        return plan.sweep
+
     def _load_warm_start(self, model, states, scores, val_scores,
                          train, validation, val_states, val_feats):
         """Initialize coordinate states and scores from a previous GameModel
@@ -1357,33 +1603,7 @@ class CoordinateDescent:
                 if validation is not None:
                     val_scores[cfg.name] = _margins(val_feats[cfg.name], w_model)
             else:
-                prev_index = prev.entity_index()
-                coeffs = []
-                for bucket in st.train_data.buckets:
-                    W = np.zeros((bucket.num_entities, bucket.local_dim))
-                    # one dict probe per entity; ALL slot remapping below is
-                    # numpy group ops (VERDICT r4 #7: the per-entity x
-                    # per-slot Python loops were O(minutes) at the survey's
-                    # thousands-to-millions-of-entities scale)
-                    rows, pbs, prs = [], [], []
-                    for r, eid in enumerate(bucket.entity_ids):
-                        slot = prev_index.get(eid)
-                        if slot is None:  # loaded models key entities as str
-                            slot = prev_index.get(str(eid))
-                        if slot is not None:
-                            rows.append(r)
-                            pbs.append(slot[0])
-                            prs.append(slot[1])
-                    if rows:
-                        rows_a = np.asarray(rows)
-                        pbs_a = np.asarray(pbs)
-                        prs_a = np.asarray(prs)
-                        for pb in np.unique(pbs_a):
-                            sel = pbs_a == pb
-                            _warm_fill_bucket(W, bucket, rows_a[sel],
-                                              prev.buckets[int(pb)],
-                                              prs_a[sel])
-                    coeffs.append(W)
+                coeffs = _coeffs_from_prev(prev, st.train_data)
                 st.coeffs = coeffs
                 scores[cfg.name] = score_random_effect(
                     st.train_view, coeffs, train.num_samples, self.dtype
@@ -1392,6 +1612,40 @@ class CoordinateDescent:
                     val_scores[cfg.name] = score_random_effect(
                         val_states[cfg.name], coeffs, validation.num_samples, self.dtype
                     )
+
+
+def _coeffs_from_prev(prev, train_data) -> List[np.ndarray]:
+    """Fill a training-layout coefficient table from a previous model's
+    entity table. Warm start and recovery redistribution share this: both
+    are "re-address each entity's coefficients from an old bucket layout
+    into the current one" joins.
+
+    One dict probe per entity; ALL slot remapping below is numpy group
+    ops (VERDICT r4 #7: the per-entity x per-slot Python loops were
+    O(minutes) at the survey's thousands-to-millions-of-entities scale)."""
+    prev_index = prev.entity_index()
+    coeffs = []
+    for bucket in train_data.buckets:
+        W = np.zeros((bucket.num_entities, bucket.local_dim))
+        rows, pbs, prs = [], [], []
+        for r, eid in enumerate(bucket.entity_ids):
+            slot = prev_index.get(eid)
+            if slot is None:  # loaded models key entities as str
+                slot = prev_index.get(str(eid))
+            if slot is not None:
+                rows.append(r)
+                pbs.append(slot[0])
+                prs.append(slot[1])
+        if rows:
+            rows_a = np.asarray(rows)
+            pbs_a = np.asarray(pbs)
+            prs_a = np.asarray(prs)
+            for pb in np.unique(pbs_a):
+                sel = pbs_a == pb
+                _warm_fill_bucket(W, bucket, rows_a[sel],
+                                  prev.buckets[int(pb)], prs_a[sel])
+        coeffs.append(W)
+    return coeffs
 
 
 def _warm_fill_bucket(W, bucket, rows, prev_bucket, prs) -> None:

@@ -68,6 +68,8 @@ import time
 import uuid
 from typing import Dict, Iterator, Optional
 
+from photon_ml_tpu.io.durable import durable_replace
+
 __all__ = [
     "TraceContext", "Tracer", "current_context", "use_context",
     "span", "start", "stop", "enabled", "active_tracer",
@@ -321,7 +323,7 @@ class Tracer:
             tmp = final + f".tmp-{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(doc, f)
-            os.replace(tmp, final)
+            durable_replace(tmp, final)
 
     def stop(self, timeout_s: float = 5.0) -> None:
         self._stop.set()

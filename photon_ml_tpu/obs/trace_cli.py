@@ -34,6 +34,8 @@ import statistics
 import sys
 from typing import Dict, List, Optional, Tuple
 
+from photon_ml_tpu.io.durable import durable_replace
+
 __all__ = ["merge_traces", "validate_trace", "main"]
 
 
@@ -154,7 +156,7 @@ def _cmd_merge(args) -> int:
     tmp = out + f".tmp-{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(doc, f)
-    os.replace(tmp, out)
+    durable_replace(tmp, out)
     meta = doc["metadata"]
     print(f"merged {len(paths)} rank file(s) -> {out} "
           f"({len(doc['traceEvents'])} events, ranks {meta['ranks']})")

@@ -39,7 +39,8 @@ import threading
 from typing import List, Optional, Sequence
 
 __all__ = ["Fault", "InjectedFault", "DroppedProcess", "install", "clear",
-           "installed", "check", "mangle_payload", "process_context"]
+           "installed", "check", "mangle_payload", "process_context",
+           "crash_schedule"]
 
 
 class InjectedFault(RuntimeError):
@@ -180,6 +181,31 @@ def check(site: str) -> None:
         raise jax.errors.JaxRuntimeError(
             f"UNAVAILABLE: {f.message} (injected device loss at {site})")
     raise InjectedFault(f"{site}: {f.message}")
+
+
+def crash_schedule(*kills, kind: str = "drop") -> List[Fault]:
+    """Build a crash schedule: each ``(rank, site, occurrence)`` triple
+    kills process ``rank`` at its ``occurrence``-th visit (0-based) of
+    fault site ``site``. ``kind`` selects how it dies: ``"drop"`` (silent
+    fail-stop — the recovery harness's shrink path), ``"raise"`` (a
+    reported local failure — the rollback path) or ``"device_loss"``
+    (the drivers' resume-marker/exit-75 path). Sites include the
+    mid-collective ``transport.allgather`` point inside the simulated
+    transport itself, so a rank can die INSIDE a rendezvous, not only
+    between collectives. Feed the result to :func:`install` (or merge
+    with other faults first)::
+
+        fault_injection.install(fault_injection.crash_schedule(
+            (2, "cd.step", 5),                   # rank 2, 6th CD step
+            (1, "transport.allgather", 3),       # rank 1, mid-collective
+        ))
+    """
+    plan = []
+    for rank, site, occurrence in kills:
+        plan.append(Fault(site=site, kind=kind, process=int(rank),
+                          at=int(occurrence),
+                          message=f"scheduled crash of rank {rank}"))
+    return plan
 
 
 def mangle_payload(site: str, payload: bytes) -> bytes:

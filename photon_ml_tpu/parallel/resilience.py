@@ -58,8 +58,8 @@ from typing import Callable, Dict, List, Optional
 __all__ = [
     "PeerFailure", "WatchdogTimeout", "ResumeMismatch",
     "health_barrier", "CollectiveGuard", "guarded", "retry_transient",
-    "ResumeManager", "current_transport", "use_transport",
-    "current_process_index", "default_timeout",
+    "Backoff", "ResumeManager", "current_transport", "use_transport",
+    "set_transport", "current_process_index", "default_timeout",
     "collective_site", "current_collective_site",
     "CODE_OK", "CODE_ERROR", "CODE_DEVICE_LOSS", "CODE_DATA",
 ]
@@ -226,6 +226,16 @@ def use_transport(transport):
         _tls.transport = prev
 
 
+def set_transport(transport) -> None:
+    """Replace this thread's transport IN PLACE — the elastic-recovery
+    hook: after a surviving-set rendezvous shrinks the process group,
+    the survivor installs its new (remapped-rank) endpoint here so every
+    subsequent collective runs over the surviving set. An enclosing
+    :func:`use_transport` context still restores its own previous value
+    on exit, so the swap never leaks past the simulated process."""
+    _tls.transport = transport
+
+
 # -- health barrier / guarded phases ---------------------------------------
 def health_barrier(tag: str, failure: Optional[BaseException] = None,
                    *, timeout: Optional[float] = None) -> None:
@@ -303,28 +313,100 @@ def guarded(fn: Callable, tag: Optional[str] = None,
 
 
 # -- bounded retry ---------------------------------------------------------
+class Backoff:
+    """Jittered exponential backoff schedule with an optional total
+    deadline — the ONE delay policy shared by :func:`retry_transient`,
+    the registry watcher's error backoff, the front door's circuit
+    breaker, and the chaos harness's respawn supervision (so every
+    retry loop in the tree jitters the same way instead of four fixed
+    waits stampeding in sync).
+
+    ``next_delay()`` returns ``base_s * factor^k``, clamped to ``max_s``,
+    plus a uniform jitter of up to ``jitter`` (a FRACTION of the delay).
+    ``reset()`` restarts the schedule (call it on success).
+    ``expired()`` reports whether ``deadline_s`` of wall time has passed
+    since construction or the last reset — callers stop retrying then."""
+
+    def __init__(self, base_s: float = 0.5, factor: float = 2.0,
+                 max_s: float = 60.0, jitter: float = 0.1,
+                 deadline_s: Optional[float] = None,
+                 rng=None, clock: Callable = time.monotonic):
+        if base_s < 0 or factor < 1.0 or jitter < 0:
+            raise ValueError(
+                f"need base_s >= 0, factor >= 1, jitter >= 0; got "
+                f"base_s={base_s}, factor={factor}, jitter={jitter}")
+        import random as _random
+
+        self.base_s = float(base_s)
+        self.factor = float(factor)
+        self.max_s = float(max_s)
+        self.jitter = float(jitter)
+        self.deadline_s = deadline_s
+        self._rng = rng if rng is not None else _random.Random()
+        self._clock = clock
+        self.attempts = 0
+        self._start = clock()
+
+    def next_delay(self) -> float:
+        delay = min(self.base_s * (self.factor ** self.attempts), self.max_s)
+        self.attempts += 1
+        if self.jitter > 0.0:
+            delay += self._rng.uniform(0.0, self.jitter * delay)
+        return delay
+
+    def reset(self) -> None:
+        self.attempts = 0
+        self._start = self._clock()
+
+    def expired(self) -> bool:
+        if self.deadline_s is None:
+            return False
+        return (self._clock() - self._start) >= self.deadline_s
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left under the deadline (None when unbounded)."""
+        if self.deadline_s is None:
+            return None
+        return max(0.0, self.deadline_s - (self._clock() - self._start))
+
+
 def retry_transient(fn: Callable, *, attempts: int = 3,
                     backoff_s: float = 0.5, backoff_factor: float = 2.0,
+                    jitter: float = 0.0,
+                    deadline_s: Optional[float] = None,
                     retriable=(RuntimeError, ConnectionError, OSError),
                     on_retry: Optional[Callable] = None,
-                    sleep: Callable = time.sleep):
+                    sleep: Callable = time.sleep,
+                    rng=None, clock: Callable = time.monotonic):
     """Call ``fn`` with bounded retry-with-backoff on transient failures
     (coordinator rendezvous races, slow peers). Non-``retriable``
     exceptions propagate immediately; the last attempt's exception
-    propagates unchanged so callers see the real error."""
+    propagates unchanged so callers see the real error.
+
+    ``jitter`` adds up to that FRACTION of each delay as uniform random
+    extra sleep (multi-process rendezvous retries must not re-collide in
+    lockstep); ``deadline_s`` caps TOTAL wall time across attempts — the
+    next retry is abandoned (and the last error raised) once the deadline
+    passes or the upcoming sleep would overrun it. ``jitter=0`` and
+    ``deadline_s=None`` reproduce the original fixed schedule exactly."""
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
-    delay = backoff_s
+    backoff = Backoff(base_s=backoff_s, factor=backoff_factor,
+                      max_s=float("inf"), jitter=jitter,
+                      deadline_s=deadline_s, rng=rng, clock=clock)
     for attempt in range(attempts):
         try:
             return fn()
         except retriable as e:
             if attempt + 1 >= attempts:
                 raise
+            delay = backoff.next_delay()
+            remaining = backoff.remaining()
+            if remaining is not None and delay >= remaining:
+                raise  # the deadline would pass mid-sleep: escalate now
             if on_retry is not None:
                 on_retry(attempt, e)
             sleep(delay)
-            delay *= backoff_factor
 
 
 # -- unified resume/checkpoint marker lifecycle ----------------------------
@@ -332,8 +414,10 @@ class ResumeManager:
     """One resume-marker contract for every driver (subsumes the GAME
     driver's ``RESUME.json`` and the GLM driver's ``RESUME_GLM.npz``):
 
-    * **written atomically** — temp file + ``os.replace``, so a crash
-      mid-write can never leave a half-marker that hijacks a rerun;
+    * **written atomically and durably** — temp file + fsync +
+      ``os.replace`` + parent-dir fsync (``io/durable.py``), so a crash
+      mid-write can never leave a half-marker that hijacks a rerun, and
+      a power loss after "committed" cannot un-commit it;
     * **kept until success** — the marker is consumed only when the
       protected work COMPLETES (``consume()``), so a second failure of
       any kind (OOM, SIGKILL, another device loss) does not silently
@@ -376,7 +460,12 @@ class ResumeManager:
         else:
             with open(tmp, "w") as f:
                 json.dump(record, f)
-        os.replace(tmp, self.path)
+        # durable commit: fsync content + parent dir around the rename — a
+        # marker that claims "committed" must survive power loss, not just
+        # concurrent readers (io/durable.py)
+        from photon_ml_tpu.io.durable import durable_replace
+
+        durable_replace(tmp, self.path)
 
     def load(self, verify: bool = True) -> Optional[dict]:
         """Marker payload, or None when absent. ``verify=False`` skips the

@@ -43,6 +43,7 @@ import shutil
 import time
 from typing import Dict, List, Optional
 
+from photon_ml_tpu.io.durable import durable_dir_rename, durable_replace
 from photon_ml_tpu.parallel import fault_injection
 from photon_ml_tpu.parallel.resilience import ResumeManager
 
@@ -268,7 +269,10 @@ class ModelRegistry:
                 ResumeManager(os.path.join(staging, _MANIFEST),
                               fingerprint=fingerprints).save(payload)
                 try:
-                    os.rename(staging, self.version_dir(candidate))
+                    # durable: fsync staging + parent around the rename so
+                    # a power loss can't surface a "complete" version dir
+                    # whose entries never reached disk (io/durable.py)
+                    durable_dir_rename(staging, self.version_dir(candidate))
                 except OSError:
                     continue  # lost the number to a concurrent publish
                 version = candidate
@@ -292,9 +296,10 @@ class ModelRegistry:
         return version
 
     def set_latest(self, version: str) -> None:
-        """Atomically repoint ``LATEST`` (temp file + ``os.replace``,
-        same discipline as every marker in this repo). Also the
-        rollback primitive: point it back at any retained version."""
+        """Atomically AND durably repoint ``LATEST`` (temp file + fsync +
+        ``os.replace`` + parent-dir fsync, same discipline as every
+        marker in this repo — io/durable.py). Also the rollback
+        primitive: point it back at any retained version."""
         if not self._exists(version):
             raise RegistryError(f"cannot promote missing version "
                                 f"{version!r} (known: {self.list_versions()})")
@@ -302,7 +307,7 @@ class ModelRegistry:
         tmp = f"{self.latest_path}.tmp-{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"version": version, "promoted_at": time.time()}, f)
-        os.replace(tmp, self.latest_path)
+        durable_replace(tmp, self.latest_path)
 
     def update_manifest(self, version: str, **fields) -> dict:
         """Rewrite a version's manifest payload with ``fields`` merged in

@@ -317,51 +317,95 @@ class AsyncScoringServer:
         return 200, svc.score_body(rows, per_coord, result)
 
 
+_BACKEND_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
+
+
 class _Backend:
     """One replica behind the front door: address, pooled connections,
-    in-flight count, failure cool-down."""
+    in-flight count, and a per-backend circuit breaker.
 
-    __slots__ = ("host", "port", "inflight", "down_until", "pool",
-                 "picked", "cooldowns")
+    Breaker states: ``closed`` (serving), ``open`` (ejected after
+    ``threshold`` CONSECUTIVE failures; nothing is routed here until a
+    timed health probe readmits it), ``half_open`` (a ``/healthz`` probe
+    is in flight; success closes the breaker, failure reopens it with an
+    escalated jittered cool-down). A single failure no longer ejects a
+    replica — one slow GC pause used to eject-and-readmit on a fixed
+    timer with no health evidence at all."""
 
-    def __init__(self, host: str, port: int):
+    __slots__ = ("host", "port", "inflight", "pool", "picked", "cooldowns",
+                 "state", "fails", "opened", "next_probe_at",
+                 "probe_inflight", "backoff")
+
+    def __init__(self, host: str, port: int, cooldown_s: float = 1.0):
+        from photon_ml_tpu.parallel.resilience import Backoff
+
         self.host = host
         self.port = int(port)
         self.inflight = 0
-        self.down_until = 0.0
         self.pool: List[tuple] = []  # (reader, writer) keep-alive pairs
         self.picked = 0     # times selected to carry a proxied request
-        self.cooldowns = 0  # times put into failure cool-down
+        self.cooldowns = 0  # failure events observed (counter continuity)
+        self.state = "closed"
+        self.fails = 0      # CONSECUTIVE failures; any success resets
+        self.opened = 0     # times the breaker tripped open
+        self.next_probe_at = 0.0
+        self.probe_inflight = False
+        # open-state cool-down: exponential with jitter so N front doors
+        # probing one recovering replica don't re-slam it in lockstep
+        self.backoff = Backoff(base_s=cooldown_s, factor=2.0,
+                               max_s=max(30.0, cooldown_s), jitter=0.1)
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
+    def record_failure(self, threshold: int, now: float) -> None:
+        self.fails += 1
+        self.cooldowns += 1
+        if self.state == "half_open" or self.fails >= threshold:
+            if self.state != "open":
+                self.opened += 1
+            self.state = "open"
+            self.next_probe_at = now + self.backoff.next_delay()
+
+    def record_success(self) -> None:
+        self.fails = 0
+        self.state = "closed"
+        self.backoff.reset()
+
 
 class AsyncFrontDoor:
     """Least-loaded/round-robin HTTP front door for N scoring replicas.
 
-    Policy: among backends not in failure cool-down, pick the lowest
-    in-flight count (ties resolved round-robin). A backend that fails to
-    connect or mid-exchange is cooled down for ``retry_backend_s`` and
-    the request is retried ONCE on another backend; with every backend
-    down the client sees 503 (the front door never queues — queueing and
-    shedding live in the replicas' batchers, one admission-control point
-    per process)."""
+    Policy: among backends whose circuit breaker is CLOSED, pick the
+    lowest in-flight count (ties resolved round-robin). A backend that
+    fails to connect or mid-exchange gets the request retried ONCE on
+    another backend; ``breaker_threshold`` consecutive failures open its
+    breaker — nothing is routed there until a timed ``/healthz`` probe
+    (half-open state, jittered exponential cool-down starting at
+    ``retry_backend_s``) readmits it. With every backend open the client
+    sees 503 (the front door never queues — queueing and shedding live
+    in the replicas' batchers, one admission-control point per
+    process)."""
 
     def __init__(self, backends: Sequence[str], host: str = "127.0.0.1",
                  port: int = 0, policy: str = "least_loaded",
-                 retry_backend_s: float = 1.0):
+                 retry_backend_s: float = 1.0, breaker_threshold: int = 3):
         if not backends:
             raise ValueError("front door needs at least one backend")
         if policy not in ("least_loaded", "round_robin"):
             raise ValueError(f"unknown policy {policy!r}")
+        if breaker_threshold < 1:
+            raise ValueError(f"breaker_threshold must be >= 1, got "
+                             f"{breaker_threshold}")
         self._backends = []
         for b in backends:
             h, _, p = str(b).rpartition(":")
-            self._backends.append(_Backend(h or "127.0.0.1", int(p)))
+            self._backends.append(_Backend(h or "127.0.0.1", int(p),
+                                           cooldown_s=float(retry_backend_s)))
         self.policy = policy
         self.retry_backend_s = float(retry_backend_s)
+        self.breaker_threshold = int(breaker_threshold)
         self._rr = 0
         self._host_arg, self._port_arg = host, port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -370,6 +414,7 @@ class AsyncFrontDoor:
         self.proxied = 0
         self.retried = 0
         self.unavailable = 0
+        self.readmitted = 0  # breakers closed again by a healthz probe
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> "AsyncFrontDoor":
@@ -414,11 +459,48 @@ class AsyncFrontDoor:
         asyncio.run(main())
         return 0
 
+    # -- circuit breaker ---------------------------------------------------
+    def _maybe_probe(self, backend: _Backend, now: float) -> None:
+        """Lazy open→half_open transition: when an open backend's
+        cool-down has elapsed, fire ONE async ``/healthz`` probe (guarded
+        so concurrent picks don't stack probes). Runs from the request
+        path — no timer thread; an idle front door simply probes on its
+        next request or metrics scrape."""
+        if (backend.state != "open" or now < backend.next_probe_at
+                or backend.probe_inflight):
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (sync caller): stay open until a real request
+        backend.state = "half_open"
+        backend.probe_inflight = True
+        loop.create_task(self._probe(backend))
+
+    async def _probe(self, backend: _Backend) -> None:
+        probe = (b"GET /healthz HTTP/1.1\r\nHost: backend\r\n"
+                 b"Content-Length: 0\r\nConnection: keep-alive\r\n\r\n")
+        try:
+            data = await self._backend_exchange(backend, probe)
+            ok = b" 200 " in data.split(b"\r\n", 1)[0]
+        except Exception:
+            ok = False
+        finally:
+            backend.probe_inflight = False
+        if ok:
+            backend.record_success()
+            self.readmitted += 1
+        else:
+            backend.record_failure(self.breaker_threshold, time.monotonic())
+
     # -- backend selection -------------------------------------------------
     def _pick(self, exclude: set) -> Optional[_Backend]:
         now = time.monotonic()
-        live = [b for b in self._backends
-                if b.address not in exclude and b.down_until <= now]
+        live = []
+        for b in self._backends:
+            self._maybe_probe(b, now)
+            if b.address not in exclude and b.state == "closed":
+                live.append(b)
         if not live:
             return None
         if self.policy == "round_robin":
@@ -526,12 +608,12 @@ class AsyncFrontDoor:
                                         backend=backend.address):
                         data = await self._backend_exchange(backend, request)
                     self.proxied += 1
+                    backend.record_success()
                     return data
                 except Exception:
                     tried.add(backend.address)
-                    backend.down_until = (time.monotonic()
-                                          + self.retry_backend_s)
-                    backend.cooldowns += 1
+                    backend.record_failure(self.breaker_threshold,
+                                           time.monotonic())
                     self.retried += 1
                 finally:
                     backend.inflight -= 1
@@ -553,13 +635,13 @@ class AsyncFrontDoor:
         seen_meta: set = set()
         now = time.monotonic()
         for b in self._backends:
-            if b.down_until > now:
+            self._maybe_probe(b, now)
+            if b.state != "closed":
                 continue
             try:
                 data = await self._backend_exchange(b, scrape)
             except Exception:
-                b.down_until = time.monotonic() + self.retry_backend_s
-                b.cooldowns += 1
+                b.record_failure(self.breaker_threshold, time.monotonic())
                 continue
             head, _, payload = data.partition(b"\r\n\r\n")
             if b" 200 " not in head.split(b"\r\n", 1)[0]:
@@ -596,6 +678,14 @@ class AsyncFrontDoor:
             out.append(f'photon_fd_backend_cooldowns_total'
                        f'{{backend="{escape_label_value(b.address)}"}} '
                        f'{b.cooldowns}')
+        out.append("# TYPE photon_fd_backend_state gauge")
+        for b in self._backends:
+            # 0 = closed (serving), 1 = half_open (probing), 2 = open
+            out.append(f'photon_fd_backend_state'
+                       f'{{backend="{escape_label_value(b.address)}"}} '
+                       f'{_BACKEND_STATE_NUM[b.state]}')
+        out.append("# TYPE photon_fd_readmitted_total counter")
+        out.append(f"photon_fd_readmitted_total {self.readmitted}")
         return "\n".join(out) + "\n"
 
     def stats(self) -> Dict[str, object]:
@@ -603,11 +693,13 @@ class AsyncFrontDoor:
             "policy": self.policy,
             "backends": [
                 {"address": b.address, "inflight": b.inflight,
-                 "down": b.down_until > time.monotonic(),
-                 "picked": b.picked, "cooldowns": b.cooldowns}
+                 "state": b.state, "down": b.state != "closed",
+                 "picked": b.picked, "cooldowns": b.cooldowns,
+                 "opened": b.opened}
                 for b in self._backends
             ],
             "proxied": self.proxied,
             "retried": self.retried,
             "unavailable": self.unavailable,
+            "readmitted": self.readmitted,
         }

@@ -41,12 +41,19 @@ class RegistryWatcher:
     identical intervals would have N processes stat the same files (and
     then all swap) on the same tick — jitter de-synchronizes the
     stampede while keeping every replica within one interval+jitter of a
-    promotion (the consistency the front door relies on)."""
+    promotion (the consistency the front door relies on).
+
+    Consecutive FAILED polls back off exponentially (jittered, capped at
+    ``error_backoff_max_s``) instead of hammering a down registry at the
+    fixed interval — N replicas polling a struggling shared filesystem
+    every tick is exactly the thundering herd that keeps it struggling.
+    The first successful poll resets the schedule."""
 
     def __init__(self, registry, session, interval_s: float = 10.0,
                  on_swap: Optional[Callable[[str], None]] = None,
                  on_error: Optional[Callable[[Exception], None]] = None,
-                 jitter_s: float = 0.0):
+                 jitter_s: float = 0.0,
+                 error_backoff_max_s: float = 300.0):
         self.registry = registry
         self.session = session
         self.interval_s = float(interval_s)
@@ -55,6 +62,14 @@ class RegistryWatcher:
         self.on_error = on_error
         self.errors = 0
         self.checks = 0
+        # jittered exponential backoff applied ONLY after failed polls;
+        # healthy ticks use interval_s + uniform jitter as before
+        from photon_ml_tpu.parallel.resilience import Backoff
+
+        self._error_backoff = Backoff(
+            base_s=self.interval_s, factor=2.0,
+            max_s=max(float(error_backoff_max_s), self.interval_s),
+            jitter=0.1)
         # stop() joins that expired (a poll wedged inside a swap);
         # counted + logged, mirroring producer_join_timeouts
         self.join_timeouts = 0
@@ -82,13 +97,34 @@ class RegistryWatcher:
             self.on_swap(latest)
         return latest
 
+    def _next_delay(self, rng) -> float:
+        """Sleep before the next poll: the plain jittered interval while
+        healthy, the escalating error backoff while the registry is
+        failing (split out so tests can drive the schedule without
+        sleeping)."""
+        if self._error_backoff.attempts:
+            return self._error_backoff.next_delay()
+        return self.interval_s + rng.uniform(0.0, self.jitter_s)
+
+    def _observe(self, before_errors: int) -> None:
+        if self.errors > before_errors:
+            if not self._error_backoff.attempts:
+                # enter backoff: the next delay is the SECOND rung (the
+                # first failed tick already waited one interval)
+                self._error_backoff.next_delay()
+        else:
+            self._error_backoff.reset()
+
     def _run(self) -> None:
         import random
 
         rng = random.Random(os.getpid())
-        while not self._stop.wait(self.interval_s
-                                  + rng.uniform(0.0, self.jitter_s)):
+        delay = self.interval_s + rng.uniform(0.0, self.jitter_s)
+        while not self._stop.wait(delay):
+            before = self.errors
             self.check_once()
+            self._observe(before)
+            delay = self._next_delay(rng)
 
     def start(self) -> "RegistryWatcher":
         if self._thread is not None:

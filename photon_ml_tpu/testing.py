@@ -187,7 +187,11 @@ class _SimGroup:
     """Shared N-way status-exchange rendezvous (generation-counted so
     consecutive barriers don't mix). A participant that never arrives
     starves the round; waiters raise WatchdogTimeout — the simulated
-    equivalent of a dead peer wedging a real allgather."""
+    equivalent of a dead peer wedging a real allgather. Deaths are
+    DECLARED by the runner supervisor when a simulated process's thread
+    exits (for any reason), so waiters fail a starved round immediately
+    instead of sitting out the full watchdog, and the elastic-recovery
+    rendezvous (:meth:`recover`) knows which peers can still arrive."""
 
     def __init__(self, n: int):
         self.n = n
@@ -195,6 +199,18 @@ class _SimGroup:
         self.gen = 0
         self.slots: Dict[int, Dict[int, int]] = {}
         self.results: Dict[int, List[int]] = {}
+        # ranks whose thread has exited (cleanly, dropped, or crashed);
+        # under fail-stop an exited rank can never deposit again
+        self.deaths: set = set()
+        # elastic recovery state: per-epoch survivor registration and the
+        # shrunk child group each completed epoch produced
+        self.recovery_epoch = 0
+        self.recovery_reg: Dict[int, dict] = {}
+        self.recovery_done: Dict[int, tuple] = {}
+        # (child_group, {parent_rank: child_rank}) per completed recovery
+        # — death declarations cascade into live children, and the runner
+        # verifies child traces at join
+        self.children: List[tuple] = []
         # per-rank collective event sequences, recorded at CALL time (a
         # process that dies inside a rendezvous still recorded its
         # intent) and verified at join by the collective-trace sanitizer
@@ -207,8 +223,21 @@ class _SimGroup:
         self.traces[rank].append(
             (op, current_collective_site(), describe_payload(payload)))
 
+    def declare_dead(self, rank: int) -> None:
+        """Mark ``rank``'s simulated process as gone (its thread exited).
+        Wakes every waiter — a round the dead rank never joined fails
+        immediately — and cascades into shrunk child groups so
+        post-recovery collectives learn about it too."""
+        with self.cond:
+            self.deaths.add(rank)
+            self.cond.notify_all()
+            children = list(self.children)
+        for child, rank_map in children:
+            if rank in rank_map:
+                child.declare_dead(rank_map[rank])
+
     def exchange(self, rank: int, code: int, timeout: float) -> List[int]:
-        from photon_ml_tpu.parallel.resilience import WatchdogTimeout
+        from photon_ml_tpu.parallel.resilience import CODE_ERROR, WatchdogTimeout
 
         deadline = time.monotonic() + timeout
         with self.cond:
@@ -221,19 +250,79 @@ class _SimGroup:
                 self.cond.notify_all()
                 return list(self.results[gen])
             while gen not in self.results:
+                # a declared-dead peer that never deposited can never
+                # complete this round: fail fast with the same taxonomy
+                # the watchdog would use, naming the dead ranks
+                dead_missing = sorted(self.deaths - set(slot))
+                if dead_missing:
+                    raise WatchdogTimeout(
+                        f"simulated peer process(es) {dead_missing} died "
+                        "before joining this collective round (fail-stop)",
+                        failed={r: CODE_ERROR for r in dead_missing})
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     missing = sorted(set(range(self.n)) - set(slot))
                     raise WatchdogTimeout(
                         f"simulated health barrier timed out after "
                         f"{timeout:.1f}s: processes {missing} never "
-                        "reported (fail-stop)")
+                        "reported (fail-stop)",
+                        failed={r: CODE_ERROR for r in missing})
                 self.cond.wait(remaining)
             return list(self.results[gen])
 
+    def recover(self, rank: int, payload, timeout: float):
+        """Surviving-set recovery rendezvous: every LIVE rank registers a
+        payload; when the registered set covers every not-declared-dead
+        rank, a shrunk child :class:`_SimGroup` is created once and every
+        survivor returns ``(survivor_ranks, payloads, child_group)`` —
+        survivor ranks sorted, payloads in that order, and each
+        survivor's child rank is its index in the sorted list. A live
+        rank that never registers starves the rendezvous; waiters raise
+        WatchdogTimeout (recovery itself is bounded, never a hang)."""
+        from photon_ml_tpu.parallel.resilience import CODE_ERROR, WatchdogTimeout
+
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            epoch = self.recovery_epoch
+            reg = self.recovery_reg.setdefault(epoch, {})
+            reg[rank] = payload
+            self.cond.notify_all()
+            while epoch not in self.recovery_done:
+                live = set(range(self.n)) - self.deaths
+                if set(reg) >= live:
+                    survivors = sorted(reg)
+                    child = _SimGroup(len(survivors))
+                    rank_map = {r: i for i, r in enumerate(survivors)}
+                    # a survivor that registered and then died before the
+                    # group formed is already gone: seed the child's
+                    # deaths so its first round fails fast
+                    child.deaths = {rank_map[r] for r in survivors
+                                    if r in self.deaths}
+                    self.children.append((child, rank_map))
+                    self.recovery_done[epoch] = (
+                        survivors, [reg[r] for r in survivors], child)
+                    self.recovery_epoch = epoch + 1
+                    self.cond.notify_all()
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    missing = sorted(live - set(reg))
+                    raise WatchdogTimeout(
+                        f"recovery rendezvous timed out after "
+                        f"{timeout:.1f}s: live processes {missing} never "
+                        "joined recovery",
+                        failed={r: CODE_ERROR for r in missing})
+                self.cond.wait(remaining)
+            return self.recovery_done[epoch]
+
 
 class ThreadTransport:
-    """One simulated process's endpoint onto a :class:`_SimGroup`."""
+    """One simulated process's endpoint onto a :class:`_SimGroup`.
+
+    Both allgather legs pass the ``transport.allgather`` fault site on
+    the way in — a crash schedule can kill a rank MID-COLLECTIVE (after
+    peers committed to the round, before this rank deposits), the
+    nastiest point in the fail-stop state space."""
 
     def __init__(self, group: _SimGroup, rank: int):
         self._group = group
@@ -246,6 +335,9 @@ class ThreadTransport:
         return self._group.n
 
     def allgather_status(self, code: int, timeout: float) -> List[int]:
+        from photon_ml_tpu.parallel import fault_injection
+
+        fault_injection.check("transport.allgather")
         self._group.record(self._rank, "status", code)
         return self._group.exchange(self._rank, code, timeout)
 
@@ -257,8 +349,25 @@ class ThreadTransport:
         stay SPMD-ordered exactly like the real runtime's in-order
         collective stream — and a peer that never arrives surfaces as
         WatchdogTimeout here too."""
+        from photon_ml_tpu.parallel import fault_injection
+
+        fault_injection.check("transport.allgather")
         self._group.record(self._rank, "payload", payload)
         return self._group.exchange(self._rank, payload, timeout)
+
+    def recover(self, payload, timeout: float):
+        """Elastic-recovery rendezvous over the surviving set: block until
+        every live rank registers, then return ``(survivor_ranks,
+        payloads, new_transport)`` where the new transport is this
+        process's endpoint onto the SHRUNK group (its rank is its index
+        in the sorted survivor list). Only the simulated transport
+        supports shrink — the production jax runtime cannot resize a
+        running job, which is why ``recovery.recovery_supported``
+        capability-gates on this method."""
+        survivors, payloads, child = self._group.recover(
+            self._rank, payload, timeout)
+        return (survivors, payloads,
+                ThreadTransport(child, survivors.index(self._rank)))
 
 
 def run_simulated_processes(n: int, fn: Callable, *,
@@ -315,6 +424,12 @@ def run_simulated_processes(n: int, fn: Callable, *,
             pass  # stays Dropped: this process reports nothing to anyone
         except BaseException as e:
             outcomes[rank] = e
+        finally:
+            # fail-stop bookkeeping: however this process ended, it will
+            # never deposit into another round — peers stuck waiting on
+            # it fail their round immediately instead of eating the full
+            # watchdog, and the recovery rendezvous stops expecting it
+            group.declare_dead(rank)
 
     leak_san = ThreadLeakSanitizer() if verify_thread_leaks else None
     if leak_san is not None:
@@ -345,14 +460,76 @@ def run_simulated_processes(n: int, fn: Callable, *,
         # guard reporting a local failure pairs its barrier with
         # whatever barrier the healthy peers reach next (tags differ
         # by design there), but op/payload-kind streams must align
-        # regardless.
-        clean = not any(isinstance(o, (BaseException, Dropped))
-                        for o in outcomes)
+        # regardless. A run that RECOVERED from an injected fault ends
+        # with clean outcomes while its traces contain such a pairing,
+        # so an armed fault plan also disables strict sites.
+        clean = (not any(isinstance(o, (BaseException, Dropped))
+                         for o in outcomes)
+                 and not fault_injection.installed())
         CollectiveTraceSanitizer.verify(
             group.traces, context=f"{n} simulated processes",
             strict_sites=clean)
+        # shrunk post-recovery groups carry their own collective streams;
+        # the prefix discipline (a dead rank stops early, never diverges)
+        # applies to each of them too
+        pending = list(group.children)
+        depth = 0
+        while pending:
+            child, _ = pending.pop()
+            depth += 1
+            CollectiveTraceSanitizer.verify(
+                child.traces,
+                context=f"recovery child group {depth} of {n} simulated "
+                        "processes",
+                strict_sites=False)
+            pending.extend(child.children)
     if lock_san is not None:
         lock_san.check()
     if leak_san is not None and not any_alive:
         leak_san.check()
     return outcomes
+
+
+def run_supervised_processes(n: int, fn: Callable, *,
+                             max_restarts: int = 2,
+                             backoff_s: float = 0.05,
+                             backoff_factor: float = 2.0,
+                             jitter: float = 0.1,
+                             sleep: Callable = time.sleep,
+                             **sim_kwargs) -> Tuple[list, int]:
+    """Whole-job respawn-with-backoff supervision over
+    :func:`run_simulated_processes` — the simulated equivalent of a pod
+    scheduler relaunching a failed multi-controller job. Each attempt
+    runs on a FRESH rendezvous group (the production jax runtime cannot
+    rejoin a single rank into a live SPMD job; restart granularity is
+    the job, which is exactly the drivers' resume-marker/exit-75
+    contract). A failed attempt (any exception or Dropped outcome)
+    respawns after a jittered exponential backoff, up to
+    ``max_restarts`` restarts.
+
+    ``fn`` may accept ``(rank)`` or ``(rank, attempt)`` — the attempt
+    index lets a driver-style body enable ``--auto-resume`` behavior on
+    respawns. Returns ``(outcomes, attempts)`` where ``outcomes`` is the
+    LAST attempt's outcome vector."""
+    import inspect
+
+    from photon_ml_tpu.parallel.resilience import Backoff
+
+    try:
+        params = inspect.signature(fn).parameters
+        wants_attempt = len(params) >= 2
+    except (TypeError, ValueError):
+        wants_attempt = False
+    backoff = Backoff(base_s=backoff_s, factor=backoff_factor,
+                      max_s=60.0, jitter=jitter)
+    attempts = 0
+    while True:
+        a = attempts
+        call = (lambda rank: fn(rank, a)) if wants_attempt else fn
+        outcomes = run_simulated_processes(n, call, **sim_kwargs)
+        attempts += 1
+        failed = any(isinstance(o, (BaseException, Dropped))
+                     for o in outcomes)
+        if not failed or attempts > max_restarts:
+            return outcomes, attempts
+        sleep(backoff.next_delay())

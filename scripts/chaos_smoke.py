@@ -1,0 +1,159 @@
+"""CI chaos smoke: one injected rank kill, recover in-job, bit parity.
+
+The ci_lint.sh exit-13 leg. A 4-rank simulated entity-sharded GAME fit
+has rank 2 drop-killed mid-sweep by a crash schedule; the gate is the
+tentpole contract end to end — the three survivors reform onto a
+3-shard owner map, replay from the last committed per-sweep snapshot,
+and finish with f64 coefficients BIT-identical to an uninterrupted
+4-rank run, with each survivor reporting exactly one recovery. Any
+survivor exception, parity drift, or a hang (the barrier watchdog plus
+the join timeout bound every wait) exits nonzero.
+
+Deliberately tiny (24 entities, 4 features) and lbfgs-only so the leg
+costs seconds: the exhaustive every-site sweep lives in tier-1
+(tests/test_recovery.py::test_chaos_crash_schedule_every_site); this
+leg only proves the recovery path still wires together on the real
+descent loop.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PHOTON_ML_TPU_BARRIER_TIMEOUT_S", "60")
+
+N_RANKS = 4
+VICTIM = 2
+N_SWEEPS = 4
+N_ENTITIES, ROWS_PER_ENTITY, D_G, D_U = 24, 4, 4, 6
+
+
+def _make_dataset(seed=0):
+    import numpy as np
+
+    from photon_ml_tpu.game.descent import make_game_dataset
+
+    rng = np.random.default_rng(seed)
+    w_fixed = rng.normal(size=D_G)
+    U = rng.normal(size=(N_ENTITIES, D_U))
+    Xg, Xu, y, uid = [], [], [], []
+    for u in range(N_ENTITIES):
+        xg = rng.normal(size=(ROWS_PER_ENTITY, D_G))
+        xu = rng.normal(size=(ROWS_PER_ENTITY, D_U))
+        marg = xg @ w_fixed + xu @ U[u]
+        y.append((rng.random(ROWS_PER_ENTITY)
+                  < 1 / (1 + np.exp(-marg))).astype(float))
+        Xg.append(xg)
+        Xu.append(xu)
+        uid.append(np.full(ROWS_PER_ENTITY, u))
+    Xg, Xu, y, uid = map(np.concatenate, (Xg, Xu, y, uid))
+    return make_game_dataset({"g": Xg, "u": Xu}, y,
+                             entity_ids={"userId": uid})
+
+
+def _configs():
+    from photon_ml_tpu.game.descent import CoordinateConfig
+
+    # lbfgs RE solver: bit-invariant to the survivor layout's bucket
+    # widths, so parity after the 4->3 shard reform is exact
+    return [
+        CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                         reg_weight=2.0, tolerance=1e-10, max_iters=40),
+        CoordinateConfig("per-user", coordinate_type="random",
+                         feature_shard="u", entity_column="userId",
+                         reg_type="l2", reg_weight=2.0, tolerance=1e-9,
+                         max_iters=40, num_buckets=2, optimizer="lbfgs",
+                         active_set=True, refresh_every=3,
+                         active_tol=1e-10),
+    ]
+
+
+def _fit(ds, rank, recovery):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.descent import CoordinateDescent
+    from photon_ml_tpu.parallel.entity_shard import EntityShardSpec
+
+    cd = CoordinateDescent(
+        _configs(), task="logistic", n_iterations=N_SWEEPS,
+        dtype=jnp.float64,
+        entity_shard=EntityShardSpec(N_RANKS, rank), recovery=recovery)
+    model, _history = cd.run(ds)
+    return model, recovery.stats["recoveries"]
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    from photon_ml_tpu.parallel import fault_injection as fi
+    from photon_ml_tpu.parallel.recovery import RecoveryManager
+    from photon_ml_tpu.testing import Dropped, run_simulated_processes
+
+    ds = _make_dataset()
+
+    with tempfile.TemporaryDirectory() as td:
+        clean = run_simulated_processes(
+            N_RANKS,
+            lambda rank: _fit(ds, rank, RecoveryManager(
+                os.path.join(td, "clean"), max_rank_failures=1,
+                backoff_s=0.01, jitter=0.0)),
+            join_timeout=300)
+        bad = [o for o in clean if isinstance(o, (BaseException, Dropped))]
+        if bad:
+            print(f"chaos smoke: clean run failed: {bad!r}", file=sys.stderr)
+            return 1
+        ref_fixed = np.asarray(
+            clean[0][0].coordinates["fixed"].model.coefficients.means)
+
+        # kill the victim inside sweep 1's per-user step (cd.step fires
+        # twice per sweep: occurrence 2*s is fixed, 2*s+1 per-user)
+        fi.install(fi.crash_schedule((VICTIM, "cd.step", 3)))
+        try:
+            outs = run_simulated_processes(
+                N_RANKS,
+                lambda rank: _fit(ds, rank, RecoveryManager(
+                    os.path.join(td, "crashed"), max_rank_failures=1,
+                    backoff_s=0.01, jitter=0.0)),
+                join_timeout=300)
+        finally:
+            fi.clear()
+
+    ok = True
+    if not isinstance(outs[VICTIM], (BaseException, Dropped)):
+        print(f"chaos smoke: victim rank {VICTIM} survived its own kill: "
+              f"{outs[VICTIM]!r}", file=sys.stderr)
+        ok = False
+    for rank, out in enumerate(outs):
+        if rank == VICTIM:
+            continue
+        if isinstance(out, (BaseException, Dropped)):
+            print(f"chaos smoke: survivor rank {rank} did not recover: "
+                  f"{out!r}", file=sys.stderr)
+            ok = False
+            continue
+        model, recoveries = out
+        if recoveries < 1:
+            print(f"chaos smoke: rank {rank} reported {recoveries} "
+                  "recoveries (expected >= 1)", file=sys.stderr)
+            ok = False
+        got = np.asarray(
+            model.coordinates["fixed"].model.coefficients.means)
+        drift = float(np.max(np.abs(got - ref_fixed)))
+        if drift != 0.0:
+            print(f"chaos smoke: rank {rank} fixed-effect drift "
+                  f"{drift:.3e} (expected bit parity)", file=sys.stderr)
+            ok = False
+    if ok:
+        print(f"chaos smoke: OK (rank {VICTIM} killed mid-sweep, "
+              f"{N_RANKS - 1} survivors recovered to bit parity)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

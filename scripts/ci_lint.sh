@@ -10,6 +10,9 @@
 #  12   the photon-trace smoke failed: the tracer, the simulated
 #       multi-process harness, or the rank-merge/validate pipeline
 #       (obs/trace_cli.py smoke) regressed
+#  13   the chaos smoke failed: a 4-rank simulated fit with one rank
+#       drop-killed mid-sweep no longer recovers in-job to bit parity
+#       (scripts/chaos_smoke.py — the fail-recover tentpole contract)
 cd "$(dirname "$0")/.."
 set -o pipefail
 
@@ -49,5 +52,8 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli --lock-graph
 echo "== photon-check fault-site audit =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
     --fault-sites || exit 10
+
+echo "== chaos smoke (4-rank fit, one rank killed, in-job recovery) =="
+env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 13
 
 echo "ci_lint OK"
