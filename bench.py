@@ -65,9 +65,19 @@ def _environment() -> dict:
         rec_sup = bool(recovery_supported())
     except Exception:
         rec_sup = None
+    # whether the serving stack carries the degraded-scoring ladder
+    # (ScoreContext + brownout controller): True once serve/brownout.py
+    # and the ctx-aware session are importable, None on older trees
+    try:
+        from photon_ml_tpu.serve import BrownoutController, ScoreContext
+
+        deg_sup = bool(BrownoutController and ScoreContext)
+    except Exception:
+        deg_sup = None
     return {
         "cpu_cores": os.cpu_count() or 1,
         "recovery_supported": rec_sup,
+        "degraded_serving_supported": deg_sup,
         "jax_version": jax.__version__,
         "platform": devs[0].platform,
         "device_kind": getattr(devs[0], "device_kind", ""),
@@ -747,6 +757,387 @@ def serving_main() -> None:
               "compile misses incl. mid-load swap, shed-not-5xx "
               "overload)", file=sys.stderr)
         sys.exit(7)
+
+
+def degrade_main() -> None:
+    """``python bench.py degrade`` — brownout posture under a slow store.
+
+    Two legs over one synthetic GAME model:
+
+    * ``storm_sweep`` — an offered-load sweep (the serving bench's
+      open-loop methodology) against ONE in-process replica whose
+      coefficient store is fault-injected with ``kind="delay"`` latency
+      on every cold load. The service carries a default deadline and a
+      brownout controller, so the ladder — not an error path — absorbs
+      the slow store: the leg records availability (non-5xx fraction),
+      the degraded fraction per ladder level (parsed from response
+      bodies, cross-checked against ``degraded_total`` metrics), p50/p99,
+      and the stage-labelled deadline-drop counters. A faults-off
+      control phase runs first and must show ZERO degraded responses.
+    * ``hedging`` — two real-socket replicas behind the HTTP front
+      door (round-robin, so the slow replica cannot hide behind
+      least-loaded dispatch). After a both-fast warm phase seeds the
+      per-backend latency histograms, one replica's score path is made
+      slow; p99 is measured with hedging ON (duplicate fired at the
+      primary's observed p99, first response wins) and then OFF. The
+      contract under one slow replica: hedged p99 <= 2x the healthy
+      baseline p99 (factor via BENCH_DEGRADE_HEDGE_FACTOR).
+
+    ``BENCH_DEGRADE_SMOKE=1`` shrinks both legs for CI and enforces the
+    acceptance gate (exit 11): 100% availability under the storm with a
+    nonzero degraded fraction, zero degraded responses with faults off,
+    and the hedging bound. Writes ``BENCH_degrade.json``."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import asyncio
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.utils import apply_env_platforms
+
+    apply_env_platforms()
+    import numpy as np
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        make_game_dataset,
+    )
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.parallel import fault_injection
+    from photon_ml_tpu.parallel.fault_injection import Fault
+    from photon_ml_tpu.serve import (
+        AsyncFrontDoor,
+        AsyncScoringServer,
+        BrownoutController,
+        MicroBatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    smoke = os.environ.get("BENCH_DEGRADE_SMOKE") == "1"
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    rng = np.random.default_rng(0)
+    n, d_fix, d_re, n_entities = 400, 16, 8, 64
+    Xg = rng.normal(size=(n, d_fix))
+    Xu = rng.normal(size=(n, d_re))
+    uid = rng.integers(0, n_entities, n)
+    y = (rng.random(n) < 0.5).astype(float)
+    ds = make_game_dataset({"g": Xg, "u": Xu}, y,
+                           entity_ids={"userId": uid})
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                          reg_weight=1.0),
+         CoordinateConfig("per-user", coordinate_type="random",
+                          feature_shard="u", entity_column="userId",
+                          reg_type="l2", reg_weight=1.0)],
+        task="logistic")
+    model, _ = cd.run(ds)
+    root = tempfile.mkdtemp(prefix="bench-degrade-")
+    model_dir = os.path.join(root, "model")
+    save_game_model(model, model_dir, {
+        "g": IndexMap({f"g{j}": j for j in range(d_fix)}),
+        "u": IndexMap({f"u{j}": j for j in range(d_re)}),
+    })
+
+    max_batch = 16
+    req_rows = 8
+
+    def make_row(i):
+        return {
+            "features": (
+                [{"name": f"g{j}", "value": float(Xg[i % n, j])}
+                 for j in range(d_fix)]
+                + [{"name": f"u{j}", "value": float(Xu[i % n, j])}
+                   for j in range(d_re)]),
+            "entityIds": {"userId": str(uid[i % n])},
+        }
+
+    payloads = [{"rows": [make_row(i * req_rows + j)
+                          for j in range(req_rows)]}
+                for i in range(32)]
+
+    # -- leg 1: store-latency storm sweep on the degradation ladder --------
+    # Host-LRU path with a cache far smaller than the entity universe so
+    # cold store loads never stop; a delay fault on every load models the
+    # brownout-triggering slow store (a raise-storm is the chaos suite's
+    # job — the bench measures the LADDER, not the error path).
+    store_delay_s = 0.05 if smoke else 0.1
+    deadline_ms = 40.0
+    session = ScoringSession(model_dir, max_batch=max_batch,
+                             coeff_cache_entries=8, paged_table=False)
+    brown = BrownoutController(enter_ms={1: 25.0, 2: 100.0},
+                               metrics=session.metrics)
+    batcher = MicroBatcher(session.score_rows, max_batch=max_batch,
+                           max_delay_ms=0.5, max_queue=64,
+                           metrics=session.metrics, brownout=brown)
+    svc = ScoringService(session, batcher, request_timeout_s=30.0,
+                         default_deadline_ms=deadline_ms, brownout=brown)
+
+    def degrade_loop(rate_rows_s, duration_s):
+        """Fixed-interval offered load via score_async, counting the
+        ladder level of every accepted response body."""
+        server = AsyncScoringServer(svc)
+
+        async def run():
+            interval = req_rows / rate_rows_s
+            res = {"ok": 0, "shed": 0, "errors_5xx": 0, "other": 0,
+                   "lat": [], "levels": {0: 0, 1: 0, 2: 0}}
+            tasks = []
+
+            async def fire(payload):
+                t0 = time.perf_counter()
+                status, body = await server.score_async(payload)
+                ms = (time.perf_counter() - t0) * 1e3
+                if status == 200:
+                    res["ok"] += 1
+                    res["lat"].append(ms)
+                    lvl = int((body or {}).get("degraded", 0))
+                    res["levels"][lvl] = res["levels"].get(lvl, 0) + 1
+                elif status == 429:
+                    res["shed"] += 1
+                elif status >= 500:
+                    res["errors_5xx"] += 1
+                else:
+                    res["other"] += 1
+
+            loop = asyncio.get_running_loop()
+            t_start = loop.time()
+            t_next = t_start
+            i = 0
+            while loop.time() - t_start < duration_s:
+                tasks.append(asyncio.ensure_future(
+                    fire(payloads[i % len(payloads)])))
+                i += 1
+                t_next += interval
+                delay = t_next - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+            await asyncio.gather(*tasks)
+            return res
+
+        r = asyncio.run(run())
+        total = r["ok"] + r["shed"] + r["errors_5xx"] + r["other"]
+        lat = sorted(r["lat"]) or [0.0]
+        degraded = sum(v for k, v in r["levels"].items() if k >= 1)
+        return {
+            "offered_rows_per_s": rate_rows_s,
+            "requests_total": total,
+            "requests_ok": r["ok"],
+            "requests_shed": r["shed"],
+            "requests_5xx": r["errors_5xx"],
+            "availability": round(
+                (total - r["errors_5xx"]) / total, 4) if total else None,
+            "degraded_fraction": round(degraded / r["ok"], 4)
+            if r["ok"] else None,
+            "degraded_by_level": {str(k): v
+                                  for k, v in sorted(r["levels"].items())},
+            "accepted_p50_ms": round(lat[len(lat) // 2], 3),
+            "accepted_p99_ms": round(lat[min(len(lat) - 1,
+                                             int(len(lat) * 0.99))], 3),
+        }
+
+    duration = float(os.environ.get(
+        "BENCH_DEGRADE_DURATION_S", 0.8 if smoke else 2.0))
+
+    # control: faults OFF — the ladder must stay untouched
+    svc.handle_score(payloads[0])  # warm the compile ladder
+    snap0 = svc.metrics.snapshot()
+    control = degrade_loop(2_000, duration)
+    control["degraded_total_metric"] = (
+        svc.metrics.snapshot()["degraded_total"]
+        - snap0["degraded_total"])
+
+    # prime the session's fault-cost EWMA with the slow store visible so
+    # the first measured request already knows a cold load costs more
+    # than the deadline budget
+    fault_injection.install([Fault("store.load", kind="delay",
+                                   delay_s=store_delay_s, at=-1)])
+    try:
+        session.score_rows(payloads[0]["rows"])
+        storm = []
+        rates = [2_000, 6_000] if smoke else [2_000, 6_000, 12_000]
+        for rate in rates:
+            s0 = svc.metrics.snapshot()
+            leg = degrade_loop(rate, duration)
+            s1 = svc.metrics.snapshot()
+            leg["degraded_total_metric"] = (s1["degraded_total"]
+                                            - s0["degraded_total"])
+            leg["brownout_level_after"] = s1["brownout_level"]
+            storm.append(leg)
+    finally:
+        fault_injection.clear()
+    storm_snap = svc.metrics.snapshot()
+    deadline_drops = {
+        "admission": storm_snap["deadline_drops_admission"],
+        "queue": storm_snap["deadline_drops_queue"],
+        "pre_compute": storm_snap["deadline_drops_pre_compute"],
+    }
+    svc.close()
+
+    # -- leg 2: hedged tail latency under one slow replica -----------------
+    slow_s = 0.15 if smoke else 0.3
+    blip_s = 0.017   # ambient healthy-tail blip (GC-pause stand-in) on
+    blip_every = 8   # every Nth batch of the to-be-slowed replica: the
+    slow_gate = {"s": 0.0}   # healthy baseline needs the p99 >> p50
+    # dispersion the hedge trigger is calibrated against — a perfectly
+    # uniform synthetic baseline would measure the bucket quantizer, not
+    # the policy
+
+    def make_replica(slow=False):
+        sess = ScoringSession(model_dir, max_batch=max_batch,
+                              coeff_cache_entries=n_entities,
+                              paged_table=True)
+        calls = {"n": 0}
+
+        def score(rows, per_coordinate=False, ctx=None):
+            if slow:
+                calls["n"] += 1
+                if calls["n"] % blip_every == 0:
+                    time.sleep(blip_s)
+                if slow_gate["s"] > 0:
+                    time.sleep(slow_gate["s"])
+            return sess.score_rows(rows, per_coordinate, ctx=ctx)
+
+        b = MicroBatcher(score, max_batch=max_batch, max_delay_ms=0.5,
+                         metrics=sess.metrics)
+        return ScoringService(sess, b, request_timeout_s=30.0)
+
+    svc_fast = make_replica()
+    svc_slow = make_replica(slow=True)
+    for s in (svc_fast, svc_slow):
+        s.handle_score(payloads[0])
+
+    async def door_request(door, payload):
+        reader, writer = await asyncio.open_connection(door.host,
+                                                       door.port)
+        body = json.dumps(payload).encode()
+        writer.write((f"POST /score HTTP/1.1\r\nHost: bench\r\n"
+                      f"Content-Type: application/json\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n").encode()
+                     + body)
+        await writer.drain()
+        head = await reader.readuntil(b"\r\n\r\n")
+        status = int(head.split(b" ", 2)[1])
+        length = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":")[1])
+        if length:
+            await reader.readexactly(length)
+        writer.close()
+        return status
+
+    def p99(lat):
+        lat = sorted(lat) or [0.0]
+        return round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3)
+
+    hedge_info = {}
+
+    async def hedging_leg():
+        srv_fast = await AsyncScoringServer(svc_fast).start()
+        srv_slow = await AsyncScoringServer(svc_slow).start()
+        door = await AsyncFrontDoor(
+            [f"127.0.0.1:{srv_fast.port}", f"127.0.0.1:{srv_slow.port}"],
+            policy="round_robin", hedge_enabled=False,
+            hedge_min_s=0.002, hedge_min_samples=10).start()
+        reps = 24 if smoke else 64
+
+        async def measure(n_req):
+            lat, bad = [], 0
+            for i in range(n_req):
+                t0 = time.perf_counter()
+                status = await door_request(door,
+                                            payloads[i % len(payloads)])
+                lat.append((time.perf_counter() - t0) * 1e3)
+                if status != 200:
+                    bad += 1
+            return lat, bad
+
+        try:
+            # both replicas healthy, hedging OFF: warms every breaker's
+            # latency histogram past hedge_min_samples AND measures the
+            # healthy baseline tail unmasked (hedging left on here would
+            # quietly clip the very blips the baseline must contain)
+            base_lat, base_bad = await measure(max(reps, 40))
+            # one replica slow, hedging ON (runs before the no-hedge
+            # phase: hedge losers are cancelled before note_latency, so
+            # the slow replica's histogram — the hedge trigger — keeps
+            # its healthy p99)
+            door.hedge_enabled = True
+            slow_gate["s"] = slow_s
+            hedge_lat, hedge_bad = await measure(reps)
+            hedged, wins = door.hedged, door.hedge_wins
+            # same slow replica, hedging OFF: the unprotected tail
+            door.hedge_enabled = False
+            nohedge_lat, nohedge_bad = await measure(reps)
+        finally:
+            slow_gate["s"] = 0.0
+            await door.aclose()
+            await srv_fast.aclose()
+            await srv_slow.aclose()
+        hedge_info.update({
+            "slow_replica_delay_ms": slow_s * 1e3,
+            "baseline_p99_ms": p99(base_lat),
+            "hedged_p99_ms": p99(hedge_lat),
+            "no_hedge_p99_ms": p99(nohedge_lat),
+            "hedged_fired": hedged,
+            "hedge_wins": wins,
+            "non_200s": base_bad + hedge_bad + nohedge_bad,
+        })
+
+    asyncio.run(hedging_leg())
+    svc_fast.close()
+    svc_slow.close()
+
+    hedge_factor = float(os.environ.get("BENCH_DEGRADE_HEDGE_FACTOR",
+                                        2.0))
+    storm_available = all(s["availability"] == 1.0 for s in storm)
+    storm_degraded = any((s["degraded_fraction"] or 0) > 0
+                         and s["degraded_total_metric"] > 0
+                         for s in storm)
+    control_clean = (control["degraded_fraction"] == 0.0
+                     and control["degraded_total_metric"] == 0)
+    hedge_bound = (hedge_info["hedged_p99_ms"]
+                   <= hedge_factor * hedge_info["baseline_p99_ms"]
+                   and hedge_info["hedged_p99_ms"]
+                   < hedge_info["no_hedge_p99_ms"]
+                   and hedge_info["non_200s"] == 0)
+    ok = storm_available and storm_degraded and control_clean and hedge_bound
+    record = {
+        "environment": _environment(),
+        "metric": "degraded_serving_availability_under_store_delay",
+        "value": min((s["availability"] for s in storm), default=0.0),
+        "unit": (f"non-5xx fraction under {store_delay_s * 1e3:.0f}ms "
+                 f"store.load delay faults, {deadline_ms:.0f}ms default "
+                 f"deadline, host-LRU cache 8/{n_entities} entities "
+                 "(degraded levels absorb the slow store; hedging leg "
+                 "in fields)"),
+        "store_delay_ms": store_delay_s * 1e3,
+        "default_deadline_ms": deadline_ms,
+        "control_faults_off": control,
+        "storm_sweep": storm,
+        "deadline_drops_by_stage": deadline_drops,
+        "hedging": hedge_info,
+        "acceptance_ok": ok,
+        "acceptance_criteria": {
+            "storm_availability_1_0": storm_available,
+            "storm_serves_degraded": storm_degraded,
+            "faults_off_zero_degraded": control_clean,
+            f"hedged_p99_within_{hedge_factor:g}x_baseline_and_below_"
+            "no_hedge": hedge_bound,
+        },
+    }
+    with open(os.path.join(here, "BENCH_degrade.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+    shutil.rmtree(root, ignore_errors=True)
+    if smoke and not ok:
+        print("degrade bench acceptance FAILED (storm availability, "
+              "degraded fraction, faults-off control, hedged p99 bound)",
+              file=sys.stderr)
+        sys.exit(11)
 
 
 def swap_main() -> None:
@@ -1926,7 +2317,9 @@ def _baseline() -> "tuple[float, str] | None":
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+    if len(sys.argv) > 1 and sys.argv[1] == "degrade":
+        degrade_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "swap":
         swap_main()

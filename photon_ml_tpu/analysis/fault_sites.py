@@ -6,9 +6,10 @@ EXERCISABLE. That promise decays silently: a new site with no test is
 dead code until the first real outage. The audit closes the loop:
 
 * **registered sites** — every string literal passed to
-  ``fault_injection.check("...")`` / ``fault_injection.mangle_payload
-  ("...", ...)`` in the package (AST scan, so dynamically-composed
-  site names do not count — keep site names literal);
+  ``fault_injection.check("...")`` / ``fault_injection.async_check``
+  / ``fault_injection.mangle_payload("...", ...)`` in the package
+  (AST scan, so dynamically-composed site names do not count — keep
+  site names literal);
 * **exercised sites** — every registered site name appearing as a
   string literal anywhere under ``tests/`` (covers direct
   ``Fault(site=...)`` construction, parametrize tables, and env-plan
@@ -31,7 +32,7 @@ from photon_ml_tpu.analysis.core import iter_python_files, parse_module
 __all__ = ["FaultSiteAudit", "audit_fault_sites", "registered_sites",
            "exercised_sites"]
 
-_INJECTION_FUNCS = {"check", "mangle_payload"}
+_INJECTION_FUNCS = {"check", "async_check", "mangle_payload"}
 
 
 def registered_sites(package_root: str) -> Dict[str, Tuple[str, int]]:

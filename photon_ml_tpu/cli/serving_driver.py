@@ -105,6 +105,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-deadline-s", type=float, default=0.0,
                    help="> 0 sheds requests still queued after this long "
                         "(429 cause=deadline) instead of scoring them")
+    p.add_argument("--default-deadline-ms", type=float, default=0.0,
+                   help="> 0 gives requests WITHOUT an X-Deadline-Ms "
+                        "header this budget; expired requests drop at "
+                        "the cheapest stage (429 cause=deadline)")
+    p.add_argument("--brownout", action="store_true",
+                   help="enable the brownout controller: sustained "
+                        "queue-wait overload raises the default "
+                        "degraded-scoring level (resident-only, then "
+                        "fixed-effect-only) before any 429 shedding")
+    p.add_argument("--brownout-l1-ms", type=float, default=50.0,
+                   help="queue-wait EWMA (ms) at which brownout level 1 "
+                        "(resident-coefficients-only) engages")
+    p.add_argument("--brownout-l2-ms", type=float, default=200.0,
+                   help="queue-wait EWMA (ms) at which brownout level 2 "
+                        "(fixed-effect-only) engages")
+    p.add_argument("--hedge", action="store_true",
+                   help="multi-replica front door: duplicate a request "
+                        "onto a second replica when the first exceeds "
+                        "its observed p99 (first answer wins)")
+    p.add_argument("--hedge-min-ms", type=float, default=50.0,
+                   help="floor on the hedge trigger delay")
     p.add_argument("--watchdog-s", type=float, default=60.0,
                    help="stuck-batch watchdog; <= 0 disables")
     p.add_argument("--request-timeout-s", type=float, default=30.0)
@@ -157,15 +178,27 @@ def build_service(args):
         re_page_rows=getattr(args, "re_page_rows", 256),
         re_dense_dim_max=getattr(args, "re_dense_dim_max", 4096))
     deadline = getattr(args, "queue_deadline_s", 0.0)
+    brownout = None
+    if getattr(args, "brownout", False):
+        from photon_ml_tpu.serve import BrownoutController
+
+        brownout = BrownoutController(
+            enter_ms={1: getattr(args, "brownout_l1_ms", 50.0),
+                      2: getattr(args, "brownout_l2_ms", 200.0)},
+            metrics=session.metrics)
     batcher = MicroBatcher(
         session.score_rows, max_batch=args.max_batch,
         max_delay_ms=args.max_delay_ms, max_queue=args.max_queue,
         watchdog_s=(None if args.watchdog_s <= 0 else args.watchdog_s),
         request_deadline_s=(deadline if deadline > 0 else None),
-        metrics=session.metrics)
+        metrics=session.metrics, brownout=brownout)
+    default_ms = getattr(args, "default_deadline_ms", 0.0)
     service = ScoringService(session, batcher,
                              request_timeout_s=args.request_timeout_s,
-                             registry=registry)
+                             registry=registry,
+                             default_deadline_ms=(
+                                 default_ms if default_ms > 0 else None),
+                             brownout=brownout)
     return service, registry
 
 
@@ -299,6 +332,9 @@ def _replica_argv(args, port: int, log_dir: str) -> list:
             "--re-page-rows", str(args.re_page_rows),
             "--re-dense-dim-max", str(args.re_dense_dim_max),
             "--queue-deadline-s", str(args.queue_deadline_s),
+            "--default-deadline-ms", str(args.default_deadline_ms),
+            "--brownout-l1-ms", str(args.brownout_l1_ms),
+            "--brownout-l2-ms", str(args.brownout_l2_ms),
             "--watchdog-s", str(args.watchdog_s),
             "--request-timeout-s", str(args.request_timeout_s),
             "--drain-timeout-s", str(args.drain_timeout_s),
@@ -312,6 +348,8 @@ def _replica_argv(args, port: int, log_dir: str) -> list:
                  "--trace-sample", str(args.trace_sample)]
     if args.no_paged_table:
         argv.append("--no-paged-table")
+    if args.brownout:
+        argv.append("--brownout")
     if args.registry:
         argv += ["--registry", args.registry]
         if args.model_version:
@@ -368,7 +406,9 @@ def _run_multi_replica(args, logger) -> int:
         return 1
     door = AsyncFrontDoor([f"{args.host}:{p}" for p in ports],
                           host=args.host, port=args.port,
-                          policy=args.front_door_policy)
+                          policy=args.front_door_policy,
+                          hedge_enabled=args.hedge,
+                          hedge_min_s=args.hedge_min_ms / 1e3)
 
     def ready(d):
         logger.log("front_door_ready", host=d.host, port=d.port,

@@ -71,16 +71,23 @@ class Histogram:
         self.sum += value
 
     def quantile(self, q: float) -> float:
-        """Approximate quantile from bucket boundaries (upper bound of the
-        bucket the rank lands in; +Inf bucket reports the last bound)."""
+        """Approximate quantile: linear interpolation within the bucket
+        the rank lands in (prometheus ``histogram_quantile`` semantics —
+        the old upper-bound answer overstated by up to a full bucket
+        ratio, which made any policy keyed on an observed quantile, e.g.
+        the front door's hedge trigger, fire a bucket late). The +Inf
+        overflow bucket still reports the last finite bound."""
         if self.total == 0:
             return 0.0
         rank = q * self.total
         seen = 0
+        lo = 0.0
         for j, b in enumerate(self.bounds):
+            if self.counts[j] and seen + self.counts[j] >= rank:
+                frac = (rank - seen) / self.counts[j]
+                return lo + frac * (b - lo)
             seen += self.counts[j]
-            if seen >= rank:
-                return b
+            lo = b
         return self.bounds[-1] if self.bounds else float("inf")
 
     def render(self, name: str, out: List[str],
@@ -341,7 +348,18 @@ class ServingMetrics:
         carries the active version, the standard prometheus idiom for
         string-valued state);
       gate_{pass,fail}_total — promotion-gate verdicts observed by this
-        process (the gate tool and the reload path record here).
+        process (the gate tool and the reload path record here);
+      degraded_total{level} — requests served below full fidelity by the
+        brownout ladder (level 1 = resident-coefficients-only, level 2 =
+        fixed-effect-only margin); zero whenever faults/overload are off;
+      deadline_drop_total{stage} — requests dropped because their budget
+        expired, labelled by the CHEAPEST stage that caught it
+        (admission / queue / pre_compute — never after device compute);
+      brownout_level — gauge, the controller's current DEFAULT ladder
+        level (0 healthy; raised under sustained queue-wait overload);
+      model_staleness_seconds — gauge, how long the live model has been
+        serving without a confirmed-fresh registry poll (rises while the
+        watcher pins the old version through registry failures).
     """
 
     def __init__(self):
@@ -377,6 +395,13 @@ class ServingMetrics:
         self.active_version = ""
         self.gate_pass_total = 0
         self.gate_fail_total = 0
+        # brownout ladder + deadline budget accounting (serve/brownout.py,
+        # batcher deadline propagation, watcher staleness pinning)
+        self.degraded_total: Dict[int, int] = {1: 0, 2: 0}
+        self.deadline_drops: Dict[str, int] = {
+            "admission": 0, "queue": 0, "pre_compute": 0}
+        self.brownout_level = 0
+        self.model_staleness_s = 0.0
 
     # -- recording sites ---------------------------------------------------
     def record_request(self, rows: int, latency_ms: float,
@@ -453,6 +478,32 @@ class ServingMetrics:
             else:
                 self.gate_fail_total += 1
 
+    def record_degraded(self, level: int, n: int = 1) -> None:
+        """A request was served below full fidelity at ladder ``level``
+        (1 = resident-only, 2 = fixed-effect-only). Level 0 is a no-op so
+        callers can record unconditionally."""
+        if level <= 0:
+            return
+        with self._lock:
+            self.degraded_total[int(level)] = (
+                self.degraded_total.get(int(level), 0) + int(n))
+
+    def record_deadline_drop(self, stage: str) -> None:
+        """A request's deadline budget expired and it was dropped at
+        ``stage`` (admission / queue / pre_compute) — always BEFORE any
+        device compute was spent on it."""
+        with self._lock:
+            self.deadline_drops[stage] = (
+                self.deadline_drops.get(stage, 0) + 1)
+
+    def set_brownout_level(self, level: int) -> None:
+        with self._lock:
+            self.brownout_level = int(level)
+
+    def set_model_staleness(self, seconds: float) -> None:
+        with self._lock:
+            self.model_staleness_s = float(seconds)
+
     # -- views -------------------------------------------------------------
     @staticmethod
     def _rate(hits: int, misses: int) -> float:
@@ -498,6 +549,17 @@ class ServingMetrics:
                 "active_version": self.active_version,
                 "gate_pass_total": self.gate_pass_total,
                 "gate_fail_total": self.gate_fail_total,
+                "degraded_total": sum(self.degraded_total.values()),
+                "degraded_level1_total": self.degraded_total.get(1, 0),
+                "degraded_level2_total": self.degraded_total.get(2, 0),
+                "deadline_drops_total": sum(self.deadline_drops.values()),
+                "deadline_drops_admission":
+                    self.deadline_drops.get("admission", 0),
+                "deadline_drops_queue": self.deadline_drops.get("queue", 0),
+                "deadline_drops_pre_compute":
+                    self.deadline_drops.get("pre_compute", 0),
+                "brownout_level": self.brownout_level,
+                "model_staleness_s": self.model_staleness_s,
             }
 
     def render(self) -> str:
@@ -558,4 +620,25 @@ class ServingMetrics:
                 f'photon_serve_active_version_info{{version="{label}"}} 1')
             counter("photon_serve_gate_pass_total", self.gate_pass_total)
             counter("photon_serve_gate_fail_total", self.gate_fail_total)
+            # brownout ladder + deadline budget series: fixed label sets
+            # (levels 1..2, the three pre-compute stages) so the golden-
+            # fixture byte comparison stays deterministic as counts move
+            out.append("# TYPE photon_serve_degraded_total counter")
+            for level in sorted(set(self.degraded_total) | {1, 2}):
+                out.append(
+                    f'photon_serve_degraded_total{{level="{level}"}} '
+                    f"{_fmt(self.degraded_total.get(level, 0))}")
+            out.append("# TYPE photon_serve_deadline_drop_total counter")
+            for stage in ("admission", "queue", "pre_compute"):
+                out.append(
+                    f'photon_serve_deadline_drop_total{{stage="{stage}"}} '
+                    f"{_fmt(self.deadline_drops.get(stage, 0))}")
+            for stage in sorted(set(self.deadline_drops)
+                                - {"admission", "queue", "pre_compute"}):
+                out.append(
+                    f'photon_serve_deadline_drop_total{{stage="{stage}"}} '
+                    f"{_fmt(self.deadline_drops[stage])}")
+            gauge("photon_serve_brownout_level", self.brownout_level)
+            gauge("photon_serve_model_staleness_seconds",
+                  self.model_staleness_s)
             return "\n".join(out) + "\n"

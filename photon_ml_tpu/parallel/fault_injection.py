@@ -18,9 +18,16 @@ pass boundaries, CD steps, multihost init) and lets a test arm a
   :class:`DroppedProcess` (a ``BaseException``), which the simulated
   runner (``testing.run_simulated_processes``) treats as the process
   going dark — it never reaches another health barrier, so peers must
-  surface :class:`~.resilience.WatchdogTimeout` within the watchdog.
+  surface :class:`~.resilience.WatchdogTimeout` within the watchdog;
+* ``kind="delay"`` — a latency fault: the site sleeps ``delay_s``
+  before continuing, driving the serving tier's deadline/degrade
+  machinery (a slow coefficient store or a wedged backend) without
+  raising. Sites on an event loop use :func:`async_check`, which awaits
+  the delay instead of blocking the loop.
 
 Determinism: faults address a (site, process, occurrence) triple.
+``at=-1`` fires at EVERY occurrence (the chaos-storm form: "100% of
+store loads are slow/failing").
 Occurrence counters are per-thread (each simulated process counts its own
 visits) and reset when a new plan is installed. Real multi-process runs
 can arm a plan through the ``PHOTON_ML_TPU_FAULTS`` env var (JSON list of
@@ -39,8 +46,8 @@ import threading
 from typing import List, Optional, Sequence
 
 __all__ = ["Fault", "InjectedFault", "DroppedProcess", "install", "clear",
-           "installed", "check", "mangle_payload", "process_context",
-           "crash_schedule"]
+           "installed", "check", "async_check", "mangle_payload",
+           "process_context", "crash_schedule"]
 
 
 class InjectedFault(RuntimeError):
@@ -57,16 +64,19 @@ class DroppedProcess(BaseException):
 @dataclasses.dataclass(frozen=True)
 class Fault:
     """One armed fault: fire at the ``at``-th visit (0-based, per process)
-    of ``site`` by process ``process`` (None = every process)."""
+    of ``site`` by process ``process`` (None = every process). ``at=-1``
+    fires at every visit. ``delay_s`` is the sleep for ``kind="delay"``."""
 
     site: str
-    kind: str = "raise"  # raise | device_loss | truncate | drop
+    kind: str = "raise"  # raise | device_loss | truncate | drop | delay
     process: Optional[int] = None
     at: int = 0
     message: str = "injected fault"
+    delay_s: float = 0.0
 
     def __post_init__(self):
-        if self.kind not in ("raise", "device_loss", "truncate", "drop"):
+        if self.kind not in ("raise", "device_loss", "truncate", "drop",
+                             "delay"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
 
 
@@ -158,21 +168,15 @@ def _match(site: str, kinds: Sequence[str]) -> Optional[Fault]:
     _counters()[site] = n + 1
     proc = _current_process()
     for f in _plan:
-        if (f.site == site and f.kind in kinds and f.at == n
+        if (f.site == site and f.kind in kinds and (f.at == n or f.at < 0)
                 and (f.process is None or f.process == proc)):
             return f
     return None
 
 
-def check(site: str) -> None:
-    """Injection point for control-flow faults. No-op unless a plan is
-    armed; otherwise fires any (site, process, occurrence)-matching fault."""
-    _env_plan_loaded()
-    if not _armed:
-        return
-    f = _match(site, ("raise", "device_loss", "drop"))
-    if f is None:
-        return
+def _fire(site: str, f: Fault) -> None:
+    """Raise the exception a matched control-flow fault calls for (shared
+    by the sync and async injection points)."""
     if f.kind == "drop":
         raise DroppedProcess(f"{site}: {f.message}")
     if f.kind == "device_loss":
@@ -181,6 +185,44 @@ def check(site: str) -> None:
         raise jax.errors.JaxRuntimeError(
             f"UNAVAILABLE: {f.message} (injected device loss at {site})")
     raise InjectedFault(f"{site}: {f.message}")
+
+
+def check(site: str) -> None:
+    """Injection point for control-flow faults. No-op unless a plan is
+    armed; otherwise fires any (site, process, occurrence)-matching fault.
+    A matched ``kind="delay"`` fault sleeps ``delay_s`` and returns —
+    callers on an event loop must use :func:`async_check` instead."""
+    _env_plan_loaded()
+    if not _armed:
+        return
+    f = _match(site, ("raise", "device_loss", "drop", "delay"))
+    if f is None:
+        return
+    if f.kind == "delay":
+        import time
+
+        time.sleep(f.delay_s)
+        return
+    _fire(site, f)
+
+
+async def async_check(site: str) -> None:
+    """Event-loop-safe injection point: identical matching to
+    :func:`check`, but a ``kind="delay"`` fault is awaited via
+    ``asyncio.sleep`` so an armed latency fault never blocks the loop
+    (the front door's proxy path runs here)."""
+    _env_plan_loaded()
+    if not _armed:
+        return
+    f = _match(site, ("raise", "device_loss", "drop", "delay"))
+    if f is None:
+        return
+    if f.kind == "delay":
+        import asyncio
+
+        await asyncio.sleep(f.delay_s)
+        return
+    _fire(site, f)
 
 
 def crash_schedule(*kills, kind: str = "drop") -> List[Fault]:

@@ -27,7 +27,9 @@ from photon_ml_tpu.serve.batcher import (
     BatchWatchdogTimeout,
     MicroBatcher,
     QueueFullError,
+    ScoreContext,
 )
+from photon_ml_tpu.serve.brownout import BrownoutController
 from photon_ml_tpu.serve.coeff_cache import (
     EntityCoefficientLRU,
     LayeredCoefficientStore,
@@ -41,8 +43,8 @@ from photon_ml_tpu.serve.aserver import AsyncFrontDoor, AsyncScoringServer
 from photon_ml_tpu.serve.watcher import RegistryWatcher
 
 __all__ = [
-    "ScoringSession", "MicroBatcher", "QueueFullError",
-    "BatchWatchdogTimeout", "EntityCoefficientLRU",
+    "ScoringSession", "MicroBatcher", "QueueFullError", "ScoreContext",
+    "BrownoutController", "BatchWatchdogTimeout", "EntityCoefficientLRU",
     "LayeredCoefficientStore", "ModelDirCoefficientStore", "Histogram",
     "ServingMetrics", "PagedCoefficientTable", "ScoringService",
     "ScoringServer", "AsyncScoringServer", "AsyncFrontDoor",

@@ -42,7 +42,8 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from photon_ml_tpu.obs import trace as obs_trace
-from photon_ml_tpu.obs.metrics import escape_label_value
+from photon_ml_tpu.obs.metrics import Histogram, escape_label_value
+from photon_ml_tpu.parallel import fault_injection
 from photon_ml_tpu.serve.server import ScoringService
 
 __all__ = ["AsyncScoringServer", "AsyncFrontDoor", "install_uvloop"]
@@ -268,10 +269,18 @@ class AsyncScoringServer:
             status, resp = await asyncio.get_running_loop().run_in_executor(
                 None, svc.handle_reload, payload)
             return _encode_response(status, resp, extra_headers=rid_hdr)
+        try:
+            deadline_ms = svc.parse_deadline_ms(
+                (headers or {}).get("x-deadline-ms"))
+        except ValueError as e:
+            return _encode_response(
+                400, {"error": str(e), "requestId": rid},
+                extra_headers=rid_hdr)
         # contextvars-ambient context: safe across the await (each
         # asyncio task carries its own copy, no cross-request bleed)
         with obs_trace.request_context(request_id=rid):
-            status, resp = await self.score_async(payload, request_id=rid)
+            status, resp = await self.score_async(payload, request_id=rid,
+                                                  deadline_ms=deadline_ms)
         extra = rid_hdr
         if status == 429 and isinstance(resp, dict):
             after = max(1, int(-(-float(resp.get("retryAfterS", 1.0)) // 1)))
@@ -279,11 +288,13 @@ class AsyncScoringServer:
         return _encode_response(status, resp, extra_headers=extra)
 
     async def score_async(self, payload,
-                          request_id: Optional[str] = None
+                          request_id: Optional[str] = None,
+                          deadline_ms: Optional[float] = None
                           ) -> Tuple[int, dict]:
         """``/score`` without blocking the loop: validate inline, admit
         through the batcher's non-blocking submit, await the worker's
-        resolution via done-callback."""
+        resolution via done-callback. ``deadline_ms`` is the propagated
+        ``X-Deadline-Ms`` budget."""
         svc = self.service
         valid, err = svc.validate_score_payload(payload)
         if valid is None:
@@ -304,20 +315,37 @@ class AsyncScoringServer:
             if req.error is not None:
                 fut.set_exception(req.error)
             else:
-                fut.set_result(req.result(0))
+                # the ladder level rides along with the scores so the
+                # response body can report "degraded"
+                fut.set_result((req.result(0), req.degraded))
 
         try:
             with obs_trace.span("http.score", cat="serve", rows=len(rows)):
-                pending = svc.batcher.submit(rows, per_coord,
-                                             request_id=request_id)
+                pending = svc.batcher.submit(
+                    rows, per_coord, request_id=request_id,
+                    deadline_s=svc.deadline_s(deadline_ms))
             pending.add_done_callback(_resolve)
-            result = await asyncio.wait_for(fut, svc.request_timeout_s)
+            result, degraded = await asyncio.wait_for(
+                fut, svc.request_timeout_s)
         except Exception as e:
             return svc.score_error_response(e, request_id=request_id)
-        return 200, svc.score_body(rows, per_coord, result)
+        return 200, svc.score_body(rows, per_coord, result,
+                                   degraded=degraded)
 
 
 _BACKEND_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
+
+# Hedge-policy latency resolution: ~1.25x geometric steps. The default
+# exposition buckets step 2-2.5x, and a p99 read at bucket granularity
+# can overstate the true tail by that whole ratio — a hedge that fires
+# 2.5x late cannot bound the tail it exists to cut. This histogram is
+# policy-internal (never rendered), so density costs nothing on the wire.
+_HEDGE_LAT_BUCKETS_MS = (
+    0.5, 1.0, 1.5, 2.0, 2.5, 3.2, 4.0, 5.0, 6.5, 8.0, 10.0, 13.0, 16.0,
+    20.0, 25.0, 32.0, 40.0, 50.0, 65.0, 80.0, 100.0, 130.0, 160.0, 200.0,
+    250.0, 320.0, 400.0, 500.0, 650.0, 800.0, 1000.0, 1300.0, 1600.0,
+    2000.0, 2500.0, 5000.0,
+)
 
 
 class _Backend:
@@ -334,7 +362,7 @@ class _Backend:
 
     __slots__ = ("host", "port", "inflight", "pool", "picked", "cooldowns",
                  "state", "fails", "opened", "next_probe_at",
-                 "probe_inflight", "backoff")
+                 "probe_inflight", "backoff", "lat_ms")
 
     def __init__(self, host: str, port: int, cooldown_s: float = 1.0):
         from photon_ml_tpu.parallel.resilience import Backoff
@@ -354,10 +382,15 @@ class _Backend:
         # probing one recovering replica don't re-slam it in lockstep
         self.backoff = Backoff(base_s=cooldown_s, factor=2.0,
                                max_s=max(30.0, cooldown_s), jitter=0.1)
+        # observed exchange latency — the hedging policy's p99 source
+        self.lat_ms = Histogram(_HEDGE_LAT_BUCKETS_MS)
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
+
+    def note_latency(self, ms: float) -> None:
+        self.lat_ms.observe(ms)
 
     def record_failure(self, threshold: int, now: float) -> None:
         self.fails += 1
@@ -386,11 +419,32 @@ class AsyncFrontDoor:
     ``retry_backend_s``) readmits it. With every backend open the client
     sees 503 (the front door never queues — queueing and shedding live
     in the replicas' batchers, one admission-control point per
-    process)."""
+    process).
+
+    The probe readmits only on a ``/healthz`` body whose ``status`` is
+    ``ok``: a replica still prewarming pages after a swap reports
+    ``warming`` (HTTP 200 — the process is alive) and is HELD half-open
+    with a quick re-probe instead of being readmitted into a cold-fault
+    storm or backed off as if it had failed.
+
+    Hedging (``hedge_enabled``): when a picked backend's exchange runs
+    past its own observed p99 (from at least ``hedge_min_samples``
+    samples, floored at ``hedge_min_s``), the front door fires a
+    DUPLICATE of the request at a second backend; the first success
+    wins and the loser is cancelled — a cancelled loser is never
+    counted as a backend failure, so hedging cannot trip breakers. Use
+    only for idempotent traffic (scoring is).
+
+    Deadline guard: a ``/score`` carrying ``X-Deadline-Ms <= 0`` is
+    shed HERE (429, ``photon_fd_deadline_rejects_total``) — the
+    cheapest drop point of all — and a positive budget is forwarded to
+    the replica, whose batcher/session spend it stage by stage."""
 
     def __init__(self, backends: Sequence[str], host: str = "127.0.0.1",
                  port: int = 0, policy: str = "least_loaded",
-                 retry_backend_s: float = 1.0, breaker_threshold: int = 3):
+                 retry_backend_s: float = 1.0, breaker_threshold: int = 3,
+                 hedge_enabled: bool = False, hedge_min_s: float = 0.05,
+                 hedge_min_samples: int = 20):
         if not backends:
             raise ValueError("front door needs at least one backend")
         if policy not in ("least_loaded", "round_robin"):
@@ -411,10 +465,17 @@ class AsyncFrontDoor:
         self._server: Optional[asyncio.AbstractServer] = None
         self.host: str = host
         self.port: int = 0
+        self.hedge_enabled = bool(hedge_enabled)
+        self.hedge_min_s = float(hedge_min_s)
+        self.hedge_min_samples = int(hedge_min_samples)
         self.proxied = 0
         self.retried = 0
         self.unavailable = 0
         self.readmitted = 0  # breakers closed again by a healthz probe
+        self.hedged = 0           # duplicate requests fired
+        self.hedge_wins = 0       # duplicates that answered first
+        self.deadline_rejects = 0  # X-Deadline-Ms <= 0 shed at the door
+        self.warming_holds = 0    # probes held half-open on "warming"
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> "AsyncFrontDoor":
@@ -465,9 +526,10 @@ class AsyncFrontDoor:
         cool-down has elapsed, fire ONE async ``/healthz`` probe (guarded
         so concurrent picks don't stack probes). Runs from the request
         path — no timer thread; an idle front door simply probes on its
-        next request or metrics scrape."""
-        if (backend.state != "open" or now < backend.next_probe_at
-                or backend.probe_inflight):
+        next request or metrics scrape. A HALF-OPEN backend re-probes
+        too: a warming replica parks there until its installer drains."""
+        if (backend.state not in ("open", "half_open")
+                or now < backend.next_probe_at or backend.probe_inflight):
             return
         try:
             loop = asyncio.get_running_loop()
@@ -480,9 +542,17 @@ class AsyncFrontDoor:
     async def _probe(self, backend: _Backend) -> None:
         probe = (b"GET /healthz HTTP/1.1\r\nHost: backend\r\n"
                  b"Content-Length: 0\r\nConnection: keep-alive\r\n\r\n")
+        warming = False
         try:
             data = await self._backend_exchange(backend, probe)
-            ok = b" 200 " in data.split(b"\r\n", 1)[0]
+            is_200 = b" 200 " in data.split(b"\r\n", 1)[0]
+            # a 200 readmits UNLESS the body explicitly says the replica
+            # is still prewarming pages after a swap ({"status":
+            # "warming"}) — alive, but it must stay out of rotation
+            # until its installer drains; health endpoints without the
+            # status body keep their plain 200-is-healthy contract
+            warming = is_200 and b'"status": "warming"' in data
+            ok = is_200 and not warming
         except Exception:
             ok = False
         finally:
@@ -490,6 +560,11 @@ class AsyncFrontDoor:
         if ok:
             backend.record_success()
             self.readmitted += 1
+        elif warming:
+            # alive but cold: hold half-open with a quick re-probe and
+            # WITHOUT escalating the failure backoff
+            self.warming_holds += 1
+            backend.next_probe_at = time.monotonic() + self.retry_backend_s
         else:
             backend.record_failure(self.breaker_threshold, time.monotonic())
 
@@ -574,7 +649,30 @@ class AsyncFrontDoor:
                         extra_headers=rid_hdr))
                     await writer.drain()
                     continue
-                data = await self._proxy(method, path, body, request_id=rid)
+                deadline_ms = None
+                if method == "POST":
+                    try:
+                        deadline_ms = ScoringService.parse_deadline_ms(
+                            headers.get("x-deadline-ms"))
+                    except ValueError as e:
+                        writer.write(_encode_response(
+                            400, {"error": str(e), "requestId": rid},
+                            extra_headers=rid_hdr))
+                        await writer.drain()
+                        continue
+                    if deadline_ms is not None and deadline_ms <= 0:
+                        # the budget is already spent: drop at the door,
+                        # before any backend connection is even touched
+                        self.deadline_rejects += 1
+                        writer.write(_encode_response(
+                            429, {"error": "deadline budget exhausted "
+                                           "before proxy", "shed": True,
+                                  "cause": "deadline", "requestId": rid},
+                            extra_headers=rid_hdr))
+                        await writer.drain()
+                        continue
+                data = await self._proxy(method, path, body, request_id=rid,
+                                         deadline_ms=deadline_ms)
                 writer.write(data)
                 await writer.drain()
                 if headers.get("connection", "").lower() == "close":
@@ -587,14 +685,103 @@ class AsyncFrontDoor:
             except Exception:
                 pass
 
+    def _hedge_delay(self, backend: _Backend) -> Optional[float]:
+        """How long to wait on ``backend`` before firing a duplicate at a
+        second replica — its own observed p99 (floored at ``hedge_min_s``)
+        — or None when hedging is off, there is no second replica to
+        hedge to, or the backend has too few samples to call a tail."""
+        if (not self.hedge_enabled or len(self._backends) < 2
+                or backend.lat_ms.total < self.hedge_min_samples):
+            return None
+        return max(self.hedge_min_s, backend.lat_ms.quantile(0.99) / 1e3)
+
+    async def _timed_exchange(self, backend: _Backend,
+                              request: bytes, path: str) -> bytes:
+        """One breaker-aware exchange: inflight bookkeeping, fault hook,
+        latency sample + breaker close on success, breaker failure on
+        error. A ``CancelledError`` (hedge loser being reaped) is NOT a
+        backend failure — cancelling the slow-but-healthy replica must
+        never trip its breaker."""
+        backend.inflight += 1
+        try:
+            with obs_trace.span("fd.proxy", cat="serve", path=path,
+                                backend=backend.address):
+                t0 = time.monotonic()
+                await fault_injection.async_check("fd.proxy")
+                data = await self._backend_exchange(backend, request)
+            backend.record_success()
+            backend.note_latency((time.monotonic() - t0) * 1e3)
+            return data
+        except asyncio.CancelledError:
+            raise
+        except BaseException:
+            backend.record_failure(self.breaker_threshold, time.monotonic())
+            raise
+        finally:
+            backend.inflight -= 1
+
+    async def _hedged_exchange(self, primary: _Backend, request: bytes,
+                               path: str, tried: set) -> Optional[bytes]:
+        """Race ``primary`` against (at most one) hedge duplicate: wait
+        ``_hedge_delay`` on the primary; if it hasn't answered, fire the
+        same request at a second backend and take whichever answers
+        first, cancelling the loser. Returns None when every attempted
+        backend failed (addresses added to ``tried``)."""
+        task_backend: Dict["asyncio.Task", _Backend] = {}
+
+        def _spawn(b: _Backend) -> "asyncio.Task":
+            t = asyncio.ensure_future(
+                self._timed_exchange(b, request, path))
+            task_backend[t] = b
+            return t
+
+        pending = {_spawn(primary)}
+        delay = self._hedge_delay(primary)
+        winner: Optional[bytes] = None
+        hedge_task: Optional["asyncio.Task"] = None
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, timeout=delay,
+                return_when=asyncio.FIRST_COMPLETED)
+            if not done:
+                # primary ran past its own p99: duplicate onto a second
+                # replica (once), then wait for whichever answers first
+                delay = None
+                alt = self._pick(tried | {primary.address})
+                if alt is not None:
+                    self.hedged += 1
+                    hedge_task = _spawn(alt)
+                    pending.add(hedge_task)
+                continue
+            delay = None
+            for task in done:
+                backend = task_backend[task]
+                if task.cancelled() or task.exception() is not None:
+                    tried.add(backend.address)
+                    continue
+                if winner is None:
+                    winner = task.result()
+                    if task is hedge_task:
+                        self.hedge_wins += 1
+            if winner is not None:
+                for task in pending:
+                    task.cancel()
+                if pending:
+                    await asyncio.gather(*pending, return_exceptions=True)
+                return winner
+        return None
+
     async def _proxy(self, method: str, path: str, body: bytes,
-                     request_id: Optional[str] = None) -> bytes:
+                     request_id: Optional[str] = None,
+                     deadline_ms: Optional[float] = None) -> bytes:
         rid = request_id or obs_trace.new_request_id()
+        deadline_hdr = ("" if deadline_ms is None
+                        else f"X-Deadline-Ms: {deadline_ms:g}\r\n")
         request = (
             f"{method} {path} HTTP/1.1\r\n"
             f"Host: backend\r\nContent-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n"
-            f"X-Request-Id: {rid}\r\n"
+            f"X-Request-Id: {rid}\r\n{deadline_hdr}"
             f"Connection: keep-alive\r\n\r\n").encode("ascii") + body
         tried: set = set()
         with obs_trace.request_context(request_id=rid):
@@ -602,21 +789,12 @@ class AsyncFrontDoor:
                 backend = self._pick(tried)
                 if backend is None:
                     break
-                backend.inflight += 1
-                try:
-                    with obs_trace.span("fd.proxy", cat="serve", path=path,
-                                        backend=backend.address):
-                        data = await self._backend_exchange(backend, request)
+                data = await self._hedged_exchange(backend, request, path,
+                                                   tried)
+                if data is not None:
                     self.proxied += 1
-                    backend.record_success()
                     return data
-                except Exception:
-                    tried.add(backend.address)
-                    backend.record_failure(self.breaker_threshold,
-                                           time.monotonic())
-                    self.retried += 1
-                finally:
-                    backend.inflight -= 1
+                self.retried += 1
         self.unavailable += 1
         return _encode_response(
             503, {"error": "no live backend replica", "requestId": rid},
@@ -686,6 +864,14 @@ class AsyncFrontDoor:
                        f'{_BACKEND_STATE_NUM[b.state]}')
         out.append("# TYPE photon_fd_readmitted_total counter")
         out.append(f"photon_fd_readmitted_total {self.readmitted}")
+        out.append("# TYPE photon_fd_hedged_total counter")
+        out.append(f"photon_fd_hedged_total {self.hedged}")
+        out.append("# TYPE photon_fd_hedge_wins_total counter")
+        out.append(f"photon_fd_hedge_wins_total {self.hedge_wins}")
+        out.append("# TYPE photon_fd_deadline_rejects_total counter")
+        out.append(f"photon_fd_deadline_rejects_total {self.deadline_rejects}")
+        out.append("# TYPE photon_fd_warming_holds_total counter")
+        out.append(f"photon_fd_warming_holds_total {self.warming_holds}")
         return "\n".join(out) + "\n"
 
     def stats(self) -> Dict[str, object]:
@@ -702,4 +888,8 @@ class AsyncFrontDoor:
             "retried": self.retried,
             "unavailable": self.unavailable,
             "readmitted": self.readmitted,
+            "hedged": self.hedged,
+            "hedgeWins": self.hedge_wins,
+            "deadlineRejects": self.deadline_rejects,
+            "warmingHolds": self.warming_holds,
         }

@@ -28,6 +28,7 @@ watchdog, for the same reason.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import queue
 import threading
@@ -41,7 +42,33 @@ from photon_ml_tpu.parallel.resilience import WatchdogTimeout
 _log = logging.getLogger(__name__)
 
 __all__ = ["QueueFullError", "BatchWatchdogTimeout", "MicroBatcher",
-           "PendingRequest"]
+           "PendingRequest", "ScoreContext"]
+
+
+class ScoreContext:
+    """Per-batch scoring budget + degradation state, threaded from the
+    batcher into ``ScoringSession.score_rows``. ``deadline_at`` is an
+    absolute ``time.monotonic()`` instant (None = no deadline);
+    ``level`` is the ladder FLOOR the brownout controller set for this
+    batch (0 full fidelity, 1 resident-coefficients-only, 2
+    fixed-effect-only); the session raises ``degraded`` to the level it
+    actually served at and appends a reason per escalation (``budget``,
+    ``store_fault``, ``brownout``)."""
+
+    __slots__ = ("deadline_at", "level", "degraded", "reasons")
+
+    def __init__(self, deadline_at: Optional[float] = None,
+                 level: int = 0):
+        self.deadline_at = deadline_at
+        self.level = int(level)
+        self.degraded = int(level)
+        self.reasons: List[str] = (["brownout"] if level > 0 else [])
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds of budget left (None = unlimited; may be <= 0)."""
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.monotonic()
 
 
 class QueueFullError(RuntimeError):
@@ -49,9 +76,9 @@ class QueueFullError(RuntimeError):
     at capacity (``cause="queue_full"``) or the request's deadline
     expired while it waited for a batch slot (``cause="deadline"``).
     Callers should surface this as retryable backpressure (HTTP 429);
-    ``retry_after_s`` is the server's backoff hint — current queue depth
-    times the batching deadline, i.e. roughly how long the backlog ahead
-    of a retry takes to drain."""
+    ``retry_after_s`` is the server's backoff hint — the backlog ahead
+    of a retry divided by the MEASURED drain rate (EWMA of batch
+    service time), i.e. roughly how long a retry would wait."""
 
     def __init__(self, depth: int, capacity: int,
                  retry_after_s: float = 0.0, cause: str = "queue_full"):
@@ -82,10 +109,11 @@ class PendingRequest:
 
     __slots__ = ("rows", "per_coordinate", "_event", "_result", "_error",
                  "admitted_at", "_callbacks", "_cb_lock", "request_id",
-                 "trace_ctx")
+                 "trace_ctx", "deadline_at", "degraded")
 
     def __init__(self, rows: Sequence[dict], per_coordinate: bool,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 deadline_at: Optional[float] = None):
         self.rows = list(rows)
         self.per_coordinate = per_coordinate
         self._event = threading.Event()
@@ -94,6 +122,12 @@ class PendingRequest:
         self._callbacks: List[Callable] = []
         self._cb_lock = threading.Lock()
         self.admitted_at = time.monotonic()
+        # absolute budget expiry (monotonic) — every later stage checks
+        # remaining = deadline_at - now before spending work on this
+        # request; the ladder level the session actually served at lands
+        # in `degraded` for the response body
+        self.deadline_at = deadline_at
+        self.degraded = 0
         # identity captured at admission: the submitting thread's trace
         # context rides the request across the worker-thread handoff, so
         # batcher/session/install spans land under the request's trace
@@ -159,13 +193,25 @@ class MicroBatcher:
     still waiting when its admission time + deadline passes is shed by
     the worker (:class:`QueueFullError` with ``cause="deadline"``)
     instead of being scored — under sustained overload the queue would
-    otherwise serve only requests whose clients already gave up.
+    otherwise serve only requests whose clients already gave up. A
+    per-request ``deadline_s`` at :meth:`submit` (the propagated
+    ``X-Deadline-Ms`` budget) overrides it; either way the expiry is
+    checked at every stage BEFORE work is spent (admission, queue,
+    pre-compute), with the drop stage recorded in
+    ``photon_serve_deadline_drop_total{stage}``.
+
+    ``brownout`` is an optional
+    :class:`~photon_ml_tpu.serve.brownout.BrownoutController`: the
+    batcher feeds it every request's queue wait and stamps its current
+    level into each batch's :class:`ScoreContext` as the degradation
+    floor (the session may degrade further on budget/faults).
     """
 
     def __init__(self, score_fn: Callable, *, max_batch: int = 64,
                  max_delay_ms: float = 5.0, max_queue: int = 256,
                  watchdog_s: Optional[float] = 60.0,
-                 request_deadline_s: Optional[float] = None, metrics=None):
+                 request_deadline_s: Optional[float] = None, metrics=None,
+                 brownout=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 1:
@@ -176,6 +222,22 @@ class MicroBatcher:
         self.watchdog_s = watchdog_s
         self.request_deadline_s = (None if request_deadline_s is None
                                    else float(request_deadline_s))
+        self.brownout = brownout
+        # does score_fn accept the ScoreContext? Checked ONCE here so
+        # plain fakes (tests pass lambdas) keep working ctx-less
+        try:
+            sig = inspect.signature(score_fn)
+            self._ctx_ok = ("ctx" in sig.parameters or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.parameters.values()))
+        except (TypeError, ValueError):
+            self._ctx_ok = False
+        # measured drain rate for retry_after_s: EWMA of batch service
+        # time + EWMA of requests per batch (worker writes, admission
+        # reads — both under _ewma_lock)
+        self._ewma_lock = threading.Lock()
+        self._svc_ewma_s: Optional[float] = None
+        self._rpb_ewma: Optional[float] = None
         self._queue: "queue.Queue[Optional[PendingRequest]]" = queue.Queue(
             maxsize=int(max_queue))
         self._metrics = metrics
@@ -194,10 +256,14 @@ class MicroBatcher:
     # -- submission --------------------------------------------------------
     def submit(self, rows: Sequence[dict],
                per_coordinate: bool = False,
-               request_id: Optional[str] = None) -> PendingRequest:
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> PendingRequest:
         """Admit a request (non-blocking). Raises :class:`QueueFullError`
         when the queue is at capacity and ValueError for oversized or
-        empty requests; never blocks the caller on a full queue."""
+        empty requests; never blocks the caller on a full queue.
+        ``deadline_s`` is this request's remaining budget (overrides the
+        batcher-wide ``request_deadline_s``); a request arriving with no
+        budget left is dropped HERE — the cheapest possible point."""
         if self._closed:
             raise RuntimeError("batcher is closed")
         rows = list(rows)
@@ -207,7 +273,19 @@ class MicroBatcher:
             raise ValueError(
                 f"request of {len(rows)} rows exceeds max_batch="
                 f"{self.max_batch}; split it client-side")
-        req = PendingRequest(rows, per_coordinate, request_id=request_id)
+        budget = (float(deadline_s) if deadline_s is not None
+                  else self.request_deadline_s)
+        if budget is not None and budget <= 0.0:
+            if self._metrics is not None:
+                self._metrics.record_shed(cause="deadline")
+                self._metrics.record_deadline_drop("admission")
+            raise QueueFullError(self._queue.qsize(), self._queue.maxsize,
+                                 retry_after_s=self.retry_after_s,
+                                 cause="deadline")
+        deadline_at = (None if budget is None
+                       else time.monotonic() + budget)
+        req = PendingRequest(rows, per_coordinate, request_id=request_id,
+                             deadline_at=deadline_at)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -222,11 +300,21 @@ class MicroBatcher:
 
     @property
     def retry_after_s(self) -> float:
-        """Backoff hint for shed requests: the backlog ahead of a retry,
-        estimated as queue depth (in batches) times the batching deadline
-        — the slowest the queue can drain when traffic is too sparse to
-        fill batches early. Floored at one deadline."""
-        batches_queued = self._queue.qsize() / max(self.max_batch, 1)
+        """Backoff hint for shed requests: the backlog ahead of a retry
+        divided by the MEASURED drain rate — queue depth over the EWMA
+        of requests-per-batch, times the EWMA of batch service time.
+        The previous static queue-depth x batching-deadline estimate
+        ignored how long batches actually take, so it under-advised
+        whenever scoring dominated the delay and over-advised under
+        sparse traffic with mixed batch sizes. Before the first batch
+        completes (no measurement yet) the static estimate remains the
+        fallback. Floored at one batching deadline either way."""
+        qsize = self._queue.qsize()
+        with self._ewma_lock:
+            svc, rpb = self._svc_ewma_s, self._rpb_ewma
+        if svc is not None and rpb:
+            return max(self.max_delay_s, (qsize / max(rpb, 1.0)) * svc)
+        batches_queued = qsize / max(self.max_batch, 1)
         return max(self.max_delay_s, batches_queued * self.max_delay_s)
 
     def score(self, rows: Sequence[dict], per_coordinate: bool = False,
@@ -266,15 +354,16 @@ class MicroBatcher:
     # class attribute so tests can shrink it without monkeypatching
     _idle_poll_s = 0.2
 
-    def _expired(self, req: PendingRequest) -> bool:
-        """Shed a queued request whose deadline passed (worker-side;
-        returns True when the request was shed and must be skipped)."""
-        if (self.request_deadline_s is None
-                or time.monotonic() - req.admitted_at
-                < self.request_deadline_s):
+    def _expired(self, req: PendingRequest, stage: str = "queue") -> bool:
+        """Shed a request whose deadline passed (worker-side; returns
+        True when the request was shed and must be skipped). ``stage``
+        labels WHERE the budget ran out in the drop counter — the
+        acceptance gate for "dropped before device compute"."""
+        if req.deadline_at is None or time.monotonic() < req.deadline_at:
             return False
         if self._metrics is not None:
             self._metrics.record_shed(cause="deadline")
+            self._metrics.record_deadline_drop(stage)
         req.set_error(QueueFullError(
             self._queue.qsize(), self._queue.maxsize,
             retry_after_s=self.retry_after_s, cause="deadline"))
@@ -341,16 +430,19 @@ class MicroBatcher:
                     and self._queue.empty()):
                 return
 
-    def _score_with_watchdog(self, rows: List[dict], per_coordinate: bool):
+    def _score_with_watchdog(self, rows: List[dict], per_coordinate: bool,
+                             ctx: Optional[ScoreContext] = None):
+        kwargs = {"ctx": ctx} if ctx is not None else {}
         if self.watchdog_s is None:
-            return self._score_fn(rows, per_coordinate)
+            return self._score_fn(rows, per_coordinate, **kwargs)
         box: dict = {}
         tctx = obs_trace.current_context()  # ride into the helper thread
 
         def run():
             try:
                 with obs_trace.use_context(tctx):
-                    box["result"] = self._score_fn(rows, per_coordinate)
+                    box["result"] = self._score_fn(rows, per_coordinate,
+                                                   **kwargs)
             except BaseException as e:  # surfaced to the batch below
                 box["error"] = e
 
@@ -368,12 +460,29 @@ class MicroBatcher:
         return box["result"]
 
     def _execute(self, batch: List[PendingRequest]) -> None:
+        # last budget check BEFORE device compute: a request that expired
+        # between queue pickup and execution is dropped here, stage
+        # "pre_compute" — never after scoring has been paid for
+        batch = [req for req in batch
+                 if not self._expired(req, stage="pre_compute")]
+        if not batch:
+            return
         rows: List[dict] = []
         for req in batch:
             rows.extend(req.rows)
         t0 = time.monotonic()
         queue_waits = [(t0 - req.admitted_at) * 1e3 for req in batch]
         per_coord = any(r.per_coordinate for r in batch)
+        # the batch's scoring budget is its TIGHTEST member's deadline;
+        # the brownout level is the ladder floor for the whole batch
+        ctx: Optional[ScoreContext] = None
+        if self._ctx_ok:
+            deadlines = [r.deadline_at for r in batch
+                         if r.deadline_at is not None]
+            level = self.brownout.level if self.brownout is not None else 0
+            ctx = ScoreContext(
+                deadline_at=min(deadlines) if deadlines else None,
+                level=level)
         # adopt the first traced request's context so the batch's session
         # and device-compute spans carry its trace/request id (a batch is
         # one execution; per-request attribution is the args list below)
@@ -386,7 +495,8 @@ class MicroBatcher:
                         requests=len(batch),
                         request_ids=[r.request_id for r in batch
                                      if r.request_id]):
-                result = self._score_with_watchdog(rows, per_coord)
+                result = self._score_with_watchdog(rows, per_coord,
+                                                   ctx=ctx)
         except BaseException as e:
             for req in batch:
                 req.set_error(e)
@@ -401,11 +511,23 @@ class MicroBatcher:
         if self._metrics is not None:
             self._metrics.record_batch(len(rows), self.max_batch,
                                        elapsed_ms)
+        # fold this batch into the drain-rate EWMAs retry_after_s reads
+        alpha = 0.2
+        elapsed_s = elapsed_ms / 1e3
+        with self._ewma_lock:
+            self._svc_ewma_s = (
+                elapsed_s if self._svc_ewma_s is None else
+                self._svc_ewma_s + alpha * (elapsed_s - self._svc_ewma_s))
+            self._rpb_ewma = (
+                float(len(batch)) if self._rpb_ewma is None else
+                self._rpb_ewma + alpha * (len(batch) - self._rpb_ewma))
+        degraded = ctx.degraded if ctx is not None else 0
         now = time.monotonic()
         start = 0
         for req, waited_ms in zip(batch, queue_waits):
             end = start + len(req.rows)
             sl = {k: v[start:end] for k, v in parts.items()}
+            req.degraded = degraded
             req.set_result((scores[start:end], sl)
                            if req.per_coordinate else scores[start:end])
             if self._metrics is not None:
@@ -414,6 +536,10 @@ class MicroBatcher:
                 self._metrics.record_request(
                     len(req.rows), (now - req.admitted_at) * 1e3,
                     queue_wait_ms=waited_ms, compute_ms=elapsed_ms)
+                if degraded:
+                    self._metrics.record_degraded(degraded)
+            if self.brownout is not None:
+                self.brownout.note_queue_wait(waited_ms)
             self.slow_log.note(
                 req.request_id, (now - req.admitted_at) * 1e3,
                 queue_wait_ms=round(waited_ms, 3),

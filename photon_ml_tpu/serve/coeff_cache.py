@@ -32,6 +32,8 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from photon_ml_tpu.parallel import fault_injection
+
 __all__ = ["CoeffEntry", "EntityCoefficientLRU", "LayeredCoefficientStore",
            "ModelDirCoefficientStore"]
 
@@ -107,6 +109,7 @@ class ModelDirCoefficientStore:
     def load(self, entity_id: str) -> Optional[CoeffEntry]:
         """The entity's coefficients, or None when the store has no model
         for it (the caller caches that outcome as a negative entry)."""
+        fault_injection.check("store.load")
         if str(entity_id) not in self.known_ids():
             return None
         from photon_ml_tpu.io.avro import iter_avro_records
@@ -123,6 +126,7 @@ class ModelDirCoefficientStore:
         O(m * file) as m single-entity :meth:`load` calls would (the
         paged table's install path and the LRU's batched misses come
         through here). Absent ids resolve to None without a file read."""
+        fault_injection.check("store.load")
         known = self.known_ids()
         out: Dict[str, Optional[CoeffEntry]] = {}
         wanted = set()
@@ -311,6 +315,20 @@ class EntityCoefficientLRU:
                 self.evictions += evicted
             if self._metrics is not None and evicted:
                 self._metrics.record_coeff(evictions=evicted)
+        return out
+
+    def resident_many(self, entity_ids) -> Dict[str, Optional[CoeffEntry]]:
+        """Resolve ONLY the already-resident subset of ``entity_ids`` —
+        the degraded-level-1 read: no loader call, no LRU reordering, no
+        hit/miss accounting, so a brownout scoring pass cannot perturb
+        the cache state the healthy path will resume with. Ids not in
+        the cache are simply absent from the result."""
+        out: Dict[str, Optional[CoeffEntry]] = {}
+        with self._lock:
+            for eid in entity_ids:
+                key = str(eid)
+                if key not in out and key in self._data:
+                    out[key] = self._data[key]
         return out
 
     def get_many(self, entity_ids) -> Dict[str, Optional[CoeffEntry]]:

@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu.obs import trace as obs_trace
+from photon_ml_tpu.parallel import fault_injection
 from photon_ml_tpu.serve.coeff_cache import CoeffEntry
 from photon_ml_tpu.utils import transfer_budget
 
@@ -214,6 +215,7 @@ class PagedCoefficientTable:
         refreshed on device; ``None`` resolutions join the absent set.
         Returns the number of rows written. Safe to call from the
         session's background installer while batches score."""
+        fault_injection.check("paged.install")
         touched: set = set()
         installed = 0
         with self._lock:

@@ -15,9 +15,18 @@ Status-code contract (the load-shedding contract callers program
 against; see docs/serving.md):
 
   200 scored; 400 malformed request; 404 unknown path;
-  429 shed — admission queue full, retry with backoff (explicit
-      backpressure instead of unbounded queueing latency);
+  429 shed — admission queue full OR deadline budget expired, retry
+      with backoff (explicit backpressure instead of unbounded
+      queueing latency);
   503 scoring failed; 504 batch watchdog expired (stuck execution).
+
+Deadline propagation: an ``X-Deadline-Ms`` request header (or the
+service's ``default_deadline_ms``) becomes the request's remaining
+budget — checked at admission, in-queue, and pre-compute by the batcher
+(``photon_serve_deadline_drop_total{stage}``) and spent deliberately by
+the session's degradation ladder. Every ``/score`` response carries
+``"degraded"``: 0 full fidelity, 1 resident-coefficients-only, 2
+fixed-effect-only margin.
 
 ``/admin/reload`` drives the zero-downtime hot swap (docs/lifecycle.md):
 an empty body follows the registry's ``LATEST``; ``{"version": "vNNNNNN"}``
@@ -53,13 +62,21 @@ class ScoringService:
     def __init__(self, session: ScoringSession,
                  batcher: Optional[MicroBatcher] = None,
                  request_timeout_s: float = 30.0,
-                 registry=None):
+                 registry=None,
+                 default_deadline_ms: Optional[float] = None,
+                 brownout=None):
         self.session = session
         self.metrics: ServingMetrics = session.metrics
         self.batcher = batcher or MicroBatcher(
             session.score_rows, max_batch=session.max_batch,
-            metrics=self.metrics)
+            metrics=self.metrics, brownout=brownout)
         self.request_timeout_s = float(request_timeout_s)
+        # budget applied to requests that carry no X-Deadline-Ms header
+        self.default_deadline_ms = (
+            None if default_deadline_ms is None
+            else float(default_deadline_ms))
+        self.brownout = brownout if brownout is not None else getattr(
+            self.batcher, "brownout", None)
         self.registry = registry  # optional registry.ModelRegistry
         self._reload_lock = threading.Lock()
 
@@ -105,13 +122,18 @@ class ScoringService:
         return status, body
 
     @staticmethod
-    def score_body(rows, per_coord: bool, result) -> dict:
-        """Shape a resolved batcher result into the response body."""
+    def score_body(rows, per_coord: bool, result, degraded: int = 0
+                   ) -> dict:
+        """Shape a resolved batcher result into the response body.
+        ``degraded`` is the ladder level the batch was actually served
+        at — always present so clients can alert on fidelity, not just
+        availability."""
         if per_coord:
             scores, parts = result
         else:
             scores, parts = result, {}
-        body = {"scores": [float(s) for s in scores]}
+        body = {"scores": [float(s) for s in scores],
+                "degraded": int(degraded)}
         uids = [r.get("uid") for r in rows]
         if any(u is not None for u in uids):
             body["uids"] = uids
@@ -120,13 +142,40 @@ class ScoringService:
                 k: [float(x) for x in v] for k, v in parts.items()}
         return body
 
-    def handle_score(self, payload,
-                     request_id: Optional[str] = None) -> Tuple[int, dict]:
+    @staticmethod
+    def parse_deadline_ms(raw) -> Optional[float]:
+        """Parse an ``X-Deadline-Ms`` header value. None/blank means no
+        per-request deadline; a malformed value raises ValueError (the
+        transports turn that into a 400 — a client that SENT a budget
+        but garbled it must not silently run unbounded)."""
+        if raw is None:
+            return None
+        raw = str(raw).strip()
+        if not raw:
+            return None
+        try:
+            return float(raw)
+        except ValueError:
+            raise ValueError(
+                f"bad X-Deadline-Ms value {raw!r}: must be a number "
+                "of milliseconds") from None
+
+    def deadline_s(self, deadline_ms: Optional[float]) -> Optional[float]:
+        """The effective budget in seconds: the request's own header
+        wins; otherwise the service default; otherwise None."""
+        ms = (deadline_ms if deadline_ms is not None
+              else self.default_deadline_ms)
+        return None if ms is None else ms / 1e3
+
+    def handle_score(self, payload, request_id: Optional[str] = None,
+                     deadline_ms: Optional[float] = None
+                     ) -> Tuple[int, dict]:
         """``{"rows": [...], "perCoordinate": bool}`` -> scores. Each row
         as ``ScoringSession.score_rows`` documents (features /
         entityIds / offset, plus an optional echoed ``uid``).
         ``request_id`` rides the pending request through the batcher and
-        appears in shed/error bodies."""
+        appears in shed/error bodies; ``deadline_ms`` is the propagated
+        remaining budget (``X-Deadline-Ms``)."""
         valid, err = self.validate_score_payload(payload)
         if valid is None:
             if request_id:
@@ -134,22 +183,32 @@ class ScoringService:
             return 400, err
         rows, per_coord = valid
         try:
-            result = self.batcher.score(rows, per_coord,
-                                        timeout=self.request_timeout_s,
-                                        request_id=request_id)
+            pending = self.batcher.submit(
+                rows, per_coord, request_id=request_id,
+                deadline_s=self.deadline_s(deadline_ms))
+            result = pending.result(self.request_timeout_s)
         except Exception as e:
             return self.score_error_response(e, request_id=request_id)
-        return 200, self.score_body(rows, per_coord, result)
+        return 200, self.score_body(rows, per_coord, result,
+                                    degraded=pending.degraded)
 
     def handle_healthz(self) -> Tuple[int, dict]:
-        return 200, {
-            "status": "ok",
+        """Liveness + readiness in one: HTTP 200 whenever the process
+        can serve, but ``status`` distinguishes ``ok`` from ``warming``
+        (background page installs still draining after a swap) — the
+        front door's half-open probe readmits only on ``ok``."""
+        warming = bool(getattr(self.session, "warming", False))
+        body = {
+            "status": "warming" if warming else "ok",
             "model_dir": self.session.model_dir,
             "active_version": self.session.active_version,
             "task": self.session.task,
             "queue_depth": self.batcher.queue_depth,
             "max_batch": self.batcher.max_batch,
         }
+        if self.brownout is not None:
+            body["brownout_level"] = self.brownout.level
+        return 200, body
 
     def handle_reload(self, payload) -> Tuple[int, dict]:
         """Hot-swap the session (``POST /admin/reload``). Serialized by
@@ -260,12 +319,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"bad JSON: {e}",
                               "requestId": rid}, request_id=rid)
             return
+        try:
+            deadline_ms = self.service.parse_deadline_ms(
+                self.headers.get("X-Deadline-Ms"))
+        except ValueError as e:
+            self._reply(400, {"error": str(e), "requestId": rid},
+                        request_id=rid)
+            return
         with obs_trace.request_context(request_id=rid):
             if self.path == "/admin/reload":
                 status, body = self.service.handle_reload(payload)
             else:
                 status, body = self.service.handle_score(
-                    payload, request_id=rid)
+                    payload, request_id=rid, deadline_ms=deadline_ms)
         self._reply(status, body, request_id=rid)
 
 

@@ -225,6 +225,11 @@ class ScoringSession:
         self._re_pages = int(re_pages)
         self._re_page_rows = int(re_page_rows)
         self._re_dense_dim_max = int(re_dense_dim_max)
+        # EWMA of observed cold-fault service time (store read + page
+        # install): the degradation ladder's budget check — a request
+        # whose remaining deadline cannot cover one more fault is served
+        # from resident coefficients instead of risking the store
+        self._fault_ewma_s: Optional[float] = None
 
         # -- background page installer: cold faults resolve host-side in
         # the faulting batch, residency arrives asynchronously ----------
@@ -519,6 +524,15 @@ class ScoringSession:
             time.sleep(0.002)
         return False
 
+    @property
+    def warming(self) -> bool:
+        """True while background page installs are still pending — right
+        after a swap the new version's pages are prewarming and a cold
+        burst would fault heavily. ``/healthz`` reports ``warming`` so
+        the front door's half-open breaker holds readmission until the
+        installer drains."""
+        return self._install_q.unfinished_tasks > 0
+
     def close(self, timeout_s: float = 5.0) -> None:
         """Stop the background page installer with a bounded join
         (idempotent). Pending installs are abandoned — residency is an
@@ -692,19 +706,77 @@ class ScoringSession:
 
         return score
 
+    # -- degradation ladder ------------------------------------------------
+    @staticmethod
+    def _ladder_level(ctx) -> int:
+        """The effective degradation level for this point of the batch:
+        the brownout floor raised by any budget/fault escalation earlier
+        in the same batch (0 = full, 1 = resident-only, 2 = fixed-only)."""
+        return 0 if ctx is None else max(ctx.level, ctx.degraded)
+
+    @staticmethod
+    def _note_degrade(ctx, level: int, reason: str) -> None:
+        if ctx.degraded < level:
+            ctx.degraded = level
+        ctx.reasons.append(reason)
+
+    def _note_fault_cost(self, elapsed_s: float) -> None:
+        """Fold one observed cold-fault service time into the EWMA the
+        budget check compares remaining deadline against. A slow store
+        (delay faults, contended disk) raises it, so subsequent tight
+        requests degrade instead of queueing behind the store."""
+        prev = self._fault_ewma_s
+        self._fault_ewma_s = (elapsed_s if prev is None
+                              else prev + 0.3 * (elapsed_s - prev))
+
+    def _budget_blocks_fault(self, ctx) -> bool:
+        """True when the batch's remaining budget cannot cover one more
+        cold-store fault (by the measured EWMA; with no measurement yet
+        only an already-expired budget blocks)."""
+        if ctx is None:
+            return False
+        rem = ctx.remaining_s()
+        return rem is not None and rem <= (self._fault_ewma_s or 0.0)
+
     def _re_views(self, name: str, coord: RandomEffectModel,
                   entity_ids: np.ndarray, host: Dict[str, HostSparse],
-                  st: _ModelState):
+                  st: _ModelState, ctx=None):
         """(views, coeffs) for one random coordinate of one batch, from
         cached entity coefficients — the same structures
-        ``build_model_score_views`` derives from a fully-loaded model."""
+        ``build_model_score_views`` derives from a fully-loaded model.
+        Under a degraded ``ctx`` the store is never touched: level >= 2
+        contributes nothing (fixed-effect-only margin), level 1 scores
+        from the LRU's resident entries only, and level 0 escalates to 1
+        when the remaining budget can't cover a cold fault or the store
+        itself fails — entities left unresolved score 0, byte-for-byte
+        the existing unknown-entity fallback."""
         from photon_ml_tpu.game.data import (
             build_score_buckets,
             group_rows_by_slot,
         )
 
         cache = st.coeff_caches[name]
-        resolved = cache.get_many(entity_ids)
+        level = self._ladder_level(ctx)
+        if level >= 2:
+            return [], []
+        if level >= 1:
+            resolved = cache.resident_many(entity_ids)
+        elif self._budget_blocks_fault(ctx):
+            self._note_degrade(ctx, 1, "budget")
+            resolved = cache.resident_many(entity_ids)
+        else:
+            try:
+                misses0 = cache.misses
+                t0 = time.monotonic()
+                resolved = cache.get_many(entity_ids)
+                if cache.misses > misses0:
+                    self._note_fault_cost(time.monotonic() - t0)
+            except Exception:
+                if ctx is None:
+                    raise
+                self._note_fault_cost(time.monotonic() - t0)
+                self._note_degrade(ctx, 1, "store_fault")
+                resolved = cache.resident_many(entity_ids)
         present = [eid for eid, entry in resolved.items()
                    if entry is not None]
         if not present:
@@ -722,7 +794,8 @@ class ScoringSession:
             host[coord.feature_shard], per_bucket_rows, local_maps)
         return views, [coeffs]
 
-    def score_rows(self, rows: List[dict], per_coordinate: bool = False):
+    def score_rows(self, rows: List[dict], per_coordinate: bool = False,
+                   ctx=None):
         """Score a batch of request rows.
 
         Each row is a dict: ``features`` — list of ``{"name", "term",
@@ -735,7 +808,14 @@ class ScoringSession:
         batch with rows wider than a shard's compiled pad width — or a
         model the paged table cannot hold — takes the PR-2 per-coordinate
         path. Both produce identical scores (the paged-parity tests pin
-        <= 1e-9 in f64)."""
+        <= 1e-9 in f64).
+
+        ``ctx`` (a :class:`~photon_ml_tpu.serve.batcher.ScoreContext`)
+        arms the degradation ladder: its remaining deadline budget gates
+        cold-store faults, its brownout level floors the fidelity, and
+        the level actually served lands back in ``ctx.degraded``. With
+        ``ctx=None`` (or a level-0 ctx, no faults, ample budget) the
+        code path — and therefore every score bit — is unchanged."""
         st = self._state  # one consistent snapshot across the batch
         n = len(rows)
         if n == 0:
@@ -754,14 +834,14 @@ class ScoringSession:
             if all(host[s].indices.shape[1] <= st.k_pad[s]
                    for s in st.shard_order):
                 return self._score_fused(rows, host, offsets, n, st,
-                                         per_coordinate)
+                                         per_coordinate, ctx)
             self.fused_fallback_batches += 1
         score_views = {}
         for name, coord in st.model.coordinates.items():
             if isinstance(coord, RandomEffectModel):
                 ids = self._entity_column_values(rows, coord, name)
                 score_views[name] = self._re_views(name, coord, ids, host,
-                                                   st)
+                                                   st, ctx)
         result = score_single_batch(
             st.model, host, score_views, offsets=offsets,
             dtype=self.dtype, per_coordinate=per_coordinate,
@@ -773,7 +853,7 @@ class ScoringSession:
         return np.asarray(result)
 
     def _score_fused(self, rows, host, offsets, n, st: _ModelState,
-                     per_coordinate: bool):
+                     per_coordinate: bool, ctx=None):
         """The paged hot path: pad the batch onto the row-bucket ladder,
         resolve entity ids to device slots, and score everything in one
         fused executable call. Cold entities (resident in neither pages
@@ -809,20 +889,49 @@ class ScoringSession:
             if kind != "random":
                 continue
             coord = st.model.coordinates[name]
+            if self._ladder_level(ctx) >= 2:
+                # fixed-effect-only margin: every slot is the -1
+                # sentinel, so the gather contributes exactly 0 — the
+                # same one-margin-path arithmetic as an unknown entity
+                re_bufs.append(st.paged[name].device_buffer)
+                slots_pad = np.full(B, -1, np.int32)
+                re_slots.append(slots_pad)
+                upload_bytes += slots_pad.nbytes
+                continue
             ids = self._entity_column_values(rows, coord, name).tolist()
             table = st.paged[name]
             buf, slots, missing = table.lookup(ids)
             missing = [m for m in missing if m != _NO_ENTITY]
+            if missing and self._ladder_level(ctx) >= 1:
+                # resident-pages-only: the store is not consulted, the
+                # missing entities keep slot -1 (fixed-only for them)
+                missing = []
+            elif missing and self._budget_blocks_fault(ctx):
+                self._note_degrade(ctx, 1, "budget")
+                missing = []
             if missing:
                 self.metrics.record_paged(faults=len(missing))
-                with obs_trace.span("paged.fault_install", cat="serve",
-                                    coordinate=name,
-                                    entities=len(missing)):
-                    entries = st.coeff_caches[name].get_many(missing)
-                    table.install(entries)
-                    # re-read: fresh buffer + installed entities' slots
-                    buf, slots, still = table.lookup(ids)
-                still = set(still) - {_NO_ENTITY}
+                t0_fault = time.monotonic()
+                try:
+                    with obs_trace.span("paged.fault_install", cat="serve",
+                                        coordinate=name,
+                                        entities=len(missing)):
+                        entries = st.coeff_caches[name].get_many(missing)
+                        table.install(entries)
+                        # re-read: fresh buffer + installed slots
+                        buf, slots, still = table.lookup(ids)
+                    self._note_fault_cost(time.monotonic() - t0_fault)
+                except Exception:
+                    if ctx is None:
+                        raise
+                    # store/install failure: serve this batch from
+                    # whatever is resident (original buf/slots — the
+                    # failed entities keep slot -1) instead of 5xx-ing
+                    self._note_fault_cost(time.monotonic() - t0_fault)
+                    self._note_degrade(ctx, 1, "store_fault")
+                    still = set()
+                else:
+                    still = set(still) - {_NO_ENTITY}
                 if still:
                     # batch entities exceed the table: host math for the
                     # overflow rows (size pages*page_rows >= max_batch

@@ -23,7 +23,10 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Callable, Optional
+
+from photon_ml_tpu.parallel import fault_injection
 
 __all__ = ["RegistryWatcher"]
 
@@ -73,29 +76,59 @@ class RegistryWatcher:
         # stop() joins that expired (a poll wedged inside a swap);
         # counted + logged, mirroring producer_join_timeouts
         self.join_timeouts = 0
+        # stale-model serving: a failing registry (corrupt LATEST,
+        # gate-refused version, unreadable manifest) must pin the live
+        # model, not wedge reload — staleness_s is how long the process
+        # has been serving without a confirmed-fresh poll, exported as
+        # photon_serve_model_staleness_seconds so on-call sees a stuck
+        # publish pipeline instead of a silent old model (poll thread
+        # writes, metrics/healthz readers — both under _age_lock)
+        self._age_lock = threading.Lock()
+        self.last_success_at = time.monotonic()
+        self._metrics = getattr(session, "metrics", None)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def staleness_s(self) -> float:
+        """Seconds since the last SUCCESSFUL poll (0 right after one —
+        an up-to-date pointer counts as success even with no swap)."""
+        with self._age_lock:
+            last = self.last_success_at
+        return max(0.0, time.monotonic() - last)
 
     def check_once(self) -> Optional[str]:
         """One poll: returns the version swapped to, or None (no change,
         no live version yet, or a tolerated transient error)."""
         self.checks += 1
         try:
+            fault_injection.check("registry.read")
             latest = self.registry.read_latest()
             if latest is None or latest == self.session.active_version:
+                self._note_success()
                 return None
             resolved = self.registry.open_version(latest)
             self.session.swap(resolved, version=latest)
         except Exception as e:
             # mid-publish registry states and swap failures are
-            # transient by construction: keep serving, retry next tick
+            # transient by construction: keep serving, retry next tick —
+            # the live _ModelState stays pinned and staleness age rises
             self.errors += 1
+            if self._metrics is not None:
+                self._metrics.set_model_staleness(self.staleness_s)
             if self.on_error is not None:
                 self.on_error(e)
             return None
+        self._note_success()
         if self.on_swap is not None:
             self.on_swap(latest)
         return latest
+
+    def _note_success(self) -> None:
+        with self._age_lock:
+            self.last_success_at = time.monotonic()
+        if self._metrics is not None:
+            self._metrics.set_model_staleness(0.0)
 
     def _next_delay(self, rng) -> float:
         """Sleep before the next poll: the plain jittered interval while

@@ -1,0 +1,184 @@
+"""CI chaos-serving smoke: store-fault storm, zero 5xx, degraded 1-2.
+
+The ci_lint.sh exit-14 leg. A tiny saved GAME model serves a 2x-batch
+concurrent burst while EVERY cold coefficient-store load is
+fault-injected to raise; the gate is the brownout contract end to end —
+100% availability (every response a 200, nothing becomes a 5xx), every
+response served at degraded level 1-2 with the level reported in the
+body AND in ``photon_serve_degraded_total{level}``. A faults-off
+control service must stay at level 0 with zero degraded counts, so the
+leg also proves the ladder is inert when nothing is wrong.
+
+Deliberately tiny (24 entities, 10 features, one micro-batcher): the
+exhaustive serving chaos matrix (delay faults, registry corruption,
+replica kill + hedging) lives in tier-1 (tests/test_serving_chaos.py);
+this leg only proves the degraded path still wires together on the
+real service stack.
+"""
+
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_ENTITIES, D_G, D_U = 24, 4, 6
+N_REQUESTS = 16  # 2x the storm service's max_batch, fired concurrently
+
+
+def _save_model(root):
+    import numpy as np
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        make_game_dataset,
+    )
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+
+    rng = np.random.default_rng(0)
+    n = N_ENTITIES * 4
+    Xg = rng.normal(size=(n, D_G))
+    Xu = rng.normal(size=(n, D_U))
+    uid = np.repeat(np.arange(N_ENTITIES), 4)
+    y = (rng.random(n) < 0.5).astype(float)
+    ds = make_game_dataset({"g": Xg, "u": Xu}, y,
+                           entity_ids={"userId": uid})
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                          reg_weight=1.0),
+         CoordinateConfig("per-user", coordinate_type="random",
+                          feature_shard="u", entity_column="userId",
+                          reg_type="l2", reg_weight=1.0)],
+        task="logistic")
+    model, _ = cd.run(ds)
+    model_dir = os.path.join(root, "model")
+    save_game_model(model, model_dir, {
+        "g": IndexMap({f"g{j}": j for j in range(D_G)}),
+        "u": IndexMap({f"u{j}": j for j in range(D_U)}),
+    })
+    return model_dir, Xg, Xu, uid
+
+
+def _rows(Xg, Xu, uid, idx):
+    return [{
+        "features": (
+            [{"name": f"g{j}", "value": float(Xg[i, j])}
+             for j in range(D_G)]
+            + [{"name": f"u{j}", "value": float(Xu[i, j])}
+               for j in range(D_U)]),
+        "entityIds": {"userId": str(uid[i])},
+    } for i in idx]
+
+
+def _make_service(model_dir):
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    # warmup=False keeps every entity cold, so the storm hits the store
+    # on the very first batch
+    session = ScoringSession(model_dir, max_batch=8,
+                             coeff_cache_entries=N_ENTITIES,
+                             warmup=False)
+    batcher = MicroBatcher(session.score_rows, max_batch=8,
+                           max_delay_ms=2.0, max_queue=256,
+                           metrics=session.metrics)
+    return ScoringService(session, batcher)
+
+
+def _burst(svc, Xg, Xu, uid):
+    results = [None] * N_REQUESTS
+
+    def fire(i):
+        results[i] = svc.handle_score(
+            {"rows": _rows(Xg, Xu, uid,
+                           [i % N_ENTITIES, (i + 7) % N_ENTITIES])})
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(N_REQUESTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+    return results
+
+
+def main() -> int:
+    from photon_ml_tpu.parallel import fault_injection as fi
+    from photon_ml_tpu.parallel.fault_injection import Fault
+
+    root = tempfile.mkdtemp(prefix="chaos-serving-")
+    model_dir, Xg, Xu, uid = _save_model(root)
+    ok = True
+
+    # -- control: faults off, the ladder must be inert ---------------------
+    svc = _make_service(model_dir)
+    try:
+        control = _burst(svc, Xg, Xu, uid)
+        bad = [r for r in control if r is None or r[0] != 200
+               or r[1].get("degraded", 0) != 0]
+        if bad:
+            print(f"chaos-serving smoke: control (faults off) produced "
+                  f"non-200 or degraded responses: {bad[:3]!r}",
+                  file=sys.stderr)
+            ok = False
+        if svc.metrics.snapshot()["degraded_total"] != 0:
+            print("chaos-serving smoke: control counted degraded "
+                  "responses with no faults armed", file=sys.stderr)
+            ok = False
+    finally:
+        svc.close()
+
+    # -- storm: 100% store.load failures under a 2x concurrent burst ------
+    svc = _make_service(model_dir)
+    try:
+        fi.install([Fault("store.load", kind="raise", at=-1,
+                          message="chaos-serving smoke: store down")])
+        try:
+            storm = _burst(svc, Xg, Xu, uid)
+        finally:
+            fi.clear()
+        statuses = [r[0] if r else None for r in storm]
+        if any(s != 200 for s in statuses):
+            print(f"chaos-serving smoke: storm availability broke "
+                  f"(statuses {statuses})", file=sys.stderr)
+            ok = False
+        levels = [r[1].get("degraded") if r else None for r in storm]
+        if not all(lv in (1, 2) for lv in levels):
+            print(f"chaos-serving smoke: storm responses not at degraded "
+                  f"1-2 (levels {levels})", file=sys.stderr)
+            ok = False
+        snap = svc.metrics.snapshot()
+        if snap["degraded_total"] < N_REQUESTS:
+            print(f"chaos-serving smoke: degraded_total "
+                  f"{snap['degraded_total']} < {N_REQUESTS}",
+                  file=sys.stderr)
+            ok = False
+        if 'photon_serve_degraded_total{level="1"}' not in \
+                svc.metrics.render():
+            print("chaos-serving smoke: degraded series missing from "
+                  "/metrics render", file=sys.stderr)
+            ok = False
+        if snap["errors_total"] != 0:
+            print(f"chaos-serving smoke: {snap['errors_total']} scoring "
+                  "errors counted (expected 0)", file=sys.stderr)
+            ok = False
+    finally:
+        svc.close()
+
+    if ok:
+        print(f"chaos-serving smoke: OK ({N_REQUESTS}/{N_REQUESTS} "
+              "requests 200 at degraded 1-2 under a 100% store-fault "
+              "storm; faults-off control stayed at level 0)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,12 @@
 #             (BENCH_SERVING_FLOOR, default 15000), 0 compile misses in
 #             steady state AND across a mid-load hot swap, 2x-overload
 #             soak sheds with 429s and zero scoring-path 5xx
+#   degrade — brownout posture (exit 11, distinct from serving's 7):
+#             offered-load sweep under store.load delay faults keeps
+#             100% non-5xx availability with a nonzero degraded
+#             fraction, zero degraded with faults off, and front-door
+#             hedging holds p99 under one slow replica to <= 2x the
+#             healthy baseline
 set -euo pipefail
 cd "$(dirname "$0")/.."
 # the smoke runs must not clobber the full-run bench artifacts (restore
@@ -24,7 +30,8 @@ cd "$(dirname "$0")/.."
 # the serving artifact was protected, so a smoke run silently replaced
 # BENCH_stream/cd with smoke-sized records)
 SNAPSHOT="$(mktemp -d)"
-for f in BENCH_stream.json BENCH_cd.json BENCH_shard.json BENCH_serving.json; do
+for f in BENCH_stream.json BENCH_cd.json BENCH_shard.json BENCH_serving.json \
+         BENCH_degrade.json; do
   cp "$f" "$SNAPSHOT/" 2>/dev/null || true
 done
 restore() {
@@ -50,4 +57,9 @@ JAX_PLATFORMS=cpu \
 BENCH_SERVING_SMOKE=1 \
 BENCH_SERVING_FLOOR="${BENCH_SERVING_FLOOR:-15000}" \
 timeout -k 10 600 python bench.py serving || serving_rc=$?
-exit "$serving_rc"
+degrade_rc=0
+JAX_PLATFORMS=cpu \
+BENCH_DEGRADE_SMOKE=1 \
+timeout -k 10 600 python bench.py degrade || degrade_rc=$?
+if [ "$serving_rc" -ne 0 ]; then exit "$serving_rc"; fi
+exit "$degrade_rc"

@@ -13,6 +13,10 @@
 #  13   the chaos smoke failed: a 4-rank simulated fit with one rank
 #       drop-killed mid-sweep no longer recovers in-job to bit parity
 #       (scripts/chaos_smoke.py — the fail-recover tentpole contract)
+#  14   the chaos-serving smoke failed: a 100% store-fault storm no
+#       longer serves 100% non-5xx at degraded levels 1-2, or the
+#       ladder degrades with no faults armed
+#       (scripts/chaos_serving_smoke.py — the brownout contract)
 cd "$(dirname "$0")/.."
 set -o pipefail
 
@@ -55,5 +59,8 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
 
 echo "== chaos smoke (4-rank fit, one rank killed, in-job recovery) =="
 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 13
+
+echo "== chaos-serving smoke (store-fault storm, degraded 1-2, 0 5xx) =="
+env JAX_PLATFORMS=cpu python scripts/chaos_serving_smoke.py || exit 14
 
 echo "ci_lint OK"

@@ -49,6 +49,15 @@ def exercise(m: ServingMetrics) -> None:
     m.record_swap('v0002"w\\x', 12.5)
     m.record_gate(True)
     m.record_gate(False)
+    m.record_degraded(1)
+    m.record_degraded(2, n=3)
+    m.record_degraded(0)  # no-op: level 0 is "not degraded"
+    m.record_deadline_drop("admission")
+    m.record_deadline_drop("queue")
+    m.record_deadline_drop("queue")
+    m.record_deadline_drop("pre_compute")
+    m.set_brownout_level(1)
+    m.set_model_staleness(42.5)
 
 
 class TestServingParity:
