@@ -27,6 +27,14 @@ and ad-hoc per-test counters. This package makes them machine-checked:
     ``join(timeout)``), PT404 (timeout-less blocking
     ``Queue.get()``/``wait()`` in a worker loop), PT405 (callback
     invoked while holding a lock).
+  - ``numerics``     — PN501 (bare float accumulation on a hot numeric
+    path), PN502 (dtype narrowing on an f64 path), PN503
+    (nondeterministic iteration order: unsorted listdir/glob, set
+    iteration), PN504 (entropy flowing into digests/fingerprints —
+    the Avro sync-marker bug class), PN505 (cross-process float
+    reduction with unpinned operand order), PN506 (NaN comparison /
+    float-literal equality in branch conditions). ``photon-check
+    --numerics`` runs just these.
 
 * **Fault-site audit** (``photon-check --fault-sites``): every
   ``fault_injection`` site registered in the package must be exercised
@@ -39,9 +47,13 @@ and ad-hoc per-test counters. This package makes them machine-checked:
   :class:`~.sanitizers.CompileSanitizer` subsumes the ad-hoc
   flat-compile counters in the serving/CD tests,
   :class:`~.sanitizers.LockOrderSanitizer` raises on acquisition-order
-  cycles with both stacks (deadlock detection without deadlocking), and
+  cycles with both stacks (deadlock detection without deadlocking),
   :class:`~.sanitizers.ThreadLeakSanitizer` asserts no photon-named
-  thread outlives its block.
+  thread outlives its block,
+  :class:`~.sanitizers.DeterminismSanitizer` replays registered pure
+  blocks twice and raises on any bitwise divergence (the PN5xx runtime
+  twin), and :class:`~.sanitizers.NaNGuard` traps NaN/Inf escaping a
+  solver kernel's host boundary with the producing site named.
 
 Findings carry ``path:line`` + a fix hint. Accepted findings are
 suppressed by the checked-in ``photon-check-baseline.json`` (every entry
@@ -65,17 +77,25 @@ from photon_ml_tpu.analysis.sanitizers import (  # noqa: F401
     CollectiveTraceSanitizer,
     CompileSanitizer,
     CompileSanitizerError,
+    DeterminismSanitizer,
+    DeterminismViolation,
     LockOrderSanitizer,
     LockOrderViolation,
+    NaNGuard,
+    NaNGuardError,
     ThreadLeakError,
     ThreadLeakSanitizer,
+    deterministic_replay,
+    nan_guard_check,
 )
 
 __all__ = [
     "__version__", "Finding", "PASS_CATALOG", "run_check", "load_baseline",
     "CollectiveTraceSanitizer", "CollectiveTraceMismatch",
     "CompileSanitizer", "CompileSanitizerError",
+    "DeterminismSanitizer", "DeterminismViolation", "deterministic_replay",
     "LockOrderSanitizer", "LockOrderViolation",
+    "NaNGuard", "NaNGuardError", "nan_guard_check",
     "ThreadLeakSanitizer", "ThreadLeakError", "repo_report",
 ]
 
@@ -111,6 +131,11 @@ def repo_report(root: str | None = None) -> dict:
             "concurrency_findings": sum(
                 1 for f in report["findings"]
                 if f.code.startswith("PT4")),
+            # the numerics passes' share (PN5xx): the bit-determinism
+            # posture the bench's parity-bearing numbers rode on
+            "numerics_findings": sum(
+                1 for f in report["findings"]
+                if f.code.startswith("PN5")),
         }
     except Exception as e:  # bench must never die on a lint bug
         out = {"version": __version__, "error": str(e)}

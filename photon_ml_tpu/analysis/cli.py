@@ -13,6 +13,7 @@ Usage:
                                        entry still needs a justification
                                        filled in before CI accepts it)
   photon-check --json                  machine-readable report
+  photon-check --numerics              PN5xx bit-determinism passes only
   photon-check --list-passes           finding-code catalogue
   photon-check --lock-graph            dump the inferred lock
                                        acquisition-order graph as DOT
@@ -68,7 +69,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "<repo-root>/tests)")
     p.add_argument("--passes", default=None,
                    help="comma list: collectives,recompile,blocking,"
-                        "concurrency")
+                        "concurrency,numerics")
+    p.add_argument("--numerics", action="store_true", dest="numerics",
+                   help="run only the PN5xx bit-determinism passes "
+                        "(shorthand for --passes numerics)")
     p.add_argument("--lock-graph", action="store_true", dest="lock_graph",
                    help="print the static lock acquisition-order graph "
                         "(PT402's model) as DOT instead of linting")
@@ -91,6 +95,8 @@ def _lint(args, repo_root: str) -> int:
             print(f"photon-check: {e}", file=sys.stderr)
             return 3
     passes = (args.passes.split(",") if args.passes else None)
+    if args.numerics:
+        passes = sorted(set(passes or []) | {"numerics"})
     report = run_check(paths, baseline=baseline, repo_root=repo_root,
                        passes=passes)
     findings = report["findings"]

@@ -117,6 +117,46 @@ PASS_CATALOG: Dict[str, Tuple[str, str]] = {
         "snapshot the callback list under the lock, release it, then "
         "fire — the PendingRequest._fire_callbacks pattern",
     ),
+    "PN501": (
+        "bare float accumulation on a hot numeric path (builtin sum() "
+        "over floats or a loop '+=': result depends on operand order)",
+        "route through the Kahan helpers in parallel/streaming.py "
+        "(_kahan_add/_make_kahan_reduce), math.fsum, or a jnp/np "
+        "reduction with pinned operand order",
+    ),
+    "PN502": (
+        "dtype narrowing on an f64 path (astype downcast, "
+        "np/jnp.float32 value cast, 32-bit dtype literal at a call "
+        "site, or a weak-typed float literal into a jitted kernel)",
+        "keep parity-bearing paths f64 end-to-end; thread dtype "
+        "through a parameter (function-default dtype knobs are exempt)",
+    ),
+    "PN503": (
+        "nondeterministic iteration order feeding downstream state "
+        "(unsorted os.listdir/glob/iterdir, or iterating a set)",
+        "wrap the listing in sorted(...) — the io/avro.py idiom — and "
+        "iterate sorted(the_set); len()/membership tests are exempt",
+    ),
+    "PN504": (
+        "entropy (urandom/uuid4/wall-clock/unseeded RNG) flowing into "
+        "a digest, fingerprint, or artifact field",
+        "derive the value from content (e.g. a schema/payload digest, "
+        "the Avro sync-marker fix) so rebuilds stay byte-identical",
+    ),
+    "PN505": (
+        "cross-process float reduction whose operand order is not "
+        "pinned (reducing a set-ordered operand in a gathering "
+        "function)",
+        "index gathered parts by rank (parts[i] for i in range(n)) "
+        "before concatenating/summing",
+    ),
+    "PN506": (
+        "NaN comparison or float-literal equality in a branch "
+        "(==/!= NaN never fires; one ulp of drift flips a float== "
+        "convergence check)",
+        "use np.isnan/math.isnan; compare against tolerances or "
+        "integral sentinels (0.0/1.0 are exempt)",
+    ),
 }
 
 
@@ -306,20 +346,23 @@ def run_check(roots: Sequence[str], *,
               passes: Optional[Sequence[str]] = None,
               hot_paths: Optional[Sequence[str]] = None,
               blocking_scope: Optional[Sequence[str]] = None,
-              concurrency_scope: Optional[Sequence[str]] = None) -> dict:
+              concurrency_scope: Optional[Sequence[str]] = None,
+              numerics_scope: Optional[Sequence[str]] = None) -> dict:
     """Run the lint passes over ``roots``.
 
     Returns a report dict: ``findings`` (unsuppressed), ``suppressed``
     (finding, via) pairs, ``stale_baseline`` entries that matched
     nothing, and ``files_checked``. ``passes`` selects a subset by
-    module name (collectives/recompile/blocking/concurrency);
-    ``hot_paths`` / ``blocking_scope`` / ``concurrency_scope`` override
-    the per-pass file scopes (None = the repo defaults; pass ``["*"]``
-    to scan every file — what the fixture tests do)."""
+    module name (collectives/recompile/blocking/concurrency/numerics);
+    ``hot_paths`` / ``blocking_scope`` / ``concurrency_scope`` /
+    ``numerics_scope`` override the per-pass file scopes (None = the
+    repo defaults; pass ``["*"]`` to scan every file — what the
+    fixture tests do)."""
     from photon_ml_tpu.analysis import (
         blocking,
         collectives,
         concurrency,
+        numerics,
         recompile,
     )
 
@@ -332,7 +375,8 @@ def run_check(roots: Sequence[str], *,
         modules.append((path, _relpath(path, repo_root), tree, lines))
 
     selected = set(passes) if passes is not None else {
-        "collectives", "recompile", "blocking", "concurrency"}
+        "collectives", "recompile", "blocking", "concurrency",
+        "numerics"}
     raw: List[Finding] = []
     if "collectives" in selected:
         raw += collectives.check_modules(modules)
@@ -342,6 +386,8 @@ def run_check(roots: Sequence[str], *,
         raw += blocking.check_modules(modules, scope=blocking_scope)
     if "concurrency" in selected:
         raw += concurrency.check_modules(modules, scope=concurrency_scope)
+    if "numerics" in selected:
+        raw += numerics.check_modules(modules, scope=numerics_scope)
     raw.sort(key=lambda f: (f.path, f.line, f.code))
 
     pragmas = {rel: pragma_map(lines) for _p, rel, _t, lines in modules}

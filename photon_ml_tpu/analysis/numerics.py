@@ -1,0 +1,502 @@
+"""Numerics lint (PN501-PN506): the bit-determinism discipline, checked.
+
+Every load-bearing guarantee in this repo is an f64 *bitwise* parity:
+sharded-vs-single-host fits, cached-vs-uncached passes, recovered-vs-
+uninterrupted runs, swap-stable serving. Those parities are re-proven
+test by test, but nothing enforced the coding discipline that makes
+them hold — they silently break the moment someone sums floats in a
+plain loop or iterates an unsorted ``os.listdir``. Six shapes:
+
+* **PN501** — bare float accumulation on a hot numeric path: builtin
+  ``sum()`` over a float-valued comprehension, or a ``+=`` of a float
+  expression inside a loop. Both are order- and rounding-sensitive;
+  the approved routes are the Kahan helpers in
+  ``parallel/streaming.py`` (``_kahan_add``/``_make_kahan_reduce``),
+  ``math.fsum``, or a jnp/np reduction whose operand order is pinned.
+  Integer counters, ``len()`` totals, and wall-clock/timing stats
+  (``*_s``/``elapsed``/``perf_counter`` — diagnostics, not
+  parity-bearing state) are exempt.
+* **PN502** — dtype narrowing on an f64 path: ``.astype`` to a 32/16-
+  bit float, ``np.float32(x)``/``jnp.float32(x)`` value casts, a
+  32/16-bit float ``dtype=`` literal at a *call site* (function-
+  parameter *defaults* are configuration knobs and exempt), or a bare
+  Python float literal passed positionally to a known-jitted callee
+  (jax weak-type promotion changes the kernel's compute dtype).
+* **PN503** — nondeterministic-order iteration feeding downstream
+  state: ``os.listdir``/``os.scandir``/``glob.glob``/``iterdir``
+  results not wrapped in ``sorted(...)`` (directory order is
+  filesystem-dependent), and loops/comprehensions iterating a ``set``
+  (string hashing is per-process randomized). ``len(...)`` totals and
+  ``in`` membership tests over the raw listing are order-free and
+  exempt. The fix idiom is ``sorted(os.listdir(p))`` (io/avro.py).
+* **PN504** — entropy flowing into digests/fingerprints/artifacts:
+  ``os.urandom``/``uuid.uuid4``/``time.time``/``datetime.now``/
+  unseeded ``random.*`` feeding a hash call, assigned to a
+  marker/digest/fingerprint-named variable, or produced inside a
+  function named like one — the PR-3 Avro sync-marker bug class,
+  caught statically. Entropy used for IDs, timestamps-as-metadata, or
+  jitter stays legal.
+* **PN505** — cross-process float reduction with unpinned operand
+  order: inside a function that gathers (``allgather_*``/
+  ``exchange_score_updates``/``process_allgather``), a reduction
+  (``concatenate``/``stack``/``sum``/``fsum``) whose operand iterates
+  a set. Gathered parts must be indexed by rank before reducing.
+* **PN506** — NaN/float-equality misuse: ``==``/``!=`` against a NaN
+  constant (always False/True — use ``isnan``), and ``==``/``!=``
+  against a non-integral float literal inside an ``if``/``while``
+  test (a convergence check that rounding will flip). Integral
+  literals (``0.0``, ``1.0``) and array-vs-array ``!=`` (the delta
+  exchange's *deliberate* bitwise-change detection) are exempt.
+
+Scope: PN501/PN502 run over the registered numeric hot-path modules
+(``DEFAULT_NUMERIC_HOT_PATHS``; override with ``numerics_scope``,
+``["*"]`` scans everything — what the fixture tests do). PN503-PN505
+run repo-wide. PN506 runs over modules that import numpy/jax
+(content-detected). Like every pass here the analysis is lexical —
+a float that arrives through three helper calls is invisible; the
+justified baseline exists for the shapes the lattice cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Sequence, Set
+
+from photon_ml_tpu.analysis.core import (
+    PASS_CATALOG,
+    Finding,
+    ancestors,
+    call_name,
+    dotted_name,
+    enclosing_function,
+    snippet_at,
+)
+
+__all__ = ["check_modules", "DEFAULT_NUMERIC_HOT_PATHS"]
+
+# Parity-bearing numeric modules: solver kernels, the CD loop, scoring,
+# streaming accumulation, the cross-process exchange. PN501/PN502 run
+# here by default; grow this list as numeric code grows.
+DEFAULT_NUMERIC_HOT_PATHS = (
+    "photon_ml_tpu/game/descent.py",
+    "photon_ml_tpu/game/random_effect.py",
+    "photon_ml_tpu/game/scoring.py",
+    "photon_ml_tpu/models/glm.py",
+    "photon_ml_tpu/ops/losses.py",
+    "photon_ml_tpu/ops/objective.py",
+    "photon_ml_tpu/ops/regularization.py",
+    "photon_ml_tpu/ops/statistics.py",
+    "photon_ml_tpu/optimize/common.py",
+    "photon_ml_tpu/optimize/lbfgs.py",
+    "photon_ml_tpu/optimize/lbfgs_margin.py",
+    "photon_ml_tpu/optimize/linesearch.py",
+    "photon_ml_tpu/optimize/owlqn.py",
+    "photon_ml_tpu/optimize/tron.py",
+    "photon_ml_tpu/evaluation/evaluators.py",
+    "photon_ml_tpu/evaluation/device.py",
+    "photon_ml_tpu/parallel/entity_shard.py",
+    "photon_ml_tpu/parallel/streaming.py",
+)
+
+# -- shared predicates ------------------------------------------------------
+# Names whose terminal segment says "this value is a float that matters":
+# the accumulator vocabulary of the solver/scoring stack.
+_FLOATISH_NAME_RE = re.compile(
+    r"(loss|score|grad|margin|resid|coef|weight|penalt|objective"
+    r"|loglik|likelihood|variance|sigma|lambda|alpha|norm|rmse|auc"
+    r"|mean|value|val)s?$", re.IGNORECASE)
+# Timing/diagnostic accumulators: stats, not parity-bearing state.
+_TIMING_NAME_RE = re.compile(
+    r"(_s|_ns|_ms|seconds|elapsed|duration|wall|latency)\d*$",
+    re.IGNORECASE)
+_TIMING_CALLS = {"perf_counter", "monotonic", "time", "time_ns",
+                 "process_time"}
+_COMPENSATED = ("kahan", "fsum", "compensated")
+
+_NARROW_DTYPES = {"float32", "float16", "bfloat16", "half", "single"}
+_LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
+_ENTROPY_CALLS = {"os.urandom", "uuid.uuid4", "uuid.uuid1", "time.time",
+                  "time.time_ns", "datetime.now", "datetime.utcnow",
+                  "random.random", "random.getrandbits", "random.randint"}
+_DIGEST_CALLS = {"sha256", "sha1", "sha512", "md5", "blake2b", "blake2s",
+                 "update"}
+_ARTIFACT_NAME_RE = re.compile(
+    r"(marker|digest|fingerprint|checksum|salt|sync)", re.IGNORECASE)
+_GATHER_CALLS = {"allgather_payload", "allgather_blobs",
+                 "allgather_objects", "allgather_status",
+                 "exchange_score_updates", "process_allgather"}
+_REDUCTION_CALLS = {"concatenate", "stack", "hstack", "vstack", "sum",
+                    "fsum"}
+
+
+def _finding(code: str, rel: str, lines, lineno: int, message: str
+             ) -> Finding:
+    return Finding(code=code, path=rel, line=lineno, message=message,
+                   hint=PASS_CATALOG[code][1],
+                   snippet=snippet_at(lines, lineno))
+
+
+def _terminal(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _has_float_evidence(expr: ast.AST) -> bool:
+    """The expression's value is (or contains) a float that matters:
+    a float() cast, a division, a non-integral float literal, .item(),
+    or a name from the solver accumulator vocabulary."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, float):
+            if not float(node.value).is_integer():
+                return True
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            return True
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in {"float", "float64", "item"}:
+                return True
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            if _FLOATISH_NAME_RE.search(_terminal(node)):
+                return True
+    return False
+
+
+def _is_timing_expr(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and call_name(node) in _TIMING_CALLS:
+            return True
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if _TIMING_NAME_RE.search(_terminal(node)):
+                return True
+    return False
+
+
+def _is_compensated_context(node: ast.AST) -> bool:
+    """The statement already routes through a compensated-summation
+    helper (Kahan/fsum) — lexically, by name anywhere in the statement
+    or the enclosing function's name."""
+    fn = enclosing_function(node)
+    if fn is not None and any(k in fn.name.lower() for k in _COMPENSATED):
+        return True
+    stmt = node
+    if not isinstance(stmt, ast.stmt):
+        for anc in ancestors(node):
+            stmt = anc
+            if isinstance(anc, ast.stmt):
+                break
+    for sub in ast.walk(stmt):
+        if isinstance(sub, (ast.Name, ast.Attribute, ast.Call)):
+            name = (call_name(sub) if isinstance(sub, ast.Call)
+                    else _terminal(sub))
+            if any(k in name.lower() for k in _COMPENSATED):
+                return True
+    return False
+
+
+def _in_sorted(node: ast.AST) -> bool:
+    for anc in ancestors(node):
+        if isinstance(anc, ast.Call) and isinstance(anc.func, ast.Name) \
+                and anc.func.id in {"sorted", "len", "set", "min", "max",
+                                    "frozenset"}:
+            # sorted() pins order; len/min/max/set are order-free sinks
+            return True
+        if isinstance(anc, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in anc.ops):
+            return True  # membership test: order-free
+        if isinstance(anc, ast.stmt):
+            return False
+    return False
+
+
+def _narrow_dtype_node(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        return _terminal(node) in _NARROW_DTYPES
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _NARROW_DTYPES
+    return False
+
+
+def _jitted_callee_names(tree: ast.Module) -> Set[str]:
+    """Names bound to jit-wrapped callables at module/function scope:
+    ``step = jax.jit(...)`` / ``kernel = cached_jit(...)``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        name = call_name(node.value)
+        dotted = dotted_name(node.value)
+        if name in {"cached_jit", "jit"} or dotted in {
+                "jax.jit", "jax.pjit", "pjit"}:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+# -- PN501: bare float accumulation -----------------------------------------
+def _check_pn501(rel, lines, tree) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "sum" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, (ast.GeneratorExp, ast.ListComp)) \
+                    and _has_float_evidence(arg.elt) \
+                    and not _is_timing_expr(arg.elt) \
+                    and not _is_compensated_context(node):
+                out.append(_finding(
+                    "PN501", rel, lines, node.lineno,
+                    "builtin sum() over a float comprehension: "
+                    "left-to-right rounding makes the result depend on "
+                    "operand order"))
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.op, ast.Add):
+            in_loop = any(isinstance(a, (ast.For, ast.While))
+                          for a in ancestors(node))
+            if not in_loop:
+                continue
+            if not _has_float_evidence(node.value):
+                continue
+            if _is_timing_expr(node.value) or _is_timing_expr(node.target):
+                continue
+            if _is_compensated_context(node):
+                continue
+            out.append(_finding(
+                "PN501", rel, lines, node.lineno,
+                f"float '+=' accumulation in a loop "
+                f"(target '{_terminal(node.target) or '?'}'): rounding "
+                "error accumulates in iteration order"))
+    return out
+
+
+# -- PN502: dtype narrowing --------------------------------------------------
+def _check_pn502(rel, lines, tree) -> List[Finding]:
+    out: List[Finding] = []
+    # function-parameter defaults are configuration, not narrowing
+    default_nodes: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in (list(node.args.defaults)
+                      + [d for d in node.args.kw_defaults if d]):
+                for sub in ast.walk(d):
+                    default_nodes.add(id(sub))
+    jitted = _jitted_callee_names(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name == "astype" and node.args \
+                and _narrow_dtype_node(node.args[0]):
+            out.append(_finding(
+                "PN502", rel, lines, node.lineno,
+                "astype() downcast to a 32/16-bit float on an f64 path"))
+            continue
+        if name in _NARROW_DTYPES and node.args \
+                and id(node) not in default_nodes:
+            out.append(_finding(
+                "PN502", rel, lines, node.lineno,
+                f"{name}() value cast narrows to 32/16-bit on an f64 "
+                "path"))
+            continue
+        for kw in node.keywords:
+            if kw.arg == "dtype" and _narrow_dtype_node(kw.value) \
+                    and id(kw.value) not in default_nodes:
+                out.append(_finding(
+                    "PN502", rel, lines, kw.value.lineno,
+                    "32/16-bit float dtype literal at a call site on an "
+                    "f64 path"))
+        if isinstance(node.func, ast.Name) and node.func.id in jitted:
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, float):
+                    out.append(_finding(
+                        "PN502", rel, lines, node.lineno,
+                        f"bare Python float literal passed to jitted "
+                        f"'{node.func.id}': weak-type promotion can "
+                        "change the kernel's compute dtype"))
+    return out
+
+
+# -- PN503: nondeterministic iteration order --------------------------------
+def _check_pn503(rel, lines, tree) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node)
+            name = call_name(node)
+            listing = (dotted in _LISTING_CALLS
+                       or (dotted == "" and name in {"listdir", "scandir",
+                                                     "iglob"})
+                       or name == "iterdir")
+            if listing and not _in_sorted(node):
+                out.append(_finding(
+                    "PN503", rel, lines, node.lineno,
+                    f"unsorted {name}(): directory order is "
+                    "filesystem-dependent and flows into downstream "
+                    "state"))
+        iter_sources: List[ast.AST] = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iter_sources.append(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iter_sources.extend(g.iter for g in node.generators)
+        for src in iter_sources:
+            is_set = (isinstance(src, (ast.Set, ast.SetComp))
+                      or (isinstance(src, ast.Call)
+                          and isinstance(src.func, ast.Name)
+                          and src.func.id in {"set", "frozenset"}))
+            if is_set:
+                out.append(_finding(
+                    "PN503", rel, lines, src.lineno,
+                    "iteration over a set: string-hash order is "
+                    "randomized per process"))
+    return out
+
+
+# -- PN504: entropy into digests/fingerprints -------------------------------
+def _check_pn504(rel, lines, tree) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = dotted_name(node)
+        name = call_name(node)
+        if not (dotted in _ENTROPY_CALLS or name == "urandom"
+                or (dotted.endswith(".now") and "datetime" in dotted)):
+            continue
+        reason = ""
+        for anc in ancestors(node):
+            if isinstance(anc, ast.Call) \
+                    and call_name(anc) in _DIGEST_CALLS:
+                reason = f"feeds a {call_name(anc)}() digest"
+                break
+            if isinstance(anc, ast.Assign):
+                for t in anc.targets:
+                    if _ARTIFACT_NAME_RE.search(_terminal(t)):
+                        reason = (f"assigned to artifact-bearing "
+                                  f"'{_terminal(t)}'")
+                        break
+                if reason:
+                    break
+        if not reason:
+            fn = enclosing_function(node)
+            if fn is not None and _ARTIFACT_NAME_RE.search(fn.name):
+                reason = f"inside {fn.name}()"
+        if reason:
+            out.append(_finding(
+                "PN504", rel, lines, node.lineno,
+                f"entropy source {name or dotted}() {reason}: the "
+                "value lands in a digest/fingerprint/artifact and "
+                "breaks byte-identical rebuilds (the Avro sync-marker "
+                "bug class)"))
+    return out
+
+
+# -- PN505: unpinned cross-process reduction --------------------------------
+def _contains_set_source(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in {"set", "frozenset"}:
+            return True
+    return False
+
+
+def _check_pn505(rel, lines, tree) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        gathers = any(isinstance(n, ast.Call)
+                      and call_name(n) in _GATHER_CALLS
+                      for n in ast.walk(fn))
+        if not gathers:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if call_name(node) not in _REDUCTION_CALLS:
+                continue
+            if _contains_set_source(node.args[0]):
+                out.append(_finding(
+                    "PN505", rel, lines, node.lineno,
+                    f"{call_name(node)}() over a set-ordered operand in "
+                    f"gathering function '{fn.name}': cross-process "
+                    "reduction order is not pinned by rank"))
+    return out
+
+
+# -- PN506: NaN / float-equality misuse -------------------------------------
+def _is_nan_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Attribute, ast.Name)) \
+            and _terminal(node) == "nan":
+        return True
+    return (isinstance(node, ast.Call) and call_name(node) == "float"
+            and node.args and isinstance(node.args[0], ast.Constant)
+            and str(node.args[0].value).lower() == "nan")
+
+
+def _nonintegral_float(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, float)
+            and node.value == node.value  # not nan
+            and not float(node.value).is_integer())
+
+
+def _check_pn506(rel, lines, tree) -> List[Finding]:
+    out: List[Finding] = []
+    test_compares: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While)):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Compare):
+                    test_compares.add(id(sub))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if any(_is_nan_expr(s) for s in sides):
+            out.append(_finding(
+                "PN506", rel, lines, node.lineno,
+                "==/!= against NaN is always False/True (IEEE 754): "
+                "the branch never fires"))
+            continue
+        if id(node) in test_compares and any(
+                _nonintegral_float(s) for s in sides):
+            out.append(_finding(
+                "PN506", rel, lines, node.lineno,
+                "float-literal equality in a branch condition: one ulp "
+                "of drift flips the check"))
+    return out
+
+
+# -- entry point ------------------------------------------------------------
+def check_modules(modules, *, scope: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    """``modules`` is ``[(path, rel, tree, lines), ...]``. ``scope``
+    overrides the PN501/PN502 hot-path list (``["*"]`` scans every
+    module for every check — the fixture-test mode)."""
+    scan_all = scope is not None and list(scope) == ["*"]
+    hot = tuple(scope) if scope is not None else DEFAULT_NUMERIC_HOT_PATHS
+    out: List[Finding] = []
+    for _path, rel, tree, lines in modules:
+        is_hot = scan_all or rel in hot or any(
+            rel.endswith(h) for h in hot)
+        if is_hot:
+            out += _check_pn501(rel, lines, tree)
+            out += _check_pn502(rel, lines, tree)
+        out += _check_pn503(rel, lines, tree)
+        out += _check_pn504(rel, lines, tree)
+        out += _check_pn505(rel, lines, tree)
+        src = "\n".join(lines)
+        if scan_all or is_hot or "numpy" in src or "jax" in src:
+            out += _check_pn506(rel, lines, tree)
+    return out

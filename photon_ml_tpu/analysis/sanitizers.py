@@ -42,6 +42,25 @@ would have deadlocked this run. Edges are recorded at blocking-acquire
 *intent* only; nonblocking probes (``acquire(False)``, Condition's
 ``_is_owned``) are check-free so they can never fabricate an ordering.
 
+**DeterminismSanitizer** — the runtime twin of the PN5xx numerics
+lint. Code marks pure, parity-bearing blocks (payload packing, delta
+computation, gather reassembly, sweep resyncs) with
+``deterministic_replay(label, fn, *args)`` — a zero-cost passthrough
+normally. While a sanitizer is armed (the simulated harness arms one
+by default, ``verify_determinism=``), each marked block runs twice
+and a bitwise difference raises :class:`DeterminismViolation` naming
+the label and the first differing array index / byte offset —
+iteration-order and hidden-state bugs caught at the block that leaks
+them, not as a cryptic end-to-end parity failure.
+
+**NaNGuard** — an opt-in NaN/Inf trap at solver-kernel host
+boundaries. The jitted kernels are single fused ``lax.while_loop``s,
+so the guard scans concrete outputs where they land on the host
+(``guard.wrap(fn, site=...)`` or the ``nan_guard_check`` hook inside
+an armed ``with NaNGuard():`` block) and raises
+:class:`NaNGuardError` naming the producing site and the first
+non-finite index.
+
 **ThreadLeakSanitizer** — a context manager asserting no NEW live
 photon-named thread (``photon-*``, ``avro-chunk-producer``,
 ``stream-transfer``, ``sim-process-*``) outlives the block, after a
@@ -74,7 +93,9 @@ from typing import (
 __all__ = [
     "CollectiveTraceMismatch", "CollectiveTraceSanitizer",
     "CompileSanitizer", "CompileSanitizerError", "describe_payload",
-    "LockOrderSanitizer", "LockOrderViolation",
+    "DeterminismSanitizer", "DeterminismViolation",
+    "deterministic_replay", "LockOrderSanitizer", "LockOrderViolation",
+    "NaNGuard", "NaNGuardError", "nan_guard_check",
     "ThreadLeakSanitizer", "ThreadLeakError", "PHOTON_THREAD_PREFIXES",
 ]
 
@@ -566,3 +587,265 @@ class ThreadLeakSanitizer:
                 f"(still alive {self.grace_s:.1f}s after exit): {names} "
                 "— a shutdown path is missing its bounded join "
                 "(PT403's runtime twin)")
+
+
+# -- determinism sanitizer --------------------------------------------------
+class DeterminismViolation(AssertionError):
+    """A registered pure block produced bitwise-different results on
+    immediate replay: hidden state (iteration order, wall clock, RNG,
+    in-place mutation of an input) is leaking into a value the repo's
+    parity contracts treat as a pure function of its inputs."""
+
+
+def _bitwise_diff(a, b, path: str = "result") -> Optional[str]:
+    """First bitwise difference between two replay results as a human
+    'where' string, or None when identical. Comparison is BITWISE —
+    NaNs with equal payloads compare equal, ``-0.0`` vs ``0.0`` does
+    not — because the contract under test is bit-parity, not ==.
+    numpy is imported lazily so this module stays stdlib-importable."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        if sorted(map(repr, a)) != sorted(map(repr, b)):
+            return f"{path}: dict keys differ ({sorted(map(repr, a))} " \
+                   f"vs {sorted(map(repr, b))})"
+        for k in a:
+            where = _bitwise_diff(a[k], b[k], f"{path}[{k!r}]")
+            if where:
+                return where
+        return None
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        if len(a) != len(b):
+            return f"{path}: length {len(a)} vs {len(b)}"
+        for i, (x, y) in enumerate(zip(a, b)):
+            where = _bitwise_diff(x, y, f"{path}[{i}]")
+            if where:
+                return where
+        return None
+    if isinstance(a, (bytes, bytearray, memoryview)) and isinstance(
+            b, (bytes, bytearray, memoryview)):
+        ab, bb = bytes(a), bytes(b)
+        if ab == bb:
+            return None
+        if len(ab) != len(bb):
+            return f"{path}: {len(ab)} vs {len(bb)} bytes"
+        off = next(i for i, (x, y) in enumerate(zip(ab, bb)) if x != y)
+        return (f"{path}: bytes first differ at offset {off} "
+                f"(0x{ab[off]:02x} vs 0x{bb[off]:02x})")
+    if hasattr(a, "dtype") or hasattr(b, "dtype"):  # np/jnp array-like
+        import numpy as np
+
+        av, bv = np.asarray(a), np.asarray(b)
+        if av.dtype != bv.dtype or av.shape != bv.shape:
+            return (f"{path}: array {av.dtype}{av.shape} vs "
+                    f"{bv.dtype}{bv.shape}")
+        ab, bb = av.tobytes(), bv.tobytes()
+        if ab == bb:
+            return None
+        mask = np.frombuffer(ab, np.uint8) != np.frombuffer(bb, np.uint8)
+        byte = int(np.flatnonzero(mask)[0])
+        idx = byte // max(av.dtype.itemsize, 1)
+        flat_a, flat_b = av.reshape(-1), bv.reshape(-1)
+        return (f"{path}: {av.dtype} array of shape {av.shape} first "
+                f"differs at flat index {idx} "
+                f"({flat_a[idx]!r} vs {flat_b[idx]!r})")
+    if isinstance(a, float) and isinstance(b, float):
+        import struct
+
+        if struct.pack("<d", a) != struct.pack("<d", b):
+            return f"{path}: {a!r} vs {b!r}"
+        return None
+    if type(a) is not type(b):
+        return (f"{path}: type {type(a).__name__} vs "
+                f"{type(b).__name__}")
+    if a != b:
+        return f"{path}: {a!r} vs {b!r}"
+    return None
+
+
+class DeterminismSanitizer:
+    """Replay registered pure blocks twice; bitwise-compare the results.
+
+    The repo's parity guarantees (sharded-vs-single-host, recovered-vs-
+    uninterrupted, cached-vs-uncached) all assume certain blocks —
+    payload packing, delta computation, gather reassembly, sweep-level
+    resyncs — are pure functions of their inputs. Code marks those
+    blocks with :func:`deterministic_replay`, a zero-cost passthrough
+    when no sanitizer is armed. While one is armed (the simulated
+    harness arms one by default)::
+
+        with DeterminismSanitizer() as san:
+            run_simulated_fit()
+        assert san.replays > 0      # the hooks actually fired
+
+    each registered block runs TWICE and a bitwise difference raises
+    :class:`DeterminismViolation` naming the block's label and the
+    first differing array index / byte offset. Replayed blocks must be
+    cheap and genuinely pure: no collectives (the replay would corrupt
+    the trace alignment), no mutation of inputs. Arming is
+    process-global: one active sanitizer at a time (enforced, like
+    :class:`LockOrderSanitizer`)."""
+
+    _active: Optional["DeterminismSanitizer"] = None
+
+    def __init__(self):
+        self.replays = 0
+        self.labels: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def __enter__(self) -> "DeterminismSanitizer":
+        if DeterminismSanitizer._active is not None:
+            raise RuntimeError("a DeterminismSanitizer is already "
+                               "active (arming is process-global)")
+        DeterminismSanitizer._active = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        DeterminismSanitizer._active = None
+        return False
+
+    @classmethod
+    def active(cls) -> Optional["DeterminismSanitizer"]:
+        return cls._active
+
+    def run(self, label: str, fn: Callable, *args, **kwargs):
+        first = fn(*args, **kwargs)
+        second = fn(*args, **kwargs)
+        with self._lock:
+            self.replays += 1
+            self.labels[label] = self.labels.get(label, 0) + 1
+        where = _bitwise_diff(first, second)
+        if where is not None:
+            raise DeterminismViolation(
+                f"replayed block '{label}' is not deterministic: two "
+                f"back-to-back runs over identical inputs diverged at "
+                f"{where} — hidden state (iteration order, wall clock, "
+                "RNG, input mutation) is leaking into a parity-bearing "
+                "value")
+        return first
+
+
+def deterministic_replay(label: str, fn: Callable, *args, **kwargs):
+    """Run ``fn(*args, **kwargs)``, replaying it under the active
+    :class:`DeterminismSanitizer` when one is armed. The production
+    cost is one global read; the marked block must be pure (no
+    collectives, no input mutation) so the replay is observable only
+    through time."""
+    san = DeterminismSanitizer._active
+    if san is None:
+        return fn(*args, **kwargs)
+    return san.run(label, fn, *args, **kwargs)
+
+
+# -- NaN guard ---------------------------------------------------------------
+class NaNGuardError(AssertionError):
+    """A guarded kernel let a NaN/Inf escape to the host."""
+
+
+def _first_nonfinite(value, path: str = "output") -> Optional[str]:
+    """First NaN/Inf in a (nested) result, or None. Float leaves only;
+    int/bool/str data cannot carry a NaN. numpy imported lazily."""
+    if isinstance(value, dict):
+        for k in sorted(value, key=repr):
+            where = _first_nonfinite(value[k], f"{path}[{k!r}]")
+            if where:
+                return where
+        return None
+    if isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            where = _first_nonfinite(v, f"{path}[{i}]")
+            if where:
+                return where
+        return None
+    if isinstance(value, float):
+        import math
+
+        if not math.isfinite(value):
+            return f"{path}: {value!r}"
+        return None
+    if hasattr(value, "dtype"):
+        import numpy as np
+
+        arr = np.asarray(value)
+        if arr.dtype.kind not in ("f", "c"):
+            return None
+        bad = ~np.isfinite(arr)
+        if bad.any():
+            idx = int(np.flatnonzero(bad.reshape(-1))[0])
+            val = arr.reshape(-1)[idx]
+            n_bad = int(bad.sum())
+            return (f"{path}: {arr.dtype} array of shape {arr.shape} "
+                    f"has {n_bad} non-finite value(s), first at flat "
+                    f"index {idx} ({val!r})")
+        return None
+    return None
+
+
+class NaNGuard:
+    """Opt-in NaN/Inf trap at a solver kernel's host boundary.
+
+    The jitted kernels (one fused ``lax.while_loop`` for L-BFGS) cannot
+    host-check mid-iteration without breaking tracing, so the guard
+    scans CONCRETE outputs where they land on the host, naming the
+    producing site::
+
+        guard = NaNGuard()
+        solve = guard.wrap(lbfgs, site="fe_solver:global")
+        with guard:
+            w, info = solve(fun_and_grad, w0, cfg)   # raises on NaN/Inf
+
+    Kernels that want guarding without threading a wrapper call
+    :func:`nan_guard_check` (a no-op unless a guard context is armed —
+    the opt-in is the ``with`` block, per run, not per call site).
+    Arming is process-global, one guard at a time."""
+
+    _active: Optional["NaNGuard"] = None
+
+    def __init__(self, site: str = ""):
+        self.site = site
+        self.checks = 0
+        self._lock = threading.Lock()
+
+    def __enter__(self) -> "NaNGuard":
+        if NaNGuard._active is not None:
+            raise RuntimeError(
+                "a NaNGuard is already active (arming is process-global)")
+        NaNGuard._active = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        NaNGuard._active = None
+        return False
+
+    @classmethod
+    def armed(cls) -> bool:
+        return cls._active is not None
+
+    def check_value(self, site: str, value) -> None:
+        with self._lock:
+            self.checks += 1
+        where = _first_nonfinite(value)
+        if where is not None:
+            raise NaNGuardError(
+                f"non-finite value escaped kernel '{site or self.site}' "
+                f"at {where} — the solver diverged (step size, "
+                "regularization, or input data) and the NaN would "
+                "silently poison every downstream reduction")
+
+    def wrap(self, fn: Callable, site: str = "") -> Callable:
+        """Guarded version of ``fn``: outputs are scanned on every call
+        (with or without an armed context — wrapping IS the opt-in)."""
+        label = site or self.site or getattr(fn, "__name__", "kernel")
+
+        def guarded(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            self.check_value(label, out)
+            return out
+
+        return guarded
+
+
+def nan_guard_check(site: str, value) -> None:
+    """Hook for kernels that guard their own host boundary: no-op (one
+    global read) unless a :class:`NaNGuard` context is armed."""
+    guard = NaNGuard._active
+    if guard is not None:
+        guard.check_value(site, value)

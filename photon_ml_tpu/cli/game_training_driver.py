@@ -795,7 +795,7 @@ def _latest_checkpoint(output_dir: str):
     def nums(name):
         return tuple(int(x) for x in re.findall(r"\d+", name)) or (-1,)
 
-    entries = [d for d in os.listdir(root)
+    entries = [d for d in sorted(os.listdir(root))
                if os.path.isdir(os.path.join(root, d))]
     live = [d for d in entries if ".tmp-" not in d and ".old-" not in d]
     # crash-window recovery: save_game_model's overwrite swap can die

@@ -34,6 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu.analysis.sanitizers import (
+    deterministic_replay,
+    nan_guard_check,
+)
 from photon_ml_tpu.evaluation import get_evaluator
 from photon_ml_tpu.game.data import (
     HostSparse,
@@ -257,6 +261,26 @@ _margins_jit = jax.jit(_margins)
 _log = logging.getLogger(__name__)
 
 
+def _changed_rows(new_np: np.ndarray, old_np: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """This shard's bitwise-changed rows and their new values — the
+    published delta. Pure in its inputs (the replay-hook contract)."""
+    rows = np.flatnonzero(new_np != old_np).astype(np.int32)
+    return rows, new_np[rows]
+
+
+def _scatter_rows(prev_np: np.ndarray, row_parts: Sequence[np.ndarray],
+                  val_parts: Sequence[np.ndarray]) -> np.ndarray:
+    """Scatter every shard's published rows (disjoint by entity
+    ownership) into a copy of the previous global vector. Pure in its
+    inputs; rank order of the parts is pinned by the gather."""
+    out = np.array(prev_np, copy=True)
+    rows = np.concatenate(list(row_parts))
+    if len(rows):
+        out[rows] = np.concatenate(list(val_parts))
+    return out
+
+
 class _ResidualTotal:
     """Running residual total ``base + sum(coordinate scores)``.
 
@@ -272,7 +296,14 @@ class _ResidualTotal:
         self.total = base
 
     def resync(self, scores: Dict[str, jax.Array]) -> None:
-        self.total = self.base + sum(scores.values())
+        # the per-sweep resync is pure in (base, scores) — dict order is
+        # insertion order, pinned by the config list — and parity-bearing,
+        # so it carries a replay hook (no-op outside the sim harness)
+        self.total = deterministic_replay(
+            "cd.residual_resync", self._recompute, scores)
+
+    def _recompute(self, scores: Dict[str, jax.Array]):
+        return self.base + sum(scores.values())
 
     def excluding(self, name: str, scores: Dict[str, jax.Array]):
         """Residual offsets for one coordinate: everything but its own
@@ -666,6 +697,10 @@ class _FixedState:
             w0, offs, jnp.asarray(self.l2, self.dtype),
             jnp.asarray(self.l1, self.dtype))
         self.w = res.w
+        # opt-in NaN trap (no-op unless a NaNGuard context is armed):
+        # the jitted solver is one fused while_loop and cannot host-check
+        # mid-iteration, so divergence is caught where the result lands
+        nan_guard_check(f"fe_solver:{self.cfg.name}", res.w)
         if self.cfg.compute_variance:
             if self.streaming:
                 if self.cfg.compute_variance == "full":
@@ -1312,13 +1347,13 @@ class CoordinateDescent:
         the table."""
         new_np = np.asarray(new_local)
         old_np = np.asarray(st.local_scores)
-        rows = np.flatnonzero(new_np != old_np).astype(np.int32)
-        vals = new_np[rows]
+        rows, vals = deterministic_replay(
+            f"cd.delta:{tag}", _changed_rows, new_np, old_np)
         if new_val_local is not None:
             vnew = np.asarray(new_val_local)
             vold = np.asarray(st.local_val_scores)
-            vrows = np.flatnonzero(vnew != vold).astype(np.int32)
-            vvals = vnew[vrows]
+            vrows, vvals = deterministic_replay(
+                f"cd.delta-val:{tag}", _changed_rows, vnew, vold)
         else:
             vrows = np.zeros(0, np.int32)
             vvals = np.zeros(0, new_np.dtype)
@@ -1327,19 +1362,16 @@ class CoordinateDescent:
                                           tag=tag, stats=self._comm)
         comm_bytes = self._comm.bytes_gathered - b0
         comm_s = self._comm.seconds - t0
-        all_rows = np.concatenate([g[0] for g in gathered])
-        all_vals = np.concatenate([g[1] for g in gathered])
-        g_np = np.array(np.asarray(prev_global), copy=True)
-        if len(all_rows):
-            g_np[all_rows] = all_vals
+        g_np = deterministic_replay(
+            f"cd.scatter:{tag}", _scatter_rows, np.asarray(prev_global),
+            [g[0] for g in gathered], [g[1] for g in gathered])
         new_global = jnp.asarray(g_np)
         new_val_global = prev_val_global
         if new_val_local is not None:
-            av_rows = np.concatenate([g[2] for g in gathered])
-            av_vals = np.concatenate([g[3] for g in gathered])
-            v_np = np.array(np.asarray(prev_val_global), copy=True)
-            if len(av_rows):
-                v_np[av_rows] = av_vals
+            v_np = deterministic_replay(
+                f"cd.scatter-val:{tag}", _scatter_rows,
+                np.asarray(prev_val_global),
+                [g[2] for g in gathered], [g[3] for g in gathered])
             new_val_global = jnp.asarray(v_np)
             st.local_val_scores = new_val_local
         st.local_scores = new_local

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from photon_ml_tpu.analysis.sanitizers import nan_guard_check
 from photon_ml_tpu.compat import shard_map
 from photon_ml_tpu.game.data import RandomEffectTrainData, REScoreBucket
 from photon_ml_tpu.ops.losses import get_loss
@@ -526,7 +527,10 @@ def train_random_effect(
         norm_mode = 2 if normalization.shifts is not None else 1
     coeffs, variances = [], []
     conv_list, iter_list = [], []
-    conv_sum, iter_sum, total, solved_total = 0.0, 0.0, 0, 0
+    # integer accumulators (PN501): these are counts — summing them as
+    # floats would be exact anyway below 2^53, but keeping them int makes
+    # the order-independence self-evident to the reader and the lint
+    conv_sum, iter_sum, total, solved_total = 0, 0, 0, 0
     for b, bucket in enumerate(data.buckets):
         E, D = bucket.num_entities, bucket.local_dim
         if E == 0:
@@ -655,12 +659,17 @@ def train_random_effect(
             conv_arr[sel] = conv
             iter_arr = np.zeros(E, np.int32)
             iter_arr[sel] = iters
+        # opt-in NaN trap at the batched per-entity solver's host
+        # boundary (no-op unless a NaNGuard context is armed)
+        nan_guard_check(f"re_solver:bucket{b}", W)
+        if compute_variance and V is not None:
+            nan_guard_check(f"re_solver:bucket{b}:variances", V)
         coeffs.append(W)
         variances.append(V)
         conv_list.append(conv_arr)
         iter_list.append(iter_arr)
-        conv_sum += float(conv_arr.sum())
-        iter_sum += float(iter_arr.sum())
+        conv_sum += int(conv_arr.sum())
+        iter_sum += int(iter_arr.sum())
         total += E
         solved_total += n_solve
     return RandomEffectFitResult(

@@ -220,7 +220,7 @@ class ChunkCacheSource:
         changed — they can never be opened again)."""
         if not os.path.isdir(self.cache_dir):
             return
-        for name in os.listdir(self.cache_dir):
+        for name in sorted(os.listdir(self.cache_dir)):
             full = os.path.join(self.cache_dir, name)
             if name.startswith(".tmp-"):
                 try:

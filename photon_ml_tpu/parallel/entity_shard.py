@@ -58,6 +58,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.analysis.sanitizers import deterministic_replay
 from photon_ml_tpu.obs import metrics as obs_metrics
 from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.parallel import fault_injection
@@ -291,9 +292,16 @@ def exchange_score_updates(arrays: Sequence[np.ndarray], *, tag: str,
     are disjoint across shards (one owner per entity), so callers can
     scatter them in any order and land on the bit-identical global
     vector the single-host loop would have computed."""
-    blobs = _guarded_gather(_pack_arrays(arrays), tag=tag, stats=stats,
-                            timeout=timeout)
-    return [_unpack_arrays(b) for b in blobs]
+    # pack and reassembly are pure and parity-bearing, so they carry
+    # replay hooks (no-ops outside an armed DeterminismSanitizer); the
+    # gather between them must NOT be replayed — a re-issued collective
+    # would corrupt the trace alignment
+    blob = deterministic_replay(
+        f"entity_shard.pack:{tag}", _pack_arrays, arrays)
+    blobs = _guarded_gather(blob, tag=tag, stats=stats, timeout=timeout)
+    return deterministic_replay(
+        f"entity_shard.unpack:{tag}",
+        lambda: [_unpack_arrays(b) for b in blobs])
 
 
 def allgather_objects(obj, *, tag: str,

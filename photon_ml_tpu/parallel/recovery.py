@@ -241,7 +241,7 @@ class RecoveryManager:
         prefix = f"shard-r{self.rank}-s"
         keep = (None if keep_sweep is None
                 else os.path.basename(self._path(self.rank, keep_sweep)))
-        for name in os.listdir(self.directory):
+        for name in sorted(os.listdir(self.directory)):
             if name.startswith(prefix) and name != keep:
                 try:
                     os.remove(os.path.join(self.directory, name))

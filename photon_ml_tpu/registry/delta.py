@@ -126,7 +126,7 @@ def _write_delta_tree(dst: str, new_model_dir: str, meta: dict,
                       spec: DeltaSpec) -> None:
     shutil.copy2(os.path.join(new_model_dir, "metadata.json"),
                  os.path.join(dst, "metadata.json"))
-    for shard in {c["feature_shard"] for c in meta["coordinates"]}:
+    for shard in sorted({c["feature_shard"] for c in meta["coordinates"]}):
         name = f"index-map.{shard}.json"
         shutil.copy2(os.path.join(new_model_dir, name),
                      os.path.join(dst, name))
@@ -197,7 +197,7 @@ def materialize(registry: ModelRegistry, version: str,
         meta = load_model_metadata(dirs[0])
         shutil.copy2(os.path.join(dirs[0], "metadata.json"),
                      os.path.join(tmp, "metadata.json"))
-        for shard in {c["feature_shard"] for c in meta["coordinates"]}:
+        for shard in sorted({c["feature_shard"] for c in meta["coordinates"]}):
             name = f"index-map.{shard}.json"
             shutil.copy2(_topmost(dirs, name), os.path.join(tmp, name))
         for c in meta["coordinates"]:

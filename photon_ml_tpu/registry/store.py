@@ -356,7 +356,7 @@ class ModelRegistry:
             removed.append(v)
         if clean_staging and os.path.isdir(self.versions_root):
             now = time.time()
-            for d in os.listdir(self.versions_root):
+            for d in sorted(os.listdir(self.versions_root)):
                 if not d.startswith(".tmp-"):
                     continue
                 full = os.path.join(self.versions_root, d)

@@ -374,7 +374,8 @@ def run_simulated_processes(n: int, fn: Callable, *,
                             join_timeout: float = 120.0,
                             verify_collectives: bool = True,
                             verify_lock_order: bool = True,
-                            verify_thread_leaks: bool = True) -> list:
+                            verify_thread_leaks: bool = True,
+                            verify_determinism: bool = True) -> list:
     """Run ``fn(process_index)`` on ``n`` simulated processes (threads,
     each under its own resilience transport + fault-injection process
     context) and return the per-process OUTCOMES: the return value,
@@ -404,8 +405,20 @@ def run_simulated_processes(n: int, fn: Callable, *,
     :class:`~photon_ml_tpu.analysis.sanitizers.ThreadLeakError` names
     the survivors. Skipped when a sim thread itself is still alive at
     ``join_timeout`` — the timeout is the finding there, and fault
-    tests that interrogate it opt out explicitly."""
+    tests that interrogate it opt out explicitly.
+
+    ``verify_determinism`` (default on) arms the determinism sanitizer
+    over the run: every block the stack marks with
+    ``sanitizers.deterministic_replay`` (delta computation, payload
+    pack/unpack, gather reassembly, sweep resyncs) executes twice, and
+    a bitwise divergence raises
+    :class:`~photon_ml_tpu.analysis.sanitizers.DeterminismViolation`
+    in the offending simulated process, naming the block and the first
+    differing array index — the PN5xx lint's runtime twin, proving the
+    parity-bearing blocks are pure functions of their inputs on every
+    harness run."""
     from photon_ml_tpu.analysis.sanitizers import (
+        DeterminismSanitizer,
         LockOrderSanitizer,
         ThreadLeakSanitizer,
     )
@@ -438,6 +451,12 @@ def run_simulated_processes(n: int, fn: Callable, *,
                 if verify_lock_order else None)
     if lock_san is not None:
         lock_san.__enter__()
+    # armed across the whole run so replay hooks fire inside every sim
+    # process; a violation raises in the offending thread and lands in
+    # its outcome slot like any other exception
+    det_san = DeterminismSanitizer() if verify_determinism else None
+    if det_san is not None:
+        det_san.__enter__()
     try:
         threads = [threading.Thread(target=run, args=(i,), daemon=True,
                                     name=f"sim-process-{i}")
@@ -448,6 +467,8 @@ def run_simulated_processes(n: int, fn: Callable, *,
         for t in threads:
             t.join(max(0.0, deadline - time.monotonic()))
     finally:
+        if det_san is not None:
+            det_san.__exit__(None, None, None)
         if lock_san is not None:
             lock_san.__exit__(None, None, None)
     any_alive = any(t.is_alive() for t in threads)

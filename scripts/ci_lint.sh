@@ -17,6 +17,9 @@
 #       longer serves 100% non-5xx at degraded levels 1-2, or the
 #       ladder degrades with no faults armed
 #       (scripts/chaos_serving_smoke.py — the brownout contract)
+#  15   a numerics finding (PN5xx): bare float accumulation, dtype
+#       narrowing, order-dependent iteration, entropy in a digest, or
+#       NaN-comparison misuse on a bit-parity-bearing path
 cd "$(dirname "$0")/.."
 set -o pipefail
 
@@ -45,6 +48,22 @@ env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
     photon_ml_tpu/obs
 rc=$?
 [ "$rc" -eq 1 ] && exit 11
+
+# The numerics passes, alone, under their own exit code: a determinism
+# or dtype regression pages differently than a threading one — it shows
+# up as parity-leg flakes, not hangs. Same rc contract as the
+# concurrency legs (only exit 1 fails here; staleness is the full
+# run's). The finding count is emitted for the CI artifact either way.
+echo "== photon-check numerics (PN501-PN506) =="
+env JAX_PLATFORMS=cpu python -m photon_ml_tpu.analysis.cli \
+    --numerics --json --baseline photon-check-baseline.json \
+    | python -c "
+import json, sys
+report = json.load(sys.stdin)
+print('numerics findings: %d (%d suppressed)'
+      % (len(report['findings']), len(report['suppressed'])))"
+rc=$?
+[ "$rc" -eq 1 ] && exit 15
 
 echo "== photon-trace smoke (2-rank record -> merge -> validate) =="
 env JAX_PLATFORMS=cpu python -m photon_ml_tpu.obs.trace_cli smoke \

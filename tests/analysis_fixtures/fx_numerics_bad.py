@@ -1,0 +1,90 @@
+"""Numerics fixtures that MUST flag: one anchored shape per PN5xx code.
+
+Every ``# ANCHOR:<code>`` marks the exact line the corresponding finding
+must land on (tests/test_photon_check_numerics.py asserts file:line).
+Not imported by anything — parsed by the lint only.
+"""
+
+import glob
+import hashlib
+import os
+import time
+
+import jax
+import numpy as np
+
+from somewhere import allgather_blobs  # noqa
+
+
+def bare_sum_of_losses(rows):
+    return sum(float(r.loss) for r in rows)  # ANCHOR:PN501a
+
+
+def loop_accumulation(deltas, n):
+    acc = 0.0
+    for d in deltas:
+        acc += d.grad / n  # ANCHOR:PN501b
+    return acc
+
+
+def narrowing_cast(x):
+    return x.astype(np.float32)  # ANCHOR:PN502a
+
+
+def narrowing_literal(n):
+    return np.zeros((n,), dtype=np.float32)  # ANCHOR:PN502b
+
+
+def _step(w, xs):
+    return w * xs
+
+
+kernel = jax.jit(_step)
+
+
+def weak_scalar_into_kernel(xs):
+    return kernel(0.5, xs)  # ANCHOR:PN502c
+
+
+def unsorted_scan(path):
+    names = []
+    for name in os.listdir(path):  # ANCHOR:PN503a
+        names.append(name)
+    return names
+
+
+def set_iteration(keys):
+    out = []
+    for key in set(keys):  # ANCHOR:PN503b
+        out.append(key)
+    return out
+
+
+def make_sync_marker():
+    marker = os.urandom(16)  # ANCHOR:PN504a
+    return marker
+
+
+def stamp_digest(payload):
+    h = hashlib.sha256(payload)
+    h.update(str(time.time()).encode())  # ANCHOR:PN504b
+    return h.digest()
+
+
+def reassemble(payload):
+    blobs = allgather_blobs(payload, tag="fx")
+    return np.sum(frozenset(blobs))  # ANCHOR:PN505
+
+
+def skip_nans(values):
+    out = []
+    for v in values:
+        if v != np.nan:  # ANCHOR:PN506a
+            out.append(v)
+    return out
+
+
+def converged(delta):
+    if delta == 1e-6:  # ANCHOR:PN506b
+        return True
+    return False

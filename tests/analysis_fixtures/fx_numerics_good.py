@@ -1,0 +1,126 @@
+"""Numerics fixtures that must stay CLEAN: the approved idiom for every
+PN5xx shape, plus the deliberate exemptions (timing stats, integer
+counters, dtype parameter defaults, len()/membership listdir sinks,
+integral-literal comparisons). Parsed by the lint only."""
+
+import glob
+import hashlib
+import math
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from somewhere import allgather_blobs  # noqa
+
+
+def _kahan_add(total, comp, value):
+    y = value - comp
+    t = total + y
+    return t, (t - total) - y
+
+
+def compensated_sum(rows):
+    total, comp = 0.0, 0.0
+    for r in rows:
+        total, comp = _kahan_add(total, comp, float(r.loss))
+    return total
+
+
+def fsum_of_losses(rows):
+    return math.fsum(float(r.loss) for r in rows)
+
+
+def pinned_reduction(values):
+    return float(np.sum(np.asarray(values)))
+
+
+def integer_counters(chunks):
+    n = 0
+    rows = 0
+    for c in chunks:
+        n += 1
+        rows += int(len(c))
+    return n, rows
+
+
+def timing_stats(chunks, decode):
+    decode_s = 0.0
+    for c in chunks:
+        t0 = time.perf_counter()
+        decode(c)
+        decode_s += time.perf_counter() - t0  # diagnostics, not parity
+    return decode_s
+
+
+def widening_cast(x):
+    return x.astype(np.float64)
+
+
+def f64_literal(n):
+    return np.zeros((n,), dtype=np.float64)
+
+
+def dtype_knob(n, dtype=jnp.float32):  # parameter default: a config knob
+    return jnp.zeros((n,), dtype)
+
+
+def sorted_scan(path):
+    names = []
+    for name in sorted(os.listdir(path)):
+        names.append(name)
+    return names
+
+
+def sorted_glob(path):
+    return sorted(glob.glob(os.path.join(path, "*.avro")))
+
+
+def order_free_sinks(path, name):
+    count = len(os.listdir(path))
+    present = name in os.listdir(path)
+    return count, present
+
+
+def sorted_set_iteration(keys):
+    out = []
+    for key in sorted(set(keys)):
+        out.append(key)
+    return out
+
+
+def content_derived_marker(schema_json):
+    return hashlib.sha256(schema_json.encode()).digest()[:16]
+
+
+def timestamp_metadata():
+    created_at = time.time()  # metadata field, not an artifact digest
+    return {"created_at": created_at}
+
+
+def rank_pinned_reassemble(payload, n):
+    blobs = allgather_blobs(payload, tag="fx")
+    return np.concatenate([np.frombuffer(blobs[i], np.float64)
+                           for i in range(n)])
+
+
+def skip_nans(values):
+    out = []
+    for v in values:
+        if not np.isnan(v):
+            out.append(v)
+    return out
+
+
+def integral_sentinels(count, tol):
+    if count == 0.0:  # integral literal: exact in f64, exempt
+        return False
+    if tol == 1.0:
+        return True
+    return False
+
+
+def bitwise_change_detection(new_np, old_np):
+    # array-vs-array != IS the repo's delta-exchange idiom: exempt
+    return np.flatnonzero(new_np != old_np)

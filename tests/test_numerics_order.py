@@ -1,0 +1,220 @@
+"""PN503 regression: directory-listing order must not be load-bearing.
+
+Each scenario runs the same housekeeping operation twice over
+identically-prepared trees — once with the real ``os.listdir`` and once
+with a scrambled one that returns entries in reverse order — and asserts
+the outcome is byte-identical: same surviving files, same contents, same
+selection. These are the four sites ISSUE/PR 14 fixed to the
+``sorted(os.listdir(...))`` idiom (io/avro.py's): recovery snapshot
+pruning, registry GC (including staging cleanup), chunk-cache sweeps,
+and the driver's latest-checkpoint resolution."""
+
+import hashlib
+import os
+import shutil
+
+import pytest
+
+from photon_ml_tpu.cli.game_training_driver import _latest_checkpoint
+from photon_ml_tpu.io.chunk_cache import ChunkCacheSource
+from photon_ml_tpu.parallel.recovery import RecoveryManager
+from photon_ml_tpu.registry.store import ModelRegistry
+
+_REAL_LISTDIR = os.listdir
+
+
+def _scrambled_listdir(path="."):
+    # the adversarial filesystem: same entries, reversed return order
+    # (listdir order is an OS/filesystem artifact, never a contract)
+    return list(reversed(_REAL_LISTDIR(path)))
+
+
+@pytest.fixture
+def scrambled(monkeypatch):
+    def arm():
+        monkeypatch.setattr(os, "listdir", _scrambled_listdir)
+
+    def disarm():
+        monkeypatch.setattr(os, "listdir", _REAL_LISTDIR)
+
+    return arm, disarm
+
+
+def _tree_state(root):
+    """{relative path: sha256(content) | 'dir'} for the whole tree —
+    the byte-identical comparison basis."""
+    state = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        for d in dirnames:
+            rel = os.path.relpath(os.path.join(dirpath, d), root)
+            state[rel] = "dir"
+        for f in filenames:
+            full = os.path.join(dirpath, f)
+            rel = os.path.relpath(full, root)
+            with open(full, "rb") as fh:
+                state[rel] = hashlib.sha256(fh.read()).hexdigest()
+    return state
+
+
+# -- recovery snapshot pruning ----------------------------------------------
+def _seed_snapshots(d):
+    os.makedirs(d)
+    for rank, sweeps in ((0, (1, 2, 3, 4, 5)), (1, (3,))):
+        for s in sweeps:
+            with open(os.path.join(d, f"shard-r{rank}-s{s}.snap.npz"),
+                      "wb") as fh:
+                fh.write(f"payload r{rank} s{s}".encode())
+
+
+def _prune_rank0(d, keep_sweep):
+    mgr = RecoveryManager(d)
+    mgr.rank = 0
+    mgr._prune(keep_sweep=keep_sweep)
+
+
+def test_recovery_prune_order_independent(tmp_path, scrambled):
+    arm, disarm = scrambled
+    natural = str(tmp_path / "natural")
+    adversarial = str(tmp_path / "adversarial")
+    _seed_snapshots(natural)
+    _seed_snapshots(adversarial)
+
+    _prune_rank0(natural, keep_sweep=3)
+    arm()
+    _prune_rank0(adversarial, keep_sweep=3)
+    disarm()
+
+    state = _tree_state(natural)
+    assert state == _tree_state(adversarial)
+    # and the prune itself did what it claims: rank 0 keeps only s3,
+    # rank 1's snapshot (a dead peer's last commit) is untouched
+    assert sorted(state) == ["shard-r0-s3.snap.npz",
+                             "shard-r1-s3.snap.npz"]
+
+
+# -- registry GC + staging cleanup -------------------------------------------
+def _seed_registry(root):
+    versions = os.path.join(root, "versions")
+    os.makedirs(versions)
+    for v in ("v000001", "v000002", "v000003", "v000004"):
+        vdir = os.path.join(versions, v)
+        os.makedirs(vdir)
+        with open(os.path.join(vdir, "manifest.json"), "w") as fh:
+            fh.write('{"version": "%s"}' % v)
+    for stale in (".tmp-1111-aa", ".tmp-2222-bb"):
+        sdir = os.path.join(versions, stale)
+        os.makedirs(sdir)
+        old = 1.0  # epoch-old mtime: far past any staging grace
+        os.utime(sdir, (old, old))
+
+
+def test_registry_gc_order_independent(tmp_path, scrambled):
+    arm, disarm = scrambled
+    natural = str(tmp_path / "natural")
+    adversarial = str(tmp_path / "adversarial")
+    _seed_registry(natural)
+    _seed_registry(adversarial)
+
+    removed_nat = ModelRegistry(natural).gc(keep=2, clean_staging=True)
+    arm()
+    removed_adv = ModelRegistry(adversarial).gc(keep=2,
+                                                clean_staging=True)
+    disarm()
+
+    assert removed_nat == removed_adv == ["v000001", "v000002"]
+    state = _tree_state(natural)
+    assert state == _tree_state(adversarial)
+    # newest two survive; both epoch-old staging dirs are swept
+    assert sorted(d for d in state if state[d] == "dir") == [
+        "versions", "versions/v000003", "versions/v000004"]
+
+
+# -- chunk-cache sweep --------------------------------------------------------
+def _seed_cache(d, live_suffix):
+    os.makedirs(d)
+    # a committed cache for a DIFFERENT fingerprint: stale, must go
+    stale = os.path.join(d, "chunks-" + "0" * 16)
+    os.makedirs(stale)
+    with open(os.path.join(stale, "meta.json"), "w") as fh:
+        fh.write("{}")
+    # two orphaned staging dirs whose writer pids are long dead
+    for tmp in (".tmp-999901-x", ".tmp-999902-y"):
+        os.makedirs(os.path.join(d, tmp))
+    # the live cache (matches the fingerprint the source will hash to)
+    live = os.path.join(d, "chunks-" + live_suffix)
+    os.makedirs(live)
+    with open(os.path.join(live, "payload.bin"), "wb") as fh:
+        fh.write(b"\x00\x01live-bytes")
+
+
+def _sweep(d):
+    # construction runs _sweep(); the fingerprint is pinned so both
+    # trees hash to the same live cache path
+    src = ChunkCacheSource([], d, fingerprint={"pin": 1})
+    return os.path.basename(src.cache_path)
+
+
+def test_chunk_cache_sweep_order_independent(tmp_path, scrambled):
+    arm, disarm = scrambled
+    probe = ChunkCacheSource([], str(tmp_path / "probe"),
+                             fingerprint={"pin": 1})
+    live_suffix = os.path.basename(probe.cache_path)[len("chunks-"):]
+
+    natural = str(tmp_path / "natural")
+    adversarial = str(tmp_path / "adversarial")
+    _seed_cache(natural, live_suffix)
+    _seed_cache(adversarial, live_suffix)
+
+    _sweep(natural)
+    arm()
+    _sweep(adversarial)
+    disarm()
+
+    state = _tree_state(natural)
+    assert state == _tree_state(adversarial)
+    # orphans and the stale-fingerprint cache are gone, live cache's
+    # payload survives bit-for-bit
+    assert sorted(state) == ["chunks-" + live_suffix,
+                             f"chunks-{live_suffix}/payload.bin"]
+
+
+# -- driver latest-checkpoint resolution --------------------------------------
+def _seed_checkpoints(out_dir):
+    root = os.path.join(out_dir, "checkpoints")
+    os.makedirs(root)
+    # identical mtimes force the numeric tiebreak: iter-10 must beat
+    # iter-9 regardless of the order listdir surfaces them
+    stamp = 1700000000.0
+    for name in ("run-iter-9", "run-iter-10", "run-iter-2"):
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        os.utime(d, (stamp, stamp))
+    os.utime(root, (stamp, stamp))
+
+
+def test_latest_checkpoint_order_independent(tmp_path, scrambled):
+    arm, disarm = scrambled
+    out = str(tmp_path / "out")
+    _seed_checkpoints(out)
+
+    natural = _latest_checkpoint(out)
+    arm()
+    adversarial = _latest_checkpoint(out)
+    disarm()
+
+    assert natural == adversarial
+    assert os.path.basename(natural) == "run-iter-10"
+
+
+# -- the idiom itself ---------------------------------------------------------
+def test_scrambler_actually_scrambles(tmp_path, scrambled):
+    # guard the guard: if the adversarial listdir ever degrades into a
+    # passthrough, every test above passes vacuously
+    arm, disarm = scrambled
+    for name in ("a", "b", "c"):
+        (tmp_path / name).touch()
+    arm()
+    scrambled_names = os.listdir(str(tmp_path))
+    disarm()
+    assert scrambled_names == list(reversed(_REAL_LISTDIR(str(tmp_path))))
+    assert sorted(scrambled_names) == ["a", "b", "c"]

@@ -1,0 +1,243 @@
+"""photon-check numerics passes (PN501-PN506): exact finding codes +
+file:line anchors against the numerics fixtures, the hot-path default
+scope for PN501/PN502, the baseline/pragma/stale-entry suppression
+contract for PN5xx, the ``--numerics`` CLI flag, and the repo-wide
+clean-state gate (0 unsuppressed findings — acceptance criterion)."""
+
+import json
+import os
+import re
+
+from photon_ml_tpu.analysis import PASS_CATALOG, repo_report
+from photon_ml_tpu.analysis.cli import main as cli_main
+from photon_ml_tpu.analysis.core import (
+    iter_python_files,
+    load_baseline,
+    parse_module,
+    run_check,
+)
+from photon_ml_tpu.analysis.numerics import (
+    DEFAULT_NUMERIC_HOT_PATHS,
+    check_modules,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _anchors(path):
+    out = {}
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            m = re.search(r"#\s*ANCHOR:(\w+)", line)
+            if m:
+                out[m.group(1)] = i
+    return out
+
+
+def _run(paths, **kw):
+    kw.setdefault("passes", ["numerics"])
+    kw.setdefault("numerics_scope", ["*"])
+    report = run_check(paths, repo_root=REPO_ROOT, **kw)
+    return report["findings"]
+
+
+def _by_code(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.code, []).append(f)
+    return out
+
+
+def _modules(paths):
+    out = []
+    for path in iter_python_files(paths):
+        tree, lines = parse_module(path)
+        if tree is None:
+            continue
+        rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+        out.append((path, rel, tree, lines))
+    return out
+
+
+# -- bad fixture: every code at its exact anchor line ------------------------
+def test_bad_fixture_exact_codes_and_lines():
+    path = _fx("fx_numerics_bad.py")
+    anchors = _anchors(path)
+    by = _by_code(_run([path]))
+    assert set(by) == {"PN501", "PN502", "PN503", "PN504", "PN505",
+                       "PN506"}
+
+    assert sorted(f.line for f in by["PN501"]) == sorted(
+        [anchors["PN501a"], anchors["PN501b"]])
+    messages = {f.line: f.message for f in by["PN501"]}
+    assert "builtin sum()" in messages[anchors["PN501a"]]
+    assert "target 'acc'" in messages[anchors["PN501b"]]
+    assert all("_kahan_add" in f.hint for f in by["PN501"])
+
+    assert sorted(f.line for f in by["PN502"]) == sorted(
+        anchors[k] for k in ("PN502a", "PN502b", "PN502c"))
+    messages = {f.line: f.message for f in by["PN502"]}
+    assert "astype() downcast" in messages[anchors["PN502a"]]
+    assert "dtype literal at a call site" in messages[anchors["PN502b"]]
+    assert "jitted 'kernel'" in messages[anchors["PN502c"]]
+
+    assert sorted(f.line for f in by["PN503"]) == sorted(
+        [anchors["PN503a"], anchors["PN503b"]])
+    messages = {f.line: f.message for f in by["PN503"]}
+    assert "unsorted listdir()" in messages[anchors["PN503a"]]
+    assert "iteration over a set" in messages[anchors["PN503b"]]
+    assert all("sorted" in f.hint for f in by["PN503"])
+
+    assert sorted(f.line for f in by["PN504"]) == sorted(
+        [anchors["PN504a"], anchors["PN504b"]])
+    messages = {f.line: f.message for f in by["PN504"]}
+    assert "'marker'" in messages[anchors["PN504a"]]
+    assert "update() digest" in messages[anchors["PN504b"]]
+    assert all("sync-marker" in f.message for f in by["PN504"])
+
+    (pn505,) = by["PN505"]
+    assert pn505.line == anchors["PN505"]
+    assert "gathering function 'reassemble'" in pn505.message
+    assert "rank" in pn505.hint
+
+    assert sorted(f.line for f in by["PN506"]) == sorted(
+        [anchors["PN506a"], anchors["PN506b"]])
+    messages = {f.line: f.message for f in by["PN506"]}
+    assert "NaN" in messages[anchors["PN506a"]]
+    assert "float-literal equality" in messages[anchors["PN506b"]]
+
+
+def test_good_fixture_clean():
+    assert _run([_fx("fx_numerics_good.py")]) == []
+
+
+# -- scope: PN501/PN502 are hot-path-only by default -------------------------
+def test_hot_path_scope_default():
+    # outside the hot list (scope=None), the accumulation/narrowing
+    # shapes are not flagged; the order/entropy/NaN shapes still are
+    path = _fx("fx_numerics_bad.py")
+    by = _by_code(_run([path], numerics_scope=None))
+    assert "PN501" not in by and "PN502" not in by
+    assert {"PN503", "PN504", "PN505", "PN506"} <= set(by)
+
+
+def test_hot_path_scope_explicit_file():
+    # naming the fixture as a hot path turns PN501/PN502 back on
+    path = _fx("fx_numerics_bad.py")
+    by = _by_code(_run(
+        [path], numerics_scope=["tests/analysis_fixtures/"
+                                "fx_numerics_bad.py"]))
+    assert "PN501" in by and "PN502" in by
+
+
+def test_default_hot_paths_exist():
+    # the registered hot list must track the tree — a renamed solver
+    # module would silently fall out of PN501/PN502 coverage
+    for rel in DEFAULT_NUMERIC_HOT_PATHS:
+        assert os.path.exists(os.path.join(REPO_ROOT, rel)), rel
+
+
+# -- suppression contract ----------------------------------------------------
+def test_pragma_requires_reason(tmp_path):
+    src = (
+        "import os\n"
+        "def scan(p):\n"
+        "    # photon-check: allow[PN503]\n"
+        "    return [n for n in os.listdir(p)]\n"
+        "def scan2(p):\n"
+        "    # photon-check: allow[PN503] one-shot tmpdir, order-free\n"
+        "    return [n for n in os.listdir(p)]\n")
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    findings = _run([str(f)])
+    # the reasonless pragma does NOT suppress; the reasoned one does
+    assert [x.code for x in findings] == ["PN503"]
+    assert findings[0].line == 4
+
+
+def test_baseline_suppresses_and_reports_stale(tmp_path):
+    path = _fx("fx_numerics_bad.py")
+    anchors = _anchors(path)
+    all_findings = _run([path])
+    target = next(f for f in all_findings
+                  if f.line == anchors["PN503a"])
+    baseline = [{
+        "code": target.code, "path": target.path,
+        "snippet": target.snippet,
+        "justification": "fixture: exercised by the suppression test",
+    }, {
+        "code": "PN503", "path": "photon_ml_tpu/gone.py",
+        "snippet": "for name in os.listdir(d):",
+        "justification": "entry for a deleted file — must go stale",
+    }]
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"entries": baseline}))
+    report = run_check([path], repo_root=REPO_ROOT,
+                       passes=["numerics"], numerics_scope=["*"],
+                       baseline=load_baseline(str(bl)))
+    assert target.line not in {f.line for f in report["findings"]}
+    assert [(f.line, via) for f, via in report["suppressed"]] == [
+        (target.line, "baseline")]
+    assert [e.path for e in report["stale_baseline"]] == [
+        "photon_ml_tpu/gone.py"]
+
+
+def test_unjustified_baseline_entry_rejected(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"entries": [{
+        "code": "PN501", "path": "x.py", "snippet": "acc += v",
+        "justification": "TODO"}]}))
+    try:
+        load_baseline(str(bl))
+    except Exception as e:
+        assert "justification" in str(e)
+    else:
+        raise AssertionError("TODO justification accepted")
+
+
+# -- catalogue + CLI + repo gate ---------------------------------------------
+def test_pass_catalog_has_pn5xx():
+    codes = {"PN501", "PN502", "PN503", "PN504", "PN505", "PN506"}
+    assert codes <= set(PASS_CATALOG)
+    for code in codes:
+        desc, hint = PASS_CATALOG[code]
+        assert desc and hint
+
+
+def test_cli_numerics_flag(capsys):
+    rc = cli_main(["--numerics", "--json", "--repo-root", REPO_ROOT,
+                   "--baseline", os.path.join(
+                       REPO_ROOT, "photon-check-baseline.json"),
+                   os.path.join(REPO_ROOT, "photon_ml_tpu")])
+    out = json.loads(capsys.readouterr().out)
+    # clean repo: the only nonzero exit a pass-scoped run may take is 3
+    # (other passes' baseline entries are stale by construction)
+    assert rc in (0, 3)
+    assert out["findings"] == []
+    for f in out["findings"]:
+        assert f["code"].startswith("PN5")
+
+
+def test_repo_is_numerics_clean():
+    # THE acceptance gate: photon-check --numerics over the package has
+    # zero unsuppressed findings, and the shared bench environment
+    # block records that posture
+    findings = _run([os.path.join(REPO_ROOT, "photon_ml_tpu")],
+                    numerics_scope=None)
+    assert findings == [], [f.render() for f in findings]
+    report = repo_report(REPO_ROOT)
+    assert report.get("numerics_findings") == 0
+    assert report.get("findings") == 0
+
+
+def test_check_modules_direct():
+    # the engine-free entry point used by repo_report-style embedding
+    findings = check_modules(_modules([_fx("fx_numerics_bad.py")]),
+                             scope=["*"])
+    assert {f.code for f in findings} == {
+        "PN501", "PN502", "PN503", "PN504", "PN505", "PN506"}
