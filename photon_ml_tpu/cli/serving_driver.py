@@ -126,6 +126,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "its observed p99 (first answer wins)")
     p.add_argument("--hedge-min-ms", type=float, default=50.0,
                    help="floor on the hedge trigger delay")
+    p.add_argument("--affinity", action="store_true",
+                   help="multi-replica front door: route each row to "
+                        "the replica OWNING its entity (stable-hash "
+                        "membership epochs; join/leave/breaker churn "
+                        "re-owns the moved slice with prefetch before "
+                        "the epoch commits; docs/serving.md)")
+    p.add_argument("--affinity-id-kind", default="auto",
+                   choices=["auto", "int", "str"],
+                   help="entity-id hashing domain for the owner map; "
+                        "auto decides per id (digits hash as int64, "
+                        "anything else as a string) to match the "
+                        "training shard map")
     p.add_argument("--watchdog-s", type=float, default=60.0,
                    help="stuck-batch watchdog; <= 0 disables")
     p.add_argument("--request-timeout-s", type=float, default=30.0)
@@ -408,14 +420,22 @@ def _run_multi_replica(args, logger) -> int:
                           host=args.host, port=args.port,
                           policy=args.front_door_policy,
                           hedge_enabled=args.hedge,
-                          hedge_min_s=args.hedge_min_ms / 1e3)
+                          hedge_min_s=args.hedge_min_ms / 1e3,
+                          affinity=args.affinity,
+                          affinity_id_kind=args.affinity_id_kind)
 
     def ready(d):
+        epoch = d.membership_epoch
         logger.log("front_door_ready", host=d.host, port=d.port,
-                   backends=[f"{args.host}:{p}" for p in ports])
+                   backends=[f"{args.host}:{p}" for p in ports],
+                   affinity=bool(args.affinity),
+                   membership_epoch=(None if epoch is None
+                                     else epoch.epoch))
+        routing = (f", entity-affinity epoch {epoch.epoch}"
+                   if epoch is not None else "")
         print(f"front door on http://{d.host}:{d.port} -> "
               f"{len(ports)} replicas on {ports} "
-              f"({args.front_door_policy})", flush=True)
+              f"({args.front_door_policy}{routing})", flush=True)
 
     try:
         door.run_forever(ready_callback=ready)

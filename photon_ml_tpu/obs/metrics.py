@@ -359,7 +359,17 @@ class ServingMetrics:
         level (0 healthy; raised under sustained queue-wait overload);
       model_staleness_seconds — gauge, how long the live model has been
         serving without a confirmed-fresh registry poll (rises while the
-        watcher pins the old version through registry failures).
+        watcher pins the old version through registry failures);
+      membership_epoch — gauge, the entity-affinity membership epoch the
+        replica currently serves under (0 = no membership applied);
+      membership_{prefetch_entities,prefetch_bytes}_total — the
+        rebalance handoff: entities/bytes prefetched into this replica's
+        caches+pages when an ownership delta moved them here;
+      membership_non_owned_skips_total — paged installs skipped because
+        the faulting entity belongs to another replica (it still scores
+        correctly through the host LRU path);
+      membership_evictions_total — resident paged rows dropped by a
+        re-own compaction (``retain_only``) when ownership shrank.
     """
 
     def __init__(self):
@@ -402,6 +412,16 @@ class ServingMetrics:
             "admission": 0, "queue": 0, "pre_compute": 0}
         self.brownout_level = 0
         self.model_staleness_s = 0.0
+        # entity-affinity membership (serve/membership.py): the applied
+        # epoch plus the rebalance-handoff accounting — prefetched
+        # entities/bytes moved per re-own, installs skipped because the
+        # entity belongs to another replica, and rows dropped by a
+        # paged table's retain_only compaction
+        self.membership_epoch = 0
+        self.membership_prefetch_entities = 0
+        self.membership_prefetch_bytes = 0
+        self.membership_non_owned_skips = 0
+        self.membership_evictions = 0
 
     # -- recording sites ---------------------------------------------------
     def record_request(self, rows: int, latency_ms: float,
@@ -504,6 +524,25 @@ class ServingMetrics:
         with self._lock:
             self.model_staleness_s = float(seconds)
 
+    def set_membership_epoch(self, epoch: int) -> None:
+        with self._lock:
+            self.membership_epoch = int(epoch)
+
+    def record_membership(self, prefetch_entities: int = 0,
+                          prefetch_bytes: int = 0,
+                          non_owned_skips: int = 0,
+                          evictions: int = 0) -> None:
+        """Membership/affinity accounting: a rebalance prefetch landed
+        ``prefetch_entities`` rows (``prefetch_bytes`` moved), a paged
+        install was skipped for ``non_owned_skips`` entities another
+        replica owns, and ``evictions`` resident rows were dropped by a
+        re-own compaction."""
+        with self._lock:
+            self.membership_prefetch_entities += int(prefetch_entities)
+            self.membership_prefetch_bytes += int(prefetch_bytes)
+            self.membership_non_owned_skips += int(non_owned_skips)
+            self.membership_evictions += int(evictions)
+
     # -- views -------------------------------------------------------------
     @staticmethod
     def _rate(hits: int, misses: int) -> float:
@@ -560,6 +599,14 @@ class ServingMetrics:
                     self.deadline_drops.get("pre_compute", 0),
                 "brownout_level": self.brownout_level,
                 "model_staleness_s": self.model_staleness_s,
+                "membership_epoch": self.membership_epoch,
+                "membership_prefetch_entities":
+                    self.membership_prefetch_entities,
+                "membership_prefetch_bytes":
+                    self.membership_prefetch_bytes,
+                "membership_non_owned_skips":
+                    self.membership_non_owned_skips,
+                "membership_evictions": self.membership_evictions,
             }
 
     def render(self) -> str:
@@ -641,4 +688,13 @@ class ServingMetrics:
             gauge("photon_serve_brownout_level", self.brownout_level)
             gauge("photon_serve_model_staleness_seconds",
                   self.model_staleness_s)
+            gauge("photon_serve_membership_epoch", self.membership_epoch)
+            counter("photon_serve_membership_prefetch_entities_total",
+                    self.membership_prefetch_entities)
+            counter("photon_serve_membership_prefetch_bytes_total",
+                    self.membership_prefetch_bytes)
+            counter("photon_serve_membership_non_owned_skips_total",
+                    self.membership_non_owned_skips)
+            counter("photon_serve_membership_evictions_total",
+                    self.membership_evictions)
             return "\n".join(out) + "\n"

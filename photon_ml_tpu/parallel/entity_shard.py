@@ -71,7 +71,7 @@ from photon_ml_tpu.parallel.resilience import (
 
 __all__ = [
     "EntityShardSpec", "EntityTableBudgetError", "ShardCommStats",
-    "stable_entity_hash", "check_table_budget",
+    "stable_entity_hash", "serving_owner_of", "check_table_budget",
     "exchange_score_updates", "allgather_objects", "allgather_blobs",
 ]
 
@@ -108,6 +108,74 @@ def stable_entity_hash(entity_ids) -> np.ndarray:
     return np.fromiter(
         (fnv1a_64(str(e).encode("utf-8")) for e in ids.ravel()),
         np.uint64, ids.size).reshape(ids.shape)
+
+
+def _int_like(entity_id) -> bool:
+    """True when a SERVING-side id (JSON string or number) would have
+    presented as an integer dtype to the training reader: a python int
+    (bools excluded — they are a different training dtype story and a
+    malformed id anyway) or a base-10 integer string, within int64 range
+    (a wider value cannot live in an int64 training column, so the
+    training side would have carried it as a string and hashed FNV)."""
+    if isinstance(entity_id, bool):
+        return False
+    if isinstance(entity_id, (int, np.integer)):
+        return -(1 << 63) <= int(entity_id) < (1 << 63)
+    if isinstance(entity_id, str):
+        s = entity_id
+        if s.startswith("-"):
+            s = s[1:]
+        if not s or not s.isdigit() or len(s) > 19:
+            return False
+        return -(1 << 63) <= int(entity_id) < (1 << 63)
+    return False
+
+
+def serving_owner_of(entity_ids, num_shards: int,
+                     id_kind: str = "auto") -> np.ndarray:
+    """int64 owning-shard index per SERVING-side entity id — the same
+    map :meth:`EntityShardSpec.owner_of` computes over the training
+    data's arrays, re-derived from the wire form (JSON strings/numbers)
+    a scoring request carries.
+
+    The dtype edge this guards: :func:`stable_entity_hash` mixes integer
+    dtypes through splitmix64 and everything else through FNV-1a 64 over
+    ``str(id)``, so ``123`` and ``"123"`` hash DIFFERENTLY. ``id_kind``
+    says which dtype the training data presented:
+
+    * ``"int"`` — the id column trained as an integer dtype; string ids
+      parse base-10 (a non-numeric id raises, surfacing the config
+      error instead of silently forking the owner map);
+    * ``"str"`` — the column trained as strings, so ``"123"`` hashes
+      FNV even though it looks numeric;
+    * ``"auto"`` — decide PER ID: integer-looking ids (see
+      :func:`_int_like`) hash as int64, the rest as strings. Per-id,
+      not per-batch, so one odd id in a request cannot move every other
+      row's owner.
+    """
+    if id_kind not in ("auto", "int", "str"):
+        raise ValueError(f"unknown id_kind {id_kind!r} "
+                         "(expected auto|int|str)")
+    ids = list(entity_ids)
+    n = np.uint64(num_shards)
+    out = np.empty(len(ids), np.int64)
+    if not ids:
+        return out
+    if id_kind == "int":
+        arr = np.asarray([int(e) for e in ids], np.int64)
+        return (stable_entity_hash(arr) % n).astype(np.int64)
+    if id_kind == "str":
+        arr = np.asarray([str(e) for e in ids])
+        return (stable_entity_hash(arr) % n).astype(np.int64)
+    mask = np.asarray([_int_like(e) for e in ids], bool)
+    if mask.any():
+        arr = np.asarray([int(e) for e, m in zip(ids, mask) if m],
+                         np.int64)
+        out[mask] = (stable_entity_hash(arr) % n).astype(np.int64)
+    if not mask.all():
+        arr = np.asarray([str(e) for e, m in zip(ids, mask) if not m])
+        out[~mask] = (stable_entity_hash(arr) % n).astype(np.int64)
+    return out
 
 
 @dataclasses.dataclass(frozen=True)

@@ -35,6 +35,11 @@ from photon_ml_tpu.serve.coeff_cache import (
     LayeredCoefficientStore,
     ModelDirCoefficientStore,
 )
+from photon_ml_tpu.serve.membership import (
+    MembershipEpoch,
+    MembershipManager,
+    MembershipView,
+)
 from photon_ml_tpu.serve.metrics import Histogram, ServingMetrics
 from photon_ml_tpu.serve.paged_table import PagedCoefficientTable
 from photon_ml_tpu.serve.session import ScoringSession
@@ -48,5 +53,6 @@ __all__ = [
     "LayeredCoefficientStore", "ModelDirCoefficientStore", "Histogram",
     "ServingMetrics", "PagedCoefficientTable", "ScoringService",
     "ScoringServer", "AsyncScoringServer", "AsyncFrontDoor",
-    "RegistryWatcher",
+    "RegistryWatcher", "MembershipEpoch", "MembershipManager",
+    "MembershipView",
 ]

@@ -27,10 +27,25 @@ that edge with an event loop:
   same registry (``serve/watcher.py``); the front door is deliberately
   model-oblivious.
 
-Admin/scoring split: ``/admin/reload`` runs in a worker thread
-(``run_in_executor``) because a swap legitimately takes milliseconds to
-seconds — the loop keeps serving scores while a swap builds off to the
-side.
+Entity-affinity routing (``affinity=True``): the front door additionally
+runs a :class:`~photon_ml_tpu.serve.membership.MembershipManager` — the
+training tier's stable-hash owner map over the live replica set — and
+routes each ``/score`` row to the replica that OWNS its entity (mixed
+batches are scattered by owner and the per-row scores merged at the
+door). Replicas learn their slice through ``POST /admin/membership``
+broadcasts; on churn (join/leave/breaker-open) the door proposes a new
+epoch, pushes the moved hot ids into their new owners' paged tables,
+and commits the epoch only AFTER every member acknowledged — a
+rebalance is a bounded warm handoff, not a cold-fault storm. When an
+owner is unroutable the request fails over to any live replica (which
+serves the foreign entities through its store/LRU path) and the
+response carries ``"routing": "fallback"`` — degraded residency, never
+a 5xx. See docs/serving.md "Entity-affinity routing & membership".
+
+Admin/scoring split: ``/admin/reload`` and ``/admin/membership`` run in
+a worker thread (``run_in_executor``) because a swap or a prefetch
+legitimately takes milliseconds to seconds — the loop keeps serving
+scores while they build off to the side.
 """
 
 from __future__ import annotations
@@ -44,6 +59,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.obs.metrics import Histogram, escape_label_value
 from photon_ml_tpu.parallel import fault_injection
+from photon_ml_tpu.serve.membership import MembershipEpoch, MembershipManager
 from photon_ml_tpu.serve.server import ScoringService
 
 __all__ = ["AsyncScoringServer", "AsyncFrontDoor", "install_uvloop"]
@@ -255,7 +271,8 @@ class AsyncScoringServer:
                     extra_headers=rid_hdr)
             return _encode_response(404, {"error": f"unknown path {path}"},
                                     extra_headers=rid_hdr)
-        if method != "POST" or path not in ("/score", "/admin/reload"):
+        if method != "POST" or path not in ("/score", "/admin/reload",
+                                            "/admin/membership"):
             return _encode_response(404, {"error": f"unknown path {path}"},
                                     extra_headers=rid_hdr)
         try:
@@ -268,6 +285,12 @@ class AsyncScoringServer:
             # swaps take ms-seconds: off the loop, scores keep flowing
             status, resp = await asyncio.get_running_loop().run_in_executor(
                 None, svc.handle_reload, payload)
+            return _encode_response(status, resp, extra_headers=rid_hdr)
+        if path == "/admin/membership":
+            # the prefetch half does store IO — off the loop (PB303),
+            # like reload; the reply still means "pages are warm"
+            status, resp = await asyncio.get_running_loop().run_in_executor(
+                None, svc.handle_membership, payload)
             return _encode_response(status, resp, extra_headers=rid_hdr)
         try:
             deadline_ms = svc.parse_deadline_ms(
@@ -438,13 +461,31 @@ class AsyncFrontDoor:
     Deadline guard: a ``/score`` carrying ``X-Deadline-Ms <= 0`` is
     shed HERE (429, ``photon_fd_deadline_rejects_total``) — the
     cheapest drop point of all — and a positive budget is forwarded to
-    the replica, whose batcher/session spend it stage by stage."""
+    the replica, whose batcher/session spend it stage by stage.
+
+    Entity affinity (``affinity=True``): ``/score`` rows are routed to
+    the replica owning their entity under the committed
+    :class:`~photon_ml_tpu.serve.membership.MembershipEpoch` (a batch
+    spanning owners is scattered and its per-row scores merged back in
+    request order). The failover ladder per owner group: owner closed →
+    route; owner open/unknown → any live replica + ``"routing":
+    "fallback"`` label (``photon_fd_owner_miss_total{reason}``: a
+    breaker-open owner is ``breaker``, an owner outside the backend
+    list is ``epoch_skew``, a hedge duplicate winning on a non-owner is
+    ``hedge``); nothing live → the plain 503. Membership changes flow
+    through :meth:`_rebalance` — propose over the live set, broadcast
+    ``/admin/membership`` (with the moved hot ids to prefetch) to every
+    member, commit only after all acknowledged. Routing is by the
+    row's first ``entityIds`` column (sorted by name): co-residency is
+    an optimization, so additional entity columns simply resolve
+    through their replica's LRU path at full fidelity."""
 
     def __init__(self, backends: Sequence[str], host: str = "127.0.0.1",
                  port: int = 0, policy: str = "least_loaded",
                  retry_backend_s: float = 1.0, breaker_threshold: int = 3,
                  hedge_enabled: bool = False, hedge_min_s: float = 0.05,
-                 hedge_min_samples: int = 20):
+                 hedge_min_samples: int = 20, affinity: bool = False,
+                 affinity_id_kind: str = "auto", hot_track: int = 4096):
         if not backends:
             raise ValueError("front door needs at least one backend")
         if policy not in ("least_loaded", "round_robin"):
@@ -476,6 +517,25 @@ class AsyncFrontDoor:
         self.hedge_wins = 0       # duplicates that answered first
         self.deadline_rejects = 0  # X-Deadline-Ms <= 0 shed at the door
         self.warming_holds = 0    # probes held half-open on "warming"
+        # -- entity-affinity membership state ------------------------------
+        self._membership: Optional[MembershipManager] = (
+            MembershipManager([b.address for b in self._backends],
+                              id_kind=affinity_id_kind,
+                              hot_track=hot_track)
+            if affinity else None)
+        self._announced = False        # epoch pushed to every member yet?
+        self._rebalance_lock = asyncio.Lock()
+        self._bg_tasks: set = set()    # live fire-and-forget rebalances
+        self.owner_routed = 0     # groups answered by their owner
+        self.scattered = 0        # batches split across owners
+        self.fallback_served = 0  # responses served off the fallback path
+        self.owner_miss: Dict[str, int] = {"breaker": 0, "epoch_skew": 0,
+                                           "hedge": 0}
+        self.epoch_commits = 0
+        self.membership_faults = 0  # rebalance failures (fd.membership)
+        self.route_faults = 0       # routing failures (fd.route)
+        self.prefetch_entities_sent = 0  # replica-reported prefetch sums
+        self.prefetch_bytes_sent = 0
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> "AsyncFrontDoor":
@@ -510,6 +570,11 @@ class AsyncFrontDoor:
                 except (NotImplementedError, RuntimeError):
                     pass
             await self.start()
+            if self._membership is not None:
+                # announce the initial epoch so every replica pages its
+                # owned slice from the first request (a failed announce
+                # is retried lazily from the request path)
+                await self._rebalance()
             if ready_callback is not None:
                 # same contract as AsyncScoringServer.run_forever: the
                 # driver's ready callback logs to disk — executor it
@@ -649,6 +714,11 @@ class AsyncFrontDoor:
                         extra_headers=rid_hdr))
                     await writer.drain()
                     continue
+                if (method == "POST"
+                        and path in ("/fd/admin/join", "/fd/admin/leave")):
+                    writer.write(await self._handle_admin(path, body, rid))
+                    await writer.drain()
+                    continue
                 deadline_ms = None
                 if method == "POST":
                     try:
@@ -671,8 +741,14 @@ class AsyncFrontDoor:
                             extra_headers=rid_hdr))
                         await writer.drain()
                         continue
-                data = await self._proxy(method, path, body, request_id=rid,
-                                         deadline_ms=deadline_ms)
+                if (self._membership is not None and method == "POST"
+                        and path == "/score"):
+                    data = await self._score_affinity(body, rid,
+                                                      deadline_ms)
+                else:
+                    data = await self._proxy(method, path, body,
+                                             request_id=rid,
+                                             deadline_ms=deadline_ms)
                 writer.write(data)
                 await writer.drain()
                 if headers.get("connection", "").lower() == "close":
@@ -721,12 +797,16 @@ class AsyncFrontDoor:
             backend.inflight -= 1
 
     async def _hedged_exchange(self, primary: _Backend, request: bytes,
-                               path: str, tried: set) -> Optional[bytes]:
+                               path: str, tried: set
+                               ) -> Tuple[Optional[bytes], bool]:
         """Race ``primary`` against (at most one) hedge duplicate: wait
         ``_hedge_delay`` on the primary; if it hasn't answered, fire the
         same request at a second backend and take whichever answers
-        first, cancelling the loser. Returns None when every attempted
-        backend failed (addresses added to ``tried``)."""
+        first, cancelling the loser. Returns ``(response, hedge_won)``;
+        the response is None when every attempted backend failed
+        (addresses added to ``tried``). ``hedge_won`` lets the affinity
+        router know the answer came from a NON-owner (the duplicate) so
+        it can label the response as fallback-served."""
         task_backend: Dict["asyncio.Task", _Backend] = {}
 
         def _spawn(b: _Backend) -> "asyncio.Task":
@@ -738,6 +818,7 @@ class AsyncFrontDoor:
         pending = {_spawn(primary)}
         delay = self._hedge_delay(primary)
         winner: Optional[bytes] = None
+        winner_was_hedge = False
         hedge_task: Optional["asyncio.Task"] = None
         while pending:
             done, pending = await asyncio.wait(
@@ -763,34 +844,41 @@ class AsyncFrontDoor:
                     winner = task.result()
                     if task is hedge_task:
                         self.hedge_wins += 1
+                        winner_was_hedge = True
             if winner is not None:
                 for task in pending:
                     task.cancel()
                 if pending:
                     await asyncio.gather(*pending, return_exceptions=True)
-                return winner
-        return None
+                return winner, winner_was_hedge
+        return None, False
 
-    async def _proxy(self, method: str, path: str, body: bytes,
-                     request_id: Optional[str] = None,
-                     deadline_ms: Optional[float] = None) -> bytes:
-        rid = request_id or obs_trace.new_request_id()
+    @staticmethod
+    def _build_request(method: str, path: str, body: bytes, rid: str,
+                       deadline_ms: Optional[float] = None) -> bytes:
         deadline_hdr = ("" if deadline_ms is None
                         else f"X-Deadline-Ms: {deadline_ms:g}\r\n")
-        request = (
+        return (
             f"{method} {path} HTTP/1.1\r\n"
             f"Host: backend\r\nContent-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n"
             f"X-Request-Id: {rid}\r\n{deadline_hdr}"
             f"Connection: keep-alive\r\n\r\n").encode("ascii") + body
-        tried: set = set()
+
+    async def _proxy(self, method: str, path: str, body: bytes,
+                     request_id: Optional[str] = None,
+                     deadline_ms: Optional[float] = None,
+                     exclude: Optional[set] = None) -> bytes:
+        rid = request_id or obs_trace.new_request_id()
+        request = self._build_request(method, path, body, rid, deadline_ms)
+        tried: set = set(exclude or ())
         with obs_trace.request_context(request_id=rid):
             for _attempt in range(2):
                 backend = self._pick(tried)
                 if backend is None:
                     break
-                data = await self._hedged_exchange(backend, request, path,
-                                                   tried)
+                data, _hedge_won = await self._hedged_exchange(
+                    backend, request, path, tried)
                 if data is not None:
                     self.proxied += 1
                     return data
@@ -799,6 +887,423 @@ class AsyncFrontDoor:
         return _encode_response(
             503, {"error": "no live backend replica", "requestId": rid},
             extra_headers=(("X-Request-Id", rid),))
+
+    # -- entity-affinity membership ----------------------------------------
+    def _backend_by_address(self, address: str) -> Optional[_Backend]:
+        for b in self._backends:
+            if b.address == address:
+                return b
+        return None
+
+    @property
+    def membership_epoch(self) -> Optional[MembershipEpoch]:
+        """The committed epoch (None when affinity is disabled)."""
+        return None if self._membership is None else self._membership.epoch
+
+    def _live_addresses(self) -> List[str]:
+        return sorted(b.address for b in self._backends
+                      if b.state == "closed")
+
+    def _membership_stale(self) -> bool:
+        """Does the committed epoch disagree with the live replica set
+        (or has the initial epoch never been announced)? Cheap enough to
+        ask per request — the rebalance itself is lazy."""
+        if self._membership is None:
+            return False
+        if not self._announced:
+            return True
+        live = tuple(self._live_addresses())
+        return bool(live) and live != self._membership.epoch.replicas
+
+    def _maybe_rebalance(self) -> None:
+        """Kick a background rebalance when the live set drifted from
+        the committed epoch. Fire-and-forget from the request path: the
+        current request routes on the committed epoch (the failover
+        ladder covers its dead owner), the NEXT requests get the new
+        one. The task set keeps strong references (a GC'd task would
+        silently drop the rebalance)."""
+        if not self._membership_stale() or self._rebalance_lock.locked():
+            return
+        task = asyncio.get_running_loop().create_task(self._rebalance())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    async def sync_membership(self) -> dict:
+        """Run one rebalance to completion — propose over the live set,
+        broadcast + prefetch, commit — and report it. The await-able
+        form of :meth:`_maybe_rebalance` for drivers, benches, and
+        tests that need 'the epoch is committed' as a postcondition."""
+        if self._membership is None:
+            return {"committed": False, "reason": "affinity disabled"}
+        return await self._rebalance()
+
+    async def _rebalance(self) -> dict:
+        """One membership transition, serialized by the rebalance lock:
+        propose a successor epoch over the live replicas, push it (plus
+        each new owner's moved hot ids to prefetch) to EVERY member,
+        and only then commit — so by the time requests route on the new
+        map, the handed-over pages are already warm. Failures are
+        counted (``membership_faults``), never raised: the committed
+        epoch keeps routing and a later request retries the
+        transition."""
+        if self._membership is None:
+            return {"committed": False, "reason": "affinity disabled"}
+        async with self._rebalance_lock:
+            try:
+                await fault_injection.async_check("fd.membership")
+                live = self._live_addresses()
+                if not live:
+                    return {"committed": False,
+                            "reason": "no live replicas"}
+                new = self._membership.propose(live)
+                if new is None and self._announced:
+                    return {"committed": False, "reason": "unchanged",
+                            "epoch": self._membership.epoch.epoch}
+                # first rebalance: the constructor epoch exists but the
+                # replicas have never heard it — announce before routing
+                target = new if new is not None else self._membership.epoch
+                moved = (self._membership.moved_ids(target)
+                         if new is not None else {})
+                with obs_trace.span("fd.rebalance", cat="serve",
+                                    epoch=target.epoch,
+                                    replicas=target.num_shards,
+                                    moved=sum(len(v)
+                                              for v in moved.values())):
+                    ok = await self._broadcast_epoch(target, moved)
+                if new is None:
+                    self._announced = ok
+                    return {"committed": ok, "epoch": target.epoch,
+                            "replicas": list(target.replicas)}
+                if not ok:
+                    self.membership_faults += 1
+                    return {"committed": False,
+                            "reason": "broadcast failed",
+                            "epoch": target.epoch}
+                if self._membership.commit(new):
+                    self.epoch_commits += 1
+                self._announced = True
+                return {"committed": True, "epoch": new.epoch,
+                        "replicas": list(new.replicas)}
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.membership_faults += 1
+                return {"committed": False, "error": str(e)}
+
+    async def _broadcast_epoch(self, epoch: MembershipEpoch,
+                               moved: Dict[int, List[str]]) -> bool:
+        """Push ``epoch`` (and each member's moved-id prefetch list) to
+        every replica in it. True only when EVERY member replied 200 —
+        the commit gate."""
+        ok = True
+        for i, addr in enumerate(epoch.replicas):
+            backend = self._backend_by_address(addr)
+            if backend is None:
+                ok = False
+                continue
+            body = json.dumps(epoch.payload(i, moved.get(i))
+                              ).encode("utf-8")
+            request = self._build_request(
+                "POST", "/admin/membership", body,
+                obs_trace.new_request_id())
+            try:
+                data = await self._timed_exchange(backend, request,
+                                                  "/admin/membership")
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                ok = False
+                continue
+            status, reply = self._parse_response(data)
+            if status != 200:
+                ok = False
+                continue
+            if isinstance(reply, dict):
+                self.prefetch_entities_sent += int(
+                    reply.get("prefetched", 0))
+                self.prefetch_bytes_sent += int(
+                    reply.get("prefetchBytes", 0))
+        return ok
+
+    async def add_backend(self, address: str) -> dict:
+        """Join a replica (``POST /fd/admin/join``): register it and
+        rebalance so it owns (and has prefetched) its slice before the
+        epoch routes to it."""
+        address = str(address)
+        if self._backend_by_address(address) is None:
+            h, _, p = address.rpartition(":")
+            self._backends.append(
+                _Backend(h or "127.0.0.1", int(p),
+                         cooldown_s=self.retry_backend_s))
+        if self._membership is None:
+            return {"committed": False, "reason": "affinity disabled"}
+        return await self._rebalance()
+
+    async def remove_backend(self, address: str) -> dict:
+        """Drain a replica out (``POST /fd/admin/leave``): deregister,
+        close its pooled connections, re-own its slice across the
+        survivors. The last backend cannot leave."""
+        address = str(address)
+        b = self._backend_by_address(address)
+        if b is not None:
+            if len(self._backends) <= 1:
+                return {"committed": False,
+                        "reason": "cannot remove the last backend"}
+            self._backends.remove(b)
+            for _r, w in b.pool:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            b.pool.clear()
+        if self._membership is None:
+            return {"committed": False, "reason": "affinity disabled"}
+        return await self._rebalance()
+
+    async def _handle_admin(self, path: str, body: bytes,
+                            rid: str) -> bytes:
+        """``POST /fd/admin/join`` / ``/fd/admin/leave`` with
+        ``{"address": "host:port"}``: mutate the replica set and run
+        the rebalance to completion before replying — a 200 here means
+        the new epoch is committed (or reports why it is not)."""
+        rid_hdr = (("X-Request-Id", rid),)
+        try:
+            payload = json.loads(body or b"null")
+            address = str(payload["address"])
+            if ":" not in address:
+                raise ValueError(f"address must be host:port, "
+                                 f"got {address!r}")
+            int(address.rpartition(":")[2])
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            return _encode_response(
+                400, {"error": f"bad admin payload: {e}",
+                      "requestId": rid}, extra_headers=rid_hdr)
+        if path.endswith("/join"):
+            result = await self.add_backend(address)
+        else:
+            result = await self.remove_backend(address)
+            if result.get("reason") == "cannot remove the last backend":
+                return _encode_response(
+                    409, {"error": result["reason"], "requestId": rid},
+                    extra_headers=rid_hdr)
+        return _encode_response(
+            200, {"backends": [b.address for b in self._backends],
+                  "rebalance": result, "requestId": rid},
+            extra_headers=rid_hdr)
+
+    # -- affinity routing --------------------------------------------------
+    @staticmethod
+    def _row_entity(row) -> Optional[str]:
+        """The routing entity id of a score row: the value of its
+        first ``entityIds`` column (sorted by column name, so routing
+        is deterministic for multi-coordinate models); None routes the
+        row with whatever owner group goes first."""
+        ids = row.get("entityIds") if isinstance(row, dict) else None
+        if not isinstance(ids, dict) or not ids:
+            return None
+        value = (next(iter(ids.values())) if len(ids) == 1
+                 else ids[min(ids)])
+        return None if value is None else str(value)
+
+    def _owner_groups(self, payload: dict, epoch: MembershipEpoch
+                      ) -> Optional[List[Tuple[str, List[int]]]]:
+        """Group a batch's row indices by owning replica address under
+        ``epoch``; None when no row carries an entity id (plain proxy
+        is the right path). Rows without an entity ride with the
+        lowest-indexed owner group — they score identically anywhere."""
+        rows = payload.get("rows")
+        if not isinstance(rows, list) or not rows:
+            return None
+        eids = [self._row_entity(r) for r in rows]
+        with_id = [(i, e) for i, e in enumerate(eids) if e is not None]
+        if not with_id:
+            return None
+        ids = [e for _i, e in with_id]
+        owners = epoch.owner_of(ids)
+        for e in ids:
+            self._membership.note_routed(e)
+        groups: Dict[int, List[int]] = {}
+        for (i, _e), o in zip(with_id, owners):
+            groups.setdefault(int(o), []).append(i)
+        free = [i for i, e in enumerate(eids) if e is None]
+        if free:
+            first = min(groups)
+            groups[first] = sorted(groups[first] + free)
+        return [(epoch.replicas[o], idxs)
+                for o, idxs in sorted(groups.items())]
+
+    def _note_owner_miss(self, reason: str) -> None:
+        self.owner_miss[reason] = self.owner_miss.get(reason, 0) + 1
+
+    @staticmethod
+    def _parse_response(data: bytes) -> Tuple[int, Optional[dict]]:
+        head, _, payload = data.partition(b"\r\n\r\n")
+        try:
+            status = int(head.split(b" ", 2)[1])
+        except (IndexError, ValueError):
+            return 500, None
+        try:
+            body = json.loads(payload) if payload else None
+        except (ValueError, json.JSONDecodeError):
+            body = None
+        return status, body if isinstance(body, dict) else None
+
+    def _label_fallback(self, data: bytes) -> bytes:
+        """Stamp ``"routing": "fallback"`` into a 200 JSON response
+        served off the non-owner path — the contract's degraded-
+        residency marker (clients alert on fidelity, not availability).
+        Forwarded headers the status contract pins (X-Request-Id,
+        Retry-After) survive the rewrite; non-200s and non-JSON bodies
+        pass through untouched."""
+        head, _, payload = data.partition(b"\r\n\r\n")
+        if b" 200 " not in head.split(b"\r\n", 1)[0]:
+            return data
+        try:
+            body = json.loads(payload)
+        except (ValueError, json.JSONDecodeError):
+            return data
+        if not isinstance(body, dict):
+            return data
+        body["routing"] = "fallback"
+        extra = []
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.strip().lower() in (b"x-request-id", b"retry-after"):
+                extra.append((k.decode("latin-1").strip(),
+                              v.decode("latin-1").strip()))
+        self.fallback_served += 1
+        return _encode_response(200, body, extra_headers=tuple(extra))
+
+    async def _owner_send(self, owner_addr: str, body: bytes, rid: str,
+                          deadline_ms: Optional[float]
+                          ) -> Tuple[bytes, bool]:
+        """Send one owner group's rows down the failover ladder:
+        owner's breaker closed → route to it (hedging may still
+        duplicate onto a non-owner; if the duplicate wins the response
+        is fallback-labeled and counted ``owner_miss{reason=hedge}``);
+        owner open (``breaker``) / not a registered backend
+        (``epoch_skew``) / failed mid-exchange → any live replica,
+        fallback-labeled. Returns ``(response_bytes, fell_back)``."""
+        backend = self._backend_by_address(owner_addr)
+        reason: Optional[str] = None
+        if backend is None:
+            reason = "epoch_skew"
+        elif backend.state != "closed":
+            self._maybe_probe(backend, time.monotonic())
+            reason = "breaker"
+        else:
+            request = self._build_request("POST", "/score", body, rid,
+                                          deadline_ms)
+            tried: set = set()
+            data, hedge_won = await self._hedged_exchange(
+                backend, request, "/score", tried)
+            if data is not None:
+                self.proxied += 1
+                self.owner_routed += 1
+                if hedge_won:
+                    # the duplicate landed on a NON-owner: it served the
+                    # foreign entities off its store/LRU path — correct
+                    # scores, degraded residency, so label it
+                    self._note_owner_miss("hedge")
+                    return self._label_fallback(data), True
+                return data, False
+            reason = "breaker"
+        self._note_owner_miss(reason)
+        data = await self._proxy("POST", "/score", body, request_id=rid,
+                                 deadline_ms=deadline_ms,
+                                 exclude={owner_addr})
+        return self._label_fallback(data), True
+
+    async def _score_affinity(self, body: bytes, rid: str,
+                              deadline_ms: Optional[float]) -> bytes:
+        """The affinity ``/score`` path: group rows by owner under the
+        committed epoch, route each group down the owner ladder,
+        scatter/merge when the batch spans owners. Any routing failure
+        (``fd.route``, malformed rows) degrades to the plain
+        least-loaded proxy — a non-owner serves every entity correctly
+        through its LRU path, so routing is never allowed to fail a
+        request that a dumb proxy would have served."""
+        self._maybe_rebalance()
+        epoch = self._membership.epoch
+        groups = None
+        try:
+            await fault_injection.async_check("fd.route")
+            payload = json.loads(body or b"null")
+            if isinstance(payload, dict):
+                groups = self._owner_groups(payload, epoch)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.route_faults += 1
+            groups = None
+        if not groups:
+            return await self._proxy("POST", "/score", body,
+                                     request_id=rid,
+                                     deadline_ms=deadline_ms)
+        if len(groups) == 1:
+            # single-owner batch: forward the ORIGINAL bytes untouched
+            data, _fell_back = await self._owner_send(
+                groups[0][0], body, rid, deadline_ms)
+            return data
+        self.scattered += 1
+        return await self._scatter_merge(groups, payload, rid,
+                                         deadline_ms)
+
+    async def _scatter_merge(self, groups: List[Tuple[str, List[int]]],
+                             payload: dict, rid: str,
+                             deadline_ms: Optional[float]) -> bytes:
+        """Fan a mixed-owner batch out by owner group (concurrently)
+        and reassemble the per-row results in request order: the row
+        partition is disjoint and exhaustive, so scores/uids/
+        scoreComponents merge by position; ``degraded`` is the worst
+        level any group was served at; ``routing`` is ``fallback`` if
+        ANY group missed its owner, else ``scatter``. A group answering
+        non-200 fails the whole batch with THAT response — merging
+        partial scores would silently misreport rows."""
+        rows = payload["rows"]
+
+        async def one(addr: str, idxs: List[int]) -> Tuple[bytes, bool]:
+            sub = {k: v for k, v in payload.items() if k != "rows"}
+            sub["rows"] = [rows[i] for i in idxs]
+            return await self._owner_send(
+                addr, json.dumps(sub).encode("utf-8"), rid, deadline_ms)
+
+        results = await asyncio.gather(
+            *(one(addr, idxs) for addr, idxs in groups))
+        n = len(rows)
+        scores = [0.0] * n
+        uids: List[object] = [None] * n
+        comps: Dict[str, List[float]] = {}
+        degraded = 0
+        have_uids = False
+        any_fallback = any(fb for _d, fb in results)
+        for (addr, idxs), (data, _fb) in zip(groups, results):
+            status, resp = self._parse_response(data)
+            if status != 200 or resp is None:
+                return data
+            if resp.get("routing") == "fallback":
+                any_fallback = True
+            degraded = max(degraded, int(resp.get("degraded", 0)))
+            for pos, s in zip(idxs, resp.get("scores", ())):
+                scores[pos] = float(s)
+            got_uids = resp.get("uids")
+            if got_uids is not None:
+                have_uids = True
+                for pos, u in zip(idxs, got_uids):
+                    uids[pos] = u
+            for cname, vals in (resp.get("scoreComponents") or {}).items():
+                dst = comps.setdefault(cname, [0.0] * n)
+                for pos, v in zip(idxs, vals):
+                    dst[pos] = float(v)
+        merged = {"scores": scores, "degraded": degraded,
+                  "routing": "fallback" if any_fallback else "scatter"}
+        if have_uids:
+            merged["uids"] = uids
+        if comps:
+            merged["scoreComponents"] = comps
+        return _encode_response(200, merged,
+                                extra_headers=(("X-Request-Id", rid),))
 
     async def _fd_metrics(self) -> str:
         """Aggregate ``/metrics`` across replicas: each backend's samples
@@ -872,10 +1377,43 @@ class AsyncFrontDoor:
         out.append(f"photon_fd_deadline_rejects_total {self.deadline_rejects}")
         out.append("# TYPE photon_fd_warming_holds_total counter")
         out.append(f"photon_fd_warming_holds_total {self.warming_holds}")
+        if self._membership is not None:
+            epoch = self._membership.epoch
+            out.append("# TYPE photon_fd_membership_epoch gauge")
+            out.append(f"photon_fd_membership_epoch {epoch.epoch}")
+            out.append("# TYPE photon_fd_membership_replicas gauge")
+            out.append(f"photon_fd_membership_replicas {epoch.num_shards}")
+            out.append("# TYPE photon_fd_owner_routed_total counter")
+            out.append(f"photon_fd_owner_routed_total {self.owner_routed}")
+            out.append("# TYPE photon_fd_scattered_total counter")
+            out.append(f"photon_fd_scattered_total {self.scattered}")
+            out.append("# TYPE photon_fd_fallback_served_total counter")
+            out.append(f"photon_fd_fallback_served_total "
+                       f"{self.fallback_served}")
+            out.append("# TYPE photon_fd_owner_miss_total counter")
+            for reason in sorted(self.owner_miss):
+                out.append(
+                    f'photon_fd_owner_miss_total'
+                    f'{{reason="{escape_label_value(reason)}"}} '
+                    f'{self.owner_miss[reason]}')
+            out.append("# TYPE photon_fd_epoch_commits_total counter")
+            out.append(f"photon_fd_epoch_commits_total "
+                       f"{self.epoch_commits}")
+            out.append("# TYPE photon_fd_membership_faults_total counter")
+            out.append(f"photon_fd_membership_faults_total "
+                       f"{self.membership_faults}")
+            out.append("# TYPE photon_fd_route_faults_total counter")
+            out.append(f"photon_fd_route_faults_total {self.route_faults}")
+            out.append("# TYPE photon_fd_prefetch_entities_total counter")
+            out.append(f"photon_fd_prefetch_entities_total "
+                       f"{self.prefetch_entities_sent}")
+            out.append("# TYPE photon_fd_prefetch_bytes_total counter")
+            out.append(f"photon_fd_prefetch_bytes_total "
+                       f"{self.prefetch_bytes_sent}")
         return "\n".join(out) + "\n"
 
     def stats(self) -> Dict[str, object]:
-        return {
+        out = {
             "policy": self.policy,
             "backends": [
                 {"address": b.address, "inflight": b.inflight,
@@ -893,3 +1431,21 @@ class AsyncFrontDoor:
             "deadlineRejects": self.deadline_rejects,
             "warmingHolds": self.warming_holds,
         }
+        if self._membership is not None:
+            epoch = self._membership.epoch
+            out["affinity"] = {
+                "epoch": epoch.epoch,
+                "replicas": list(epoch.replicas),
+                "idKind": epoch.id_kind,
+                "announced": self._announced,
+                "ownerRouted": self.owner_routed,
+                "scattered": self.scattered,
+                "fallbackServed": self.fallback_served,
+                "ownerMiss": dict(self.owner_miss),
+                "epochCommits": self.epoch_commits,
+                "membershipFaults": self.membership_faults,
+                "routeFaults": self.route_faults,
+                "prefetchedEntities": self.prefetch_entities_sent,
+                "prefetchedBytes": self.prefetch_bytes_sent,
+            }
+        return out

@@ -115,6 +115,7 @@ class PagedCoefficientTable:
         self.installs = 0
         self.page_evictions = 0
         self.absent_marks = 0
+        self.membership_drops = 0
 
     # -- introspection -----------------------------------------------------
     def __len__(self) -> int:
@@ -141,6 +142,7 @@ class PagedCoefficientTable:
                 "installs": self.installs,
                 "page_evictions": self.page_evictions,
                 "absent": len(self._absent),
+                "membership_drops": self.membership_drops,
             }
 
     # -- lookup ------------------------------------------------------------
@@ -181,6 +183,54 @@ class PagedCoefficientTable:
             self._device = self._setter(
                 self._device, 0,
                 jnp.asarray(self._host[:self.page_rows]))
+
+    def retain_only(self, keep) -> int:
+        """Drop every resident entity for which ``keep(entity_id)`` is
+        falsy and compact the survivors into the low pages — the
+        membership re-own path: when a replica's owned slice shrinks
+        (or rotates) under a new epoch, the pages its no-longer-owned
+        entities held must be free for the owned slice IMMEDIATELY, not
+        after page-LRU churn evicts them one cold fault at a time.
+        The absent set is kept (store absence is a property of the
+        model version, not of ownership). Returns the number of rows
+        dropped. Like :meth:`install`, the refresh is functional —
+        in-flight batches keep scoring their snapshot."""
+        with self._lock:
+            survivors = [(eid, self._host[slot].copy())
+                         for eid, slot in sorted(self._slots.items(),
+                                                 key=lambda kv: kv[1])
+                         if keep(eid)]
+            dropped = len(self._slots) - len(survivors)
+            if dropped == 0:
+                return 0
+            pages_before = sum(1 for f in self._fill if f)
+            self._host[:] = 0
+            self._slots.clear()
+            self._page_ids = [[] for _ in range(self.pages)]
+            self._fill = [0] * self.pages
+            for slot, (eid, row) in enumerate(survivors):
+                page = slot // self.page_rows
+                self._host[slot] = row
+                self._slots[eid] = slot
+                self._page_ids[page].append(eid)
+                self._fill[page] = slot % self.page_rows + 1
+            self.membership_drops += dropped
+            pages_after = sum(1 for f in self._fill if f)
+            touched = range(max(pages_before, pages_after))
+            with obs_trace.span("paged.retain_only", cat="serve",
+                                table=self.name, dropped=dropped,
+                                pages=len(touched)):
+                buf = self._device
+                for page in touched:
+                    rows = transfer_budget.device_put(
+                        self._host[page * self.page_rows:
+                                   (page + 1) * self.page_rows],
+                        what=f"serve.paged_retain[{self.name}]")
+                    buf = self._setter(buf, page, rows)
+                self._device = buf
+        if self._metrics is not None:
+            self._metrics.record_membership(evictions=dropped)
+        return dropped
 
     # -- install / evict ---------------------------------------------------
     def dense_row(self, entry: CoeffEntry) -> np.ndarray:

@@ -7,9 +7,9 @@ Two layers, deliberately separated:
   response and status code. The tier-1 tests exercise THIS layer
   in-process (no sockets, no ports, no flakes).
 * :class:`ScoringServer` — a ``http.server.ThreadingHTTPServer`` wrapper
-  exposing ``POST /score``, ``POST /admin/reload``, ``GET /healthz``,
-  and ``GET /metrics`` (Prometheus text). One real-HTTP smoke test
-  covers the wire.
+  exposing ``POST /score``, ``POST /admin/reload``,
+  ``POST /admin/membership``, ``GET /healthz``, and ``GET /metrics``
+  (Prometheus text). One real-HTTP smoke test covers the wire.
 
 Status-code contract (the load-shedding contract callers program
 against; see docs/serving.md):
@@ -35,7 +35,17 @@ swaps to a bare model directory when no registry is configured. Replies
 200 with the active version (``"swapped": false`` when already there),
 404 for an unknown version, 409 when the registry has no live version,
 and 503 when the swap itself failed (the previous model keeps serving —
-a failed swap never tears down the live state)."""
+a failed swap never tears down the live state).
+
+``/admin/membership`` applies an entity-affinity epoch (docs/serving.md
+"Entity-affinity routing & membership"): the front door tells this
+replica which slice of the entity universe it owns — ``{"epoch": N,
+"replicas": [...], "selfIndex": i, "idKind": "auto",
+"prefetchEntityIds"?: [...]}``. The session drops non-owned paged rows,
+prefetches the handed-over ids SYNCHRONOUSLY (so the 200 reply means
+"the pages are warm" — the front door commits the epoch only after
+every member replied), and reports ``applied: false`` for stale epochs
+(a replayed broadcast, never an error)."""
 
 from __future__ import annotations
 
@@ -208,6 +218,10 @@ class ScoringService:
         }
         if self.brownout is not None:
             body["brownout_level"] = self.brownout.level
+        # duck-typed test sessions may not carry a membership view
+        membership = getattr(self.session, "membership", None)
+        if membership is not None and membership.epoch > 0:
+            body["membership"] = membership.describe()
         return 200, body
 
     def handle_reload(self, payload) -> Tuple[int, dict]:
@@ -247,6 +261,40 @@ class ScoringService:
                 return 503, {"error": f"swap failed: {e}",
                              "activeVersion": self.session.active_version}
         return 200, {"activeVersion": active, "swapped": True}
+
+    def handle_membership(self, payload) -> Tuple[int, dict]:
+        """Apply a membership epoch (``POST /admin/membership``). The
+        reply is sent only after the owned-slice eviction AND the moved-
+        id prefetch completed — the front door's prefetch-before-commit
+        contract hangs on this reply meaning "done", not "queued"."""
+        if not isinstance(payload, dict):
+            return 400, {"error": "membership payload must be an object"}
+        try:
+            epoch = int(payload["epoch"])
+            if "replicas" in payload:
+                replicas = [str(r) for r in payload["replicas"]]
+                num_shards = len(replicas)
+                shard_index = int(payload["selfIndex"])
+            else:
+                num_shards = int(payload["numShards"])
+                shard_index = int(payload["shardIndex"])
+            id_kind = str(payload.get("idKind", "auto"))
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": f"bad membership payload: {e}"}
+        try:
+            applied = self.session.set_membership(
+                epoch=epoch, num_shards=num_shards,
+                shard_index=shard_index, id_kind=id_kind)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        body = {"applied": bool(applied),
+                "membership": self.session.membership.describe()}
+        if applied and payload.get("prefetchEntityIds"):
+            n, nbytes = self.session.prefetch_entities(
+                payload["prefetchEntityIds"])
+            body["prefetched"] = n
+            body["prefetchBytes"] = nbytes
+        return 200, body
 
     def handle_metrics(self) -> Tuple[int, str]:
         return 200, self.metrics.render()
@@ -308,7 +356,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         rid = self._request_id()
-        if self.path not in ("/score", "/admin/reload"):
+        if self.path not in ("/score", "/admin/reload",
+                             "/admin/membership"):
             self._reply(404, {"error": f"unknown path {self.path}"},
                         request_id=rid)
             return
@@ -329,6 +378,8 @@ class _Handler(BaseHTTPRequestHandler):
         with obs_trace.request_context(request_id=rid):
             if self.path == "/admin/reload":
                 status, body = self.service.handle_reload(payload)
+            elif self.path == "/admin/membership":
+                status, body = self.service.handle_membership(payload)
             else:
                 status, body = self.service.handle_score(
                     payload, request_id=rid, deadline_ms=deadline_ms)

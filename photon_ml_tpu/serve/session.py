@@ -92,6 +92,7 @@ from photon_ml_tpu.serve.coeff_cache import (
     ModelDirCoefficientStore,
 )
 from photon_ml_tpu.obs import trace as obs_trace
+from photon_ml_tpu.serve.membership import MembershipView
 from photon_ml_tpu.serve.metrics import ServingMetrics
 from photon_ml_tpu.serve.paged_table import PagedCoefficientTable
 from photon_ml_tpu.types import SparseFeatures, margins as _margins
@@ -243,6 +244,11 @@ class ScoringSession:
             target=self._install_worker, daemon=True,
             name="photon-serve-page-install")
         self._installer.start()
+
+        # -- entity-affinity membership: which slice of the entity
+        # universe THIS replica owns (serve/membership.py). Session-
+        # level, not per-version state — an epoch survives hot swaps.
+        self._membership = MembershipView()
 
         # -- shape-bucketed compile cache: survives swaps by design ----
         self._compiled: Dict[tuple, object] = {}
@@ -438,6 +444,12 @@ class ScoringSession:
                     seen = set(hot)
                     hot += [e for e in old_paged.resident_ids()
                             if e not in seen]
+                if hot and self._membership.active:
+                    # under a membership epoch, prewarm only the owned
+                    # slice — the rest of the old hot set belongs to
+                    # other replicas now and would waste the store pass
+                    owned = self._membership.owned_many(hot)
+                    hot = [e for e, o in zip(hot, owned) if o]
                 if not hot:
                     continue
                 table = new.paged.get(name)
@@ -453,6 +465,71 @@ class ScoringSession:
         self.metrics.record_swap(new.version,
                                  (time.perf_counter() - t0) * 1e3)
         return new.version
+
+    # -- entity-affinity membership ---------------------------------------
+    @property
+    def membership(self) -> MembershipView:
+        return self._membership
+
+    def set_membership(self, *, epoch: int, num_shards: int,
+                       shard_index: int, id_kind: str = "auto") -> bool:
+        """Apply a membership epoch (``POST /admin/membership``): this
+        session is shard ``shard_index`` of ``num_shards`` replicas.
+        Stale epochs are refused (returns False, nothing changes). On a
+        real ownership change, every paged table drops + compacts the
+        rows this replica no longer owns (``retain_only``) so the freed
+        pages are immediately available to the owned slice; non-owned
+        entities keep scoring correctly through the host LRU path."""
+        if not self._membership.apply(epoch, num_shards, shard_index,
+                                      id_kind):
+            return False
+        self.metrics.set_membership_epoch(self._membership.epoch)
+        if self._membership.active:
+            mv = self._membership
+            for table in self._state.paged.values():
+                table.retain_only(mv.owned)
+        return True
+
+    def prefetch_entities(self, entity_ids) -> tuple:
+        """Warm the moved slice of a membership rebalance: load
+        ``entity_ids`` through each coordinate's batched store pass
+        (``warm_entries`` — one file scan per store, not one per id)
+        and install them into the paged tables SYNCHRONOUSLY, so when
+        the front door commits the epoch the new owner's pages already
+        hold the handoff — a join/leave is a bounded transfer, not a
+        cold-start fault storm. Ids this replica does not own under the
+        applied epoch are skipped. Returns ``(entities, bytes)``
+        actually landed."""
+        ids = [str(e) for e in entity_ids]
+        mv = self._membership
+        if mv.active and ids:
+            owned = mv.owned_many(ids)
+            ids = [e for e, o in zip(ids, owned) if o]
+        if not ids:
+            return 0, 0
+        st = self._state
+        total = moved_bytes = 0
+        with obs_trace.span("membership.prefetch", cat="serve",
+                            entities=len(ids)):
+            for name, cache in st.coeff_caches.items():
+                entries = cache.warm_entries(ids)
+                present = {k: v for k, v in entries.items()
+                           if v is not None}
+                if not present:
+                    continue
+                total += len(present)
+                table = st.paged.get(name)
+                if table is not None:
+                    installed = table.install(present)
+                    moved_bytes += (installed * table.dim
+                                    * table.dtype.itemsize)
+                else:
+                    moved_bytes += sum(
+                        v.coefficients.nbytes for v in present.values())
+        if total:
+            self.metrics.record_membership(prefetch_entities=total,
+                                           prefetch_bytes=moved_bytes)
+        return total, moved_bytes
 
     def rollback(self) -> str:
         """Re-install the state the last swap replaced (its warmed
@@ -917,7 +994,23 @@ class ScoringSession:
                                         coordinate=name,
                                         entities=len(missing)):
                         entries = st.coeff_caches[name].get_many(missing)
-                        table.install(entries)
+                        to_install = entries
+                        if self._membership.active:
+                            # non-owned entities never take device pages:
+                            # they resolve through the LRU host-math path
+                            # below (next batch hits the LRU, not the
+                            # store), keeping this replica's pages for
+                            # its owned slice
+                            owned = self._membership.owned_many(
+                                list(entries))
+                            to_install = {
+                                e: entries[e]
+                                for e, o in zip(entries, owned) if o}
+                            skipped = len(entries) - len(to_install)
+                            if skipped:
+                                self.metrics.record_membership(
+                                    non_owned_skips=skipped)
+                        table.install(to_install)
                         # re-read: fresh buffer + installed slots
                         buf, slots, still = table.lookup(ids)
                     self._note_fault_cost(time.monotonic() - t0_fault)

@@ -120,6 +120,16 @@ class RegistryWatcher:
                 self.on_error(e)
             return None
         self._note_success()
+        membership = getattr(self.session, "membership", None)
+        if membership is not None and membership.epoch > 0:
+            # under an entity-affinity epoch the swap prewarmed only
+            # this replica's owned slice — worth a line when reading a
+            # replica's log against the front door's rebalance spans
+            _log.info(
+                "RegistryWatcher: swapped to %s under membership epoch "
+                "%d (shard %d of %d); prewarmed owned slice only",
+                latest, membership.epoch, membership.shard_index,
+                membership.num_shards)
         if self.on_swap is not None:
             self.on_swap(latest)
         return latest

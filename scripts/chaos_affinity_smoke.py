@@ -1,0 +1,316 @@
+"""CI membership-chaos smoke: owner kill + rejoin at availability 1.0.
+
+The ci_lint.sh exit-16 leg. A 2-replica entity-affinity front door
+serves a small workload while its membership is churned end to end:
+
+* kill the owner of half the entities mid-load — every request must
+  still answer 200 (failover responses carry the ``routing: fallback``
+  degraded label, nothing becomes a 5xx);
+* a rebalance attempted under an armed ``fd.membership`` fault must
+  fail CLOSED (no commit, fault counted) while scoring keeps serving,
+  and an armed ``fd.route`` fault must degrade routing to the plain
+  proxy without failing a request;
+* faults cleared, the epoch re-owns onto the survivor, and a cold
+  replica REJOINS — the commit gate requires its moved slice to be
+  prefetched into its paged table before the epoch routes to it;
+* every score produced under churn must match the churn-free control
+  run within the repo's paged-vs-host parity tolerance (rtol=0,
+  atol=1e-9 — the bound tests/test_paged_table.py pins): churn may
+  degrade residency, never scores.
+
+Deliberately tiny (16 entities, one front door, two replicas): the
+exhaustive matrix (hedge-to-non-owner, scatter/merge parity,
+epoch-skew misses) lives in tier-1 (tests/test_serving_affinity.py);
+this leg proves kill/rejoin wires together on the real socket stack.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_ENTITIES, D_G, D_U = 16, 4, 6
+ATOL = 1e-9  # the serving paged-vs-host parity bound (rtol=0)
+
+
+def _save_model(root):
+    import numpy as np
+
+    from photon_ml_tpu.game.descent import (
+        CoordinateConfig,
+        CoordinateDescent,
+        make_game_dataset,
+    )
+    from photon_ml_tpu.io.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+
+    rng = np.random.default_rng(0)
+    n = N_ENTITIES * 4
+    Xg = rng.normal(size=(n, D_G))
+    Xu = rng.normal(size=(n, D_U))
+    uid = np.repeat(np.arange(N_ENTITIES), 4)
+    y = (rng.random(n) < 0.5).astype(float)
+    ds = make_game_dataset({"g": Xg, "u": Xu}, y,
+                           entity_ids={"userId": uid})
+    cd = CoordinateDescent(
+        [CoordinateConfig("fixed", feature_shard="g", reg_type="l2",
+                          reg_weight=1.0),
+         CoordinateConfig("per-user", coordinate_type="random",
+                          feature_shard="u", entity_column="userId",
+                          reg_type="l2", reg_weight=1.0)],
+        task="logistic")
+    model, _ = cd.run(ds)
+    model_dir = os.path.join(root, "model")
+    save_game_model(model, model_dir, {
+        "g": IndexMap({f"g{j}": j for j in range(D_G)}),
+        "u": IndexMap({f"u{j}": j for j in range(D_U)}),
+    })
+    return model_dir, Xg, Xu, uid
+
+
+def _rows(Xg, Xu, uid, idx):
+    return [{
+        "features": (
+            [{"name": f"g{j}", "value": float(Xg[i, j])}
+             for j in range(D_G)]
+            + [{"name": f"u{j}", "value": float(Xu[i, j])}
+               for j in range(D_U)]),
+        "entityIds": {"userId": str(uid[i])},
+    } for i in idx]
+
+
+def _make_service(model_dir):
+    from photon_ml_tpu.serve import (
+        MicroBatcher,
+        ScoringService,
+        ScoringSession,
+    )
+
+    session = ScoringSession(model_dir, max_batch=8,
+                             coeff_cache_entries=N_ENTITIES)
+    batcher = MicroBatcher(session.score_rows, max_batch=8,
+                           max_delay_ms=2.0, max_queue=256,
+                           metrics=session.metrics)
+    return ScoringService(session, batcher)
+
+
+async def _post_score(host, port, rows):
+    reader, writer = await asyncio.open_connection(host, port)
+    body = json.dumps({"rows": rows}).encode()
+    writer.write((f"POST /score HTTP/1.1\r\nHost: smoke\r\n"
+                  f"Content-Type: application/json\r\n"
+                  f"Content-Length: {len(body)}\r\n\r\n"
+                  ).encode() + body)
+    await writer.drain()
+    head = await reader.readuntil(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    length = 0
+    for line in head.split(b"\r\n")[1:]:
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":", 1)[1])
+    raw = await reader.readexactly(length) if length else b""
+    writer.close()
+    return status, (json.loads(raw) if raw else None)
+
+
+def _entity_batches(Xg, Xu, uid):
+    """One small batch per entity id: (entity, rows) pairs."""
+    out = []
+    for ent in range(N_ENTITIES):
+        idx = [i for i in range(len(uid)) if uid[i] == ent][:2]
+        out.append((ent, _rows(Xg, Xu, uid, idx)))
+    return out
+
+
+async def _control_run(model_dir, batches):
+    """Churn-free reference: same door topology, no kills."""
+    from photon_ml_tpu.serve import AsyncFrontDoor, AsyncScoringServer
+
+    services = [_make_service(model_dir) for _ in range(2)]
+    servers = [await AsyncScoringServer(s).start() for s in services]
+    door = await AsyncFrontDoor(
+        [f"{s.host}:{s.port}" for s in servers], affinity=True).start()
+    scores = {}
+    try:
+        await door.sync_membership()
+        for ent, rows in batches:
+            status, body = await _post_score(door.host, door.port, rows)
+            assert status == 200, f"control 5xx: {status}"
+            scores[ent] = body["scores"]
+    finally:
+        await door.aclose()
+        for s in servers:
+            await s.aclose()
+    return scores
+
+
+async def _churn_run(model_dir, batches, errors):
+    from photon_ml_tpu.parallel import fault_injection as fi
+    from photon_ml_tpu.parallel.fault_injection import Fault
+    from photon_ml_tpu.serve import AsyncFrontDoor, AsyncScoringServer
+
+    services = [_make_service(model_dir) for _ in range(2)]
+    servers = [await AsyncScoringServer(s).start() for s in services]
+    door = await AsyncFrontDoor(
+        [f"{s.host}:{s.port}" for s in servers],
+        affinity=True, breaker_threshold=1).start()
+    scores = {}
+    statuses = []
+    labels = []
+    dead_i = -1
+    revived = None
+
+    def take(ent, status, body):
+        statuses.append(status)
+        if status == 200:
+            scores[ent] = body["scores"]
+            labels.append(body.get("routing"))
+
+    try:
+        await door.sync_membership()
+        epoch1 = door.membership_epoch
+
+        # phase A: warm every entity through its owner
+        for ent, rows in batches:
+            st, body = await _post_score(door.host, door.port, rows)
+            take(ent, st, body)
+
+        # fd.route blackout: routing must degrade to the plain proxy,
+        # never fail the request
+        fi.install([Fault("fd.route", kind="raise", at=-1,
+                          message="membership smoke: routing down")])
+        st, body = await _post_score(door.host, door.port,
+                                     batches[0][1])
+        fi.clear()
+        take(batches[0][0], st, body)
+        if door.route_faults < 1:
+            errors.append("fd.route fault did not register a "
+                          "route_faults count")
+
+        # kill the shard-1 owner mid-load: its entities fail over
+        # (short drain: the door still holds pooled connections to the
+        # victim, and a crash does not wait for a graceful drain)
+        dead_addr = epoch1.replicas[1]
+        dead_i = next(i for i, s in enumerate(servers)
+                      if f"{s.host}:{s.port}" == dead_addr)
+        await servers[dead_i].aclose(drain_timeout_s=0.2)
+        dead_owned = [(ent, rows) for ent, rows in batches
+                      if int(epoch1.owner_of([str(ent)])[0]) == 1]
+        for ent, rows in dead_owned:
+            st, body = await _post_score(door.host, door.port, rows)
+            take(ent, st, body)
+
+        # a rebalance under an armed fd.membership fault fails CLOSED
+        fi.install([Fault("fd.membership", kind="raise", at=-1,
+                          message="membership smoke: control plane "
+                                  "down")])
+        blocked = await door.sync_membership()
+        fi.clear()
+        if blocked.get("committed"):
+            errors.append("rebalance committed under an armed "
+                          "fd.membership fault")
+        if door.membership_faults < 1:
+            errors.append("fd.membership fault did not register a "
+                          "membership_faults count")
+
+        # faults off: re-own onto the survivor
+        sync = await door.sync_membership()
+        epoch2 = door.membership_epoch
+        if not (sync.get("committed")
+                or sync.get("reason") == "unchanged"):
+            errors.append(f"post-kill rebalance did not converge: "
+                          f"{sync}")
+        if dead_addr in epoch2.replicas:
+            errors.append("dead replica still owns a slice after "
+                          "re-own")
+
+        # rejoin: a cold replica joins; the commit gate prefetches its
+        # moved slice into its paged table BEFORE the epoch routes to it
+        svc_new = _make_service(model_dir)
+        revived = await AsyncScoringServer(svc_new).start()
+        join = await door.add_backend(f"{revived.host}:{revived.port}")
+        epoch3 = door.membership_epoch
+        if not join.get("committed"):
+            errors.append(f"rejoin epoch did not commit: {join}")
+        join_addr = f"{revived.host}:{revived.port}"
+        if join_addr not in epoch3.replicas:
+            errors.append("joined replica missing from the committed "
+                          "epoch")
+        else:
+            join_idx = epoch3.replicas.index(join_addr)
+            svc_new.session.drain_installs()
+            resident = list(
+                svc_new.session._state.paged["per-user"].resident_ids())
+            warm = [e for e in resident
+                    if int(epoch3.owner_of([e])[0]) == join_idx]
+            if not warm:
+                errors.append("rejoined replica has no prefetched "
+                              "owned pages at commit")
+
+        # phase B: the full workload again on the rejoined topology
+        for ent, rows in batches:
+            st, body = await _post_score(door.host, door.port, rows)
+            take(ent, st, body)
+
+        stats = door.stats()["affinity"]
+        if stats["ownerMiss"]["breaker"] < 1:
+            errors.append("owner kill produced no "
+                          "owner_miss{reason=breaker}")
+        if "fallback" not in labels:
+            errors.append("no failover response carried the fallback "
+                          "routing label")
+        bad = [s for s in statuses if s >= 500]
+        if bad:
+            errors.append(f"availability broke: {len(bad)} 5xx of "
+                          f"{len(statuses)} requests")
+    finally:
+        await door.aclose()
+        for i, s in enumerate(servers):
+            if i != dead_i:
+                await s.aclose()
+        if revived is not None:
+            await revived.aclose()
+    return scores, len(statuses)
+
+
+def main() -> int:
+    import numpy as np
+
+    root = tempfile.mkdtemp(prefix="chaos-affinity-")
+    model_dir, Xg, Xu, uid = _save_model(root)
+    batches = _entity_batches(Xg, Xu, uid)
+    errors = []
+
+    control = asyncio.run(_control_run(model_dir, batches))
+    churned, n_requests = asyncio.run(
+        _churn_run(model_dir, batches, errors))
+
+    for ent, ref in control.items():
+        got = churned.get(ent)
+        if got is None:
+            errors.append(f"entity {ent} never scored under churn")
+            continue
+        if not np.allclose(got, ref, rtol=0, atol=ATOL):
+            errors.append(
+                f"entity {ent} scores drifted under churn: "
+                f"max abs diff "
+                f"{np.max(np.abs(np.subtract(got, ref))):.3e}")
+
+    if errors:
+        for e in errors:
+            print(f"chaos-affinity smoke: {e}", file=sys.stderr)
+        return 1
+    print(f"chaos-affinity smoke: OK ({n_requests} requests, 0 5xx, "
+          f"owner killed + rejoined with prefetched pages, "
+          f"fd.route/fd.membership faults degraded not failed, "
+          f"{len(control)} entities score-stable at atol={ATOL:g})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,11 @@
 #             fraction, zero degraded with faults off, and front-door
 #             hedging holds p99 under one slow replica to <= 2x the
 #             healthy baseline
+#   affinity — elastic entity-affinity serving (exit 13): N owner-routed
+#             replicas hold N x one replica's page budget device-
+#             resident at flat p99, a kill + cold join mid-load keeps
+#             zero 5xx with bounded p99, and the join's slice is
+#             prefetched before its epoch commits
 set -euo pipefail
 cd "$(dirname "$0")/.."
 # the smoke runs must not clobber the full-run bench artifacts (restore
@@ -31,7 +36,7 @@ cd "$(dirname "$0")/.."
 # BENCH_stream/cd with smoke-sized records)
 SNAPSHOT="$(mktemp -d)"
 for f in BENCH_stream.json BENCH_cd.json BENCH_shard.json BENCH_serving.json \
-         BENCH_degrade.json; do
+         BENCH_degrade.json BENCH_affinity.json; do
   cp "$f" "$SNAPSHOT/" 2>/dev/null || true
 done
 restore() {
@@ -61,5 +66,10 @@ degrade_rc=0
 JAX_PLATFORMS=cpu \
 BENCH_DEGRADE_SMOKE=1 \
 timeout -k 10 600 python bench.py degrade || degrade_rc=$?
+affinity_rc=0
+JAX_PLATFORMS=cpu \
+BENCH_AFFINITY_SMOKE=1 \
+timeout -k 10 600 python bench.py affinity || affinity_rc=$?
 if [ "$serving_rc" -ne 0 ]; then exit "$serving_rc"; fi
-exit "$degrade_rc"
+if [ "$degrade_rc" -ne 0 ]; then exit "$degrade_rc"; fi
+exit "$affinity_rc"

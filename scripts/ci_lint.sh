@@ -20,6 +20,12 @@
 #  15   a numerics finding (PN5xx): bare float accumulation, dtype
 #       narrowing, order-dependent iteration, entropy in a digest, or
 #       NaN-comparison misuse on a bit-parity-bearing path
+#  16   the membership chaos smoke failed: an owner kill + rejoin under
+#       the entity-affinity front door no longer holds availability 1.0
+#       (zero 5xx, fallback-labeled failover), the rejoin commits
+#       without prefetched pages, or scores drift vs the churn-free
+#       control (scripts/chaos_affinity_smoke.py — the elastic
+#       affinity-serving contract)
 cd "$(dirname "$0")/.."
 set -o pipefail
 
@@ -81,5 +87,8 @@ env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 13
 
 echo "== chaos-serving smoke (store-fault storm, degraded 1-2, 0 5xx) =="
 env JAX_PLATFORMS=cpu python scripts/chaos_serving_smoke.py || exit 14
+
+echo "== chaos-affinity smoke (owner kill + rejoin, 0 5xx, score-stable) =="
+env JAX_PLATFORMS=cpu python scripts/chaos_affinity_smoke.py || exit 16
 
 echo "ci_lint OK"

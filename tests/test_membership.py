@@ -1,0 +1,285 @@
+"""serve/membership.py + the owned-slice serving plumbing: train<->serve
+owner-map parity (int AND string id dtypes — the FNV-vs-splitmix edge),
+epoch/manager/view state machines, paged-table re-owning
+(``retain_only``), and the session-level membership API
+(``set_membership`` / ``prefetch_entities`` / non-owned install gating
+with bit-identical scores)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.parallel.entity_shard import (
+    EntityShardSpec,
+    serving_owner_of,
+)
+from photon_ml_tpu.serve.membership import (
+    MembershipEpoch,
+    MembershipManager,
+    MembershipView,
+)
+from tests.conftest import serving_rows
+
+
+class TestOwnerMapParity:
+    """The acceptance-critical invariant: the front door's router and
+    the training shard spec put every entity id on the SAME owner."""
+
+    @pytest.mark.parametrize("num_shards", [2, 3, 4, 7])
+    def test_int_ids_match_training_spec(self, num_shards):
+        ids = np.array([0, 1, 2, 9, 123, 10**12, 2**62], np.int64)
+        spec = EntityShardSpec(num_shards=num_shards, shard_index=0)
+        train = spec.owner_of(ids)
+        np.testing.assert_array_equal(
+            train, serving_owner_of(ids.tolist(), num_shards, "int"))
+        # the wire form: serving sees str(uid) (JSON entityIds values
+        # are strings) — "auto" must hash digits back in the INT domain
+        # or the serve owner diverges from the training owner for every
+        # integer-keyed model
+        wire = [str(i) for i in ids.tolist()]
+        np.testing.assert_array_equal(
+            train, serving_owner_of(wire, num_shards, "auto"))
+        np.testing.assert_array_equal(
+            train, serving_owner_of(ids.tolist(), num_shards, "auto"))
+
+    @pytest.mark.parametrize("num_shards", [2, 3, 5])
+    def test_string_ids_match_training_spec(self, num_shards):
+        ids = np.array(["alice", "bob", "user-7", "", "Ω"], object)
+        spec = EntityShardSpec(num_shards=num_shards, shard_index=0)
+        train = spec.owner_of(ids)
+        np.testing.assert_array_equal(
+            train, serving_owner_of(ids.tolist(), num_shards, "str"))
+        np.testing.assert_array_equal(
+            train, serving_owner_of(ids.tolist(), num_shards, "auto"))
+
+    def test_auto_decides_per_id_not_per_batch(self):
+        # one non-numeric id must not push the NUMERIC ids into the
+        # string hash domain (that would move every owner in the batch)
+        num_shards = 4
+        mixed = ["123", "alice", "7"]
+        out = serving_owner_of(mixed, num_shards, "auto")
+        assert out[0] == serving_owner_of([123], num_shards, "int")[0]
+        assert out[2] == serving_owner_of([7], num_shards, "int")[0]
+        assert out[1] == serving_owner_of(["alice"], num_shards, "str")[0]
+
+    def test_int_like_edges(self):
+        num_shards = 3
+        # out-of-int64-range digit strings and bools are NOT int-like
+        big = str(2**70)
+        assert (serving_owner_of([big], num_shards, "auto")[0]
+                == serving_owner_of([big], num_shards, "str")[0])
+        assert (serving_owner_of([True], num_shards, "auto")[0]
+                == serving_owner_of([True], num_shards, "str")[0])
+        # negative digit strings stay in the int domain
+        assert (serving_owner_of(["-5"], num_shards, "auto")[0]
+                == serving_owner_of([-5], num_shards, "int")[0])
+
+    def test_bad_id_kind_raises(self):
+        with pytest.raises(ValueError, match="id_kind"):
+            serving_owner_of([1], 2, "float")
+
+    def test_owner_in_range(self):
+        out = serving_owner_of(list(range(200)), 5, "int")
+        assert out.min() >= 0 and out.max() < 5
+        assert len(set(out.tolist())) == 5  # all shards used
+
+
+class TestMembershipEpoch:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="epoch"):
+            MembershipEpoch(0, ("a:1",))
+        with pytest.raises(ValueError, match="replica"):
+            MembershipEpoch(1, ())
+        with pytest.raises(ValueError, match="sorted"):
+            MembershipEpoch(1, ("b:2", "a:1"))
+        with pytest.raises(ValueError, match="id_kind"):
+            MembershipEpoch(1, ("a:1",), id_kind="weird")
+
+    def test_payload_roundtrip(self):
+        e = MembershipEpoch(3, ("a:1", "b:2"), id_kind="str")
+        p = e.payload(1, ["u1", "u2"])
+        assert p == {"epoch": 3, "replicas": ["a:1", "b:2"],
+                     "selfIndex": 1, "idKind": "str",
+                     "prefetchEntityIds": ["u1", "u2"]}
+        assert MembershipEpoch.from_payload(p) == e
+        assert "prefetchEntityIds" not in e.payload(0)
+
+    def test_owner_address_is_position(self):
+        e = MembershipEpoch(1, ("a:1", "b:2", "c:3"))
+        for eid in ["1", "2", "77", "alice"]:
+            idx = e.owner_index(eid)
+            assert e.owner_address(eid) == e.replicas[idx]
+
+
+class TestMembershipManager:
+    def test_initial_epoch_and_unchanged_propose(self):
+        m = MembershipManager(["b:2", "a:1", "a:1"])
+        assert m.epoch.epoch == 1
+        assert m.epoch.replicas == ("a:1", "b:2")
+        assert m.propose(["a:1", "b:2"]) is None
+
+    def test_propose_commit_monotonic(self):
+        m = MembershipManager(["a:1", "b:2"])
+        new = m.propose(["a:1", "b:2", "c:3"])
+        assert new.epoch == 2
+        assert m.commit(new) is True
+        assert m.epoch is new
+        # replaying an old epoch can never roll membership back
+        stale = MembershipEpoch(2, ("a:1",))
+        assert m.commit(stale) is False
+        assert m.epoch is new
+        assert m.propose(["a:1"]).epoch == 3
+
+    def test_hot_tracker_bounded_lru(self):
+        m = MembershipManager(["a:1"], hot_track=3)
+        for e in ["1", "2", "3", "1", "4"]:
+            m.note_routed(e)
+        # "2" was the least recently routed when "4" pushed past bound
+        assert m.hot_ids() == ["3", "1", "4"]
+
+    def test_moved_ids_only_moved_grouped_by_new_owner(self):
+        m = MembershipManager(["a:1", "b:2"])
+        ids = [str(i) for i in range(40)]
+        for e in ids:
+            m.note_routed(e)
+        new = m.propose(["a:1", "b:2", "c:3"])
+        moved = m.moved_ids(new)
+        cur = m.epoch
+        for new_idx, group in moved.items():
+            for eid in group:
+                # grouped under its NEW owner...
+                assert new.owner_index(eid) == new_idx
+                # ...and its owner ADDRESS actually changed
+                assert (new.replicas[new_idx]
+                        != cur.owner_address(eid))
+        flat = {e for g in moved.values() for e in g}
+        for eid in set(ids) - flat:  # unmoved ids stay untouched
+            assert (new.owner_address(eid) == cur.owner_address(eid))
+        assert flat  # 2 -> 3 shards must move SOMETHING hot
+
+
+class TestMembershipView:
+    def test_inactive_owns_everything(self):
+        v = MembershipView()
+        assert v.epoch == 0 and not v.active
+        assert v.owned_many(["a", "b"]) == [True, True]
+
+    def test_apply_monotonic_and_partition(self):
+        v = MembershipView()
+        assert v.apply(2, 3, 1) is True
+        assert v.active and v.epoch == 2
+        assert v.apply(2, 3, 0) is False  # stale: refused, unchanged
+        assert v.shard_index == 1
+        ids = [str(i) for i in range(30)]
+        owners = serving_owner_of(ids, 3, "auto")
+        assert v.owned_many(ids) == [int(o) == 1 for o in owners]
+        assert v.describe() == {"epoch": 2, "numShards": 3,
+                                "shardIndex": 1, "idKind": "auto"}
+
+    def test_single_shard_epoch_is_inactive(self):
+        v = MembershipView()
+        assert v.apply(1, 1, 0) is True
+        assert not v.active
+        assert v.owned_many(["x"]) == [True]
+
+    def test_bad_apply_raises(self):
+        v = MembershipView()
+        with pytest.raises(ValueError, match="shard_index"):
+            v.apply(1, 2, 2)
+        with pytest.raises(ValueError, match="id_kind"):
+            v.apply(1, 2, 0, id_kind="nope")
+
+
+class TestRetainOnly:
+    def _table(self):
+        from photon_ml_tpu.serve.coeff_cache import CoeffEntry
+        from photon_ml_tpu.serve.paged_table import PagedCoefficientTable
+
+        t = PagedCoefficientTable(4, pages=3, page_rows=2, name="u")
+        entries = {str(i): CoeffEntry({j: j for j in range(4)},
+                                      np.full(4, float(i)))
+                   for i in range(5)}
+        t.install(entries)
+        t.install({"ghost": None})  # absent mark must survive re-owning
+        return t
+
+    def test_drops_compacts_and_counts(self):
+        t = self._table()
+        keep = {"0", "2", "4"}
+        assert t.retain_only(lambda e: e in keep) == 2
+        assert sorted(t.resident_ids()) == sorted(keep)
+        assert t.stats()["membership_drops"] == 2
+        # survivors compacted into the low pages: 3 rows -> 2 pages
+        buf, slots, missing = t.lookup(["0", "2", "4"])
+        assert slots.max() < 4 and slots.min() >= 0
+        host = np.asarray(buf)
+        for eid, slot in zip(["0", "2", "4"], slots):
+            np.testing.assert_array_equal(host[slot],
+                                          np.full(4, float(eid)))
+        # dropped entities fault again (missing), absents stay absent
+        _, s2, miss = t.lookup(["1", "3", "ghost"])
+        assert sorted(miss) == ["1", "3"]
+        assert (s2 == -1).all()
+
+    def test_noop_when_all_kept(self):
+        t = self._table()
+        assert t.retain_only(lambda e: True) == 0
+        assert t.stats()["membership_drops"] == 0
+
+
+class TestSessionMembership:
+    def _session(self, saved_game_model):
+        from photon_ml_tpu.serve import ScoringSession
+
+        model_dir, bundle = saved_game_model
+        return ScoringSession(model_dir, dtype="float64", max_batch=16,
+                              coeff_cache_entries=32), bundle
+
+    def test_set_membership_monotonic_and_eviction(self, saved_game_model):
+        session, bundle = self._session(saved_game_model)
+        rows = serving_rows(bundle, list(range(16)))
+        session.score_rows(rows)  # populate the paged table
+        session.drain_installs()
+        assert session.set_membership(epoch=2, num_shards=2,
+                                      shard_index=0) is True
+        assert session.set_membership(epoch=2, num_shards=2,
+                                      shard_index=1) is False
+        view = session.membership
+        assert view.epoch == 2 and view.active
+        table = session._state.paged["per-user"]
+        for eid in table.resident_ids():
+            assert view.owned(eid)  # non-owned rows were dropped
+        assert session.metrics.snapshot()["membership_epoch"] == 2
+
+    def test_prefetch_entities_owned_slice_only(self, saved_game_model):
+        session, bundle = self._session(saved_game_model)
+        session.set_membership(epoch=1, num_shards=2, shard_index=0)
+        view = session.membership
+        all_ids = [str(i) for i in range(bundle["n_entities"])]
+        owned = [e for e, o in zip(all_ids, view.owned_many(all_ids))
+                 if o]
+        n, nbytes = session.prefetch_entities(all_ids)
+        assert n == len(owned) and nbytes > 0
+        table = session._state.paged["per-user"]
+        assert sorted(table.resident_ids()) == sorted(owned)
+        snap = session.metrics.snapshot()
+        assert snap["membership_prefetch_entities"] == n
+        assert snap["membership_prefetch_bytes"] == nbytes
+
+    def test_scores_stable_under_membership(self, saved_game_model):
+        """Non-owned entities score through the LRU host-math path —
+        within the repo's paged-vs-host parity tolerance (rtol=0,
+        atol=1e-9, the bound every paged-table test pins), so churn can
+        degrade residency but never change scores."""
+        session, bundle = self._session(saved_game_model)
+        rows = serving_rows(bundle, list(range(16)))
+        ref = np.asarray(session.score_rows(rows))
+        session.drain_installs()
+        session.set_membership(epoch=3, num_shards=2, shard_index=1)
+        got = np.asarray(session.score_rows(rows))
+        session.drain_installs()
+        np.testing.assert_allclose(got, ref, rtol=0, atol=1e-9)
+        snap = session.metrics.snapshot()
+        assert snap["membership_non_owned_skips"] > 0
+        table = session._state.paged["per-user"]
+        for eid in table.resident_ids():
+            assert session.membership.owned(eid)

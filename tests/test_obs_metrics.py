@@ -58,6 +58,12 @@ def exercise(m: ServingMetrics) -> None:
     m.record_deadline_drop("pre_compute")
     m.set_brownout_level(1)
     m.set_model_staleness(42.5)
+    # entity-affinity membership series (PR 15): the fixture was
+    # regenerated when these were appended — an append-only byte change,
+    # every pre-existing series renders identically
+    m.set_membership_epoch(3)
+    m.record_membership(prefetch_entities=5, prefetch_bytes=640,
+                        non_owned_skips=2, evictions=7)
 
 
 class TestServingParity:

@@ -380,10 +380,11 @@ class TestFrontDoorChaos:
             request = (b"POST /score HTTP/1.1\r\nHost: t\r\n"
                        b"Content-Length: 2\r\n"
                        b"Connection: keep-alive\r\n\r\n{}")
-            data = await door._hedged_exchange(slow_b, request, "/score",
-                                               set())
+            data, hedge_won = await door._hedged_exchange(
+                slow_b, request, "/score", set())
             elapsed = time.monotonic() - t0
             assert data is not None and b" 200 " in data
+            assert hedge_won, "the duplicate's response did not win"
             assert b'"from": "0.0"' in data, "fast replica did not win"
             assert elapsed < 0.8, f"hedge never fired ({elapsed:.2f}s)"
             assert door.hedged == 1
