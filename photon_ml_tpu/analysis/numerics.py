@@ -92,6 +92,7 @@ DEFAULT_NUMERIC_HOT_PATHS = (
     "photon_ml_tpu/optimize/lbfgs_margin.py",
     "photon_ml_tpu/optimize/linesearch.py",
     "photon_ml_tpu/optimize/owlqn.py",
+    "photon_ml_tpu/optimize/path.py",
     "photon_ml_tpu/optimize/tron.py",
     "photon_ml_tpu/evaluation/evaluators.py",
     "photon_ml_tpu/evaluation/device.py",

@@ -54,6 +54,7 @@ DEFAULT_HOT_PATHS = (
     "photon_ml_tpu/serve/paged_table.py",
     "photon_ml_tpu/parallel/streaming.py",
     "photon_ml_tpu/parallel/data_parallel.py",
+    "photon_ml_tpu/optimize/path.py",
     "photon_ml_tpu/evaluation/device.py",
 )
 

@@ -98,6 +98,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "chain, so a loose solve there buys wall-clock "
                         "without moving the tight final fits (e.g. "
                         "1e-3:0.1; 'off' disables)")
+    p.add_argument("--path-screen", default="off",
+                   choices=["off", "strong", "safe"],
+                   help="pathwise screening over the lambda grid "
+                        "(optimize/path.py, docs/path.md): walk "
+                        "--reg-weights in decreasing order, freeze "
+                        "features the sequential strong/safe rule screens "
+                        "out, solve the restricted problem, and KKT-"
+                        "certify against the full gradient (violators "
+                        "re-enter and the solve repeats). Composes with "
+                        "warm start, --solver-tol-schedule and "
+                        "--auto-resume; requires an L1 component "
+                        "(l1/elastic_net) to bite and refuses "
+                        "--normalization")
+    p.add_argument("--path-kkt-tol", type=float, default=1e-6,
+                   help="relative slack of the screened-coordinate KKT "
+                        "certification test (ops.regularization."
+                        "kkt_slack)")
+    p.add_argument("--path-max-kkt-rounds", type=int, default=5,
+                   help="restricted-solve repair rounds per lambda before "
+                        "falling back to a full-width solve")
+    p.add_argument("--path-min-bucket", type=int, default=64,
+                   help="floor of the power-of-two restricted-width "
+                        "bucket ladder")
     p.add_argument("--normalization", default="none",
                    choices=[t.value for t in NormalizationType])
     p.add_argument("--add-intercept", action="store_true", default=True)
@@ -285,6 +308,15 @@ def _run(args) -> int:
                    reason=f"reg_type={args.reg_type} needs OWL-QN")
         optimizer = "owlqn"
 
+    if args.path_screen != "off" \
+            and NormalizationType(args.normalization) != NormalizationType.NONE:
+        raise SystemExit(
+            "--path-screen does not compose with --normalization: the "
+            "virtual shift couples every column through the margin "
+            "adjustment, so a frozen column would still move the margins "
+            "(optimize/path.py). Normalize the data on disk or drop one "
+            "of the flags")
+
     out_of_core = args.out_of_core
     if args.chunk_cache_dir and not out_of_core:
         raise SystemExit("--chunk-cache-dir requires --out-of-core (the "
@@ -469,6 +501,10 @@ def _run(args) -> int:
                                 if args.validation_data else None),
             "validation_rows": (None if validation is None
                                 else int(vlabels.shape[0])),
+            # a resumed path must re-screen the tail exactly as the
+            # crashed run would have: refuse to resume across a change
+            # of screening rule
+            "path_screen": args.path_screen,
         },
         is_lead=is_lead)
     resume_path = resume.path
@@ -513,6 +549,9 @@ def _run(args) -> int:
                     "grad_norm": float(res.grad_norm),
                     "iterations": int(res.iterations),
                     "converged": bool(res.converged),
+                    "solver_tolerance": getattr(res, "solver_tolerance",
+                                                None),
+                    "screened_dim": getattr(res, "screened_dim", None),
                     "loss_history": np.asarray(res.loss_history)},
             "metrics": metrics_,
             "variances": (None if variances_ is None
@@ -537,6 +576,33 @@ def _run(args) -> int:
                                batch.features).startswith("csc"):
             grid_csc = build_csc(objective, batch, mesh)
 
+    path_solver = None
+    if args.path_screen != "off":
+        from photon_ml_tpu.optimize import PathConfig, PathSolver
+
+        pcfg = PathConfig(screen=args.path_screen,
+                          kkt_tol=args.path_kkt_tol,
+                          max_kkt_rounds=args.path_max_kkt_rounds,
+                          min_bucket=args.path_min_bucket)
+        if streaming:
+            # out-of-core: the restricted passes stream the SAME chunk
+            # sequence (the PR-4 chunk cache underneath makes the whole
+            # path one decode of the data)
+            path_solver = PathSolver(
+                objective, reg, chunks=chunks, dim=dim, mesh=stream_mesh,
+                optimizer=optimizer, config=opt_config, path_config=pcfg,
+                dtype=dtype, prefetch_depth=args.prefetch_depth)
+        else:
+            path_solver = PathSolver(
+                objective, reg, batch=batch, mesh=mesh,
+                optimizer=optimizer, config=opt_config, path_config=pcfg,
+                dtype=dtype, precomputed_csc=grid_csc)
+        # lambda-granular resume: replayed solutions seed warm/screening
+        # states (gradients recomputed lazily), so the resumed tail's
+        # candidate sets match the uninterrupted run's
+        for lam_done, res_done, _m, _v in results:
+            path_solver.seed_state(lam_done, np.asarray(res_done.w))
+
     try:
         with Timed(logger, "training"), profile_trace(args.profile_dir):
             start_idx = len(results)
@@ -554,7 +620,14 @@ def _run(args) -> int:
                         opt_config,
                         tolerance=args.solver_tol_schedule.at(
                             li, args.tolerance))
+                path_stats_box = [None]
+
                 def _fit_lambda(lam=lam, run_config=run_config):
+                    if path_solver is not None:
+                        res_, pstats = path_solver.solve(
+                            lam, tolerance=run_config.tolerance)
+                        path_stats_box[0] = pstats
+                        return res_
                     if streaming:
                         from photon_ml_tpu.parallel.streaming import (
                             fit_streaming,
@@ -594,11 +667,19 @@ def _run(args) -> int:
                         tag=f"glm.lambda_retry:{li}")
                 else:
                     res = _fit_lambda()
+                # every fit records the tolerance it solved to and the
+                # width it solved over (full dim when unscreened), so the
+                # lambda log and resume marker always carry both
+                if res.solver_tolerance is None:
+                    res = res._replace(
+                        solver_tolerance=float(run_config.tolerance))
+                if res.screened_dim is None:
+                    res = res._replace(screened_dim=int(dim))
                 w = res.w  # warm start the next lambda
                 diag = {
                     "reg_weight": lam,
-                    **({"solver_tolerance": run_config.tolerance}
-                       if args.solver_tol_schedule is not None else {}),
+                    "solver_tolerance": float(res.solver_tolerance),
+                    "screened_dim": int(res.screened_dim),
                     "loss": float(res.value),
                     "grad_norm": float(res.grad_norm),
                     "iterations": int(res.iterations),
@@ -612,6 +693,8 @@ def _run(args) -> int:
                     # streamed fits: decode-wait / transfer / compute-stall
                     # seconds for this lambda's whole pass sequence
                     diag["stream"] = res.stream_stats
+                if path_stats_box[0] is not None:
+                    diag["path"] = path_stats_box[0].as_dict()
                 metrics = {}
                 if validation_batch is not None and evaluators:
                     scores = np.asarray(objective.margins(res.w, validation_batch))

@@ -35,6 +35,119 @@ class GameFitResult:
     history: List[dict]
 
 
+@dataclasses.dataclass(frozen=True)
+class GlmPathFitResult:
+    """One lambda of a pathwise fixed-effect fit: the full-width result
+    (``w`` scattered back; ``solver_tolerance``/``screened_dim`` set), the
+    screening record, and validation metrics (empty without evaluators)."""
+
+    reg_weight: float
+    result: object          # optimize.common.OptimizationResult
+    stats: object           # optimize.path.PathLambdaStats
+    metrics: dict
+
+
+class GlmPathEstimator:
+    """Pathwise fixed-effect GLM over a lambda grid — the estimator face
+    of ``optimize.path.PathSolver`` (docs/path.md): screening + KKT
+    certification per lambda, one shared solver so the whole grid (and
+    any later ``fit`` call on the same estimator) reuses warm states and
+    the compiled restricted-bucket ladder.
+
+    Pass exactly one of ``batch`` (in-memory ``LabeledBatch``) or
+    ``chunks``/``dim`` (streamed host chunks) to ``fit``. The grid is
+    solved in the order given (decreasing lambda screens best)."""
+
+    def __init__(
+        self,
+        task: str = "logistic",
+        reg_type: str = "elastic_net",
+        elastic_net_alpha: float = 0.5,
+        optimizer: str = "auto",
+        evaluators: Sequence[str] = (),
+        intercept_index: int = -1,
+        mesh=None,
+        dtype=jnp.float32,
+        config=None,
+        path_config=None,
+    ):
+        from photon_ml_tpu.ops.regularization import RegularizationContext
+        from photon_ml_tpu.optimize import OptimizerConfig, PathConfig
+
+        self.task = task
+        self.reg = RegularizationContext(reg_type, alpha=elastic_net_alpha)
+        self.optimizer = optimizer
+        self.evaluator_names = list(evaluators)
+        self.intercept_index = intercept_index
+        self.mesh = mesh
+        self.dtype = dtype
+        self.config = config if config is not None else OptimizerConfig()
+        self.path_config = (path_config if path_config is not None
+                            else PathConfig())
+        self._solver = None
+
+    def solver(self, batch=None, chunks=None, dim=None):
+        """The shared PathSolver, built on first use and pinned to the
+        first dataset seen (warm states are only meaningful on one
+        dataset; pass a fresh estimator for a new one)."""
+        if self._solver is None:
+            from photon_ml_tpu.ops.objective import make_objective
+            from photon_ml_tpu.optimize import PathSolver
+            from photon_ml_tpu.parallel.mesh import make_mesh
+
+            objective = make_objective(
+                self.task, intercept_index=self.intercept_index)
+            mesh = self.mesh if self.mesh is not None else make_mesh()
+            self._solver = PathSolver(
+                objective, self.reg, batch=batch, chunks=chunks, dim=dim,
+                mesh=mesh, optimizer=self.optimizer, config=self.config,
+                path_config=self.path_config, dtype=self.dtype)
+        return self._solver
+
+    def fit(
+        self,
+        reg_weights: Sequence[float],
+        batch=None,
+        chunks=None,
+        dim=None,
+        validation_batch=None,
+        tol_schedule=None,
+    ) -> List[GlmPathFitResult]:
+        solver = self.solver(batch=batch, chunks=chunks, dim=dim)
+        out: List[GlmPathFitResult] = []
+        for li, lam in enumerate(reg_weights):
+            tol = None
+            if tol_schedule is not None:
+                tol = tol_schedule.at(li, self.config.tolerance)
+            res, stats = solver.solve(lam, tolerance=tol)
+            metrics = {}
+            if validation_batch is not None and self.evaluator_names:
+                scores = np.asarray(solver._objective.margins(
+                    res.w, validation_batch))
+                for name in self.evaluator_names:
+                    metrics[name] = get_evaluator(name).evaluate(
+                        scores, np.asarray(validation_batch.labels),
+                        np.asarray(validation_batch.weights))
+            out.append(GlmPathFitResult(float(lam), res, stats, metrics))
+        return out
+
+    def select_best(
+        self, results: Sequence[GlmPathFitResult]
+    ) -> GlmPathFitResult:
+        if not results:
+            raise ValueError("no fit results to select from")
+        if not self.evaluator_names or not results[0].metrics:
+            return results[0]
+        primary = self.evaluator_names[0]
+        ev = get_evaluator(primary)
+        best = results[0]
+        for r in results[1:]:
+            if r.metrics and ev.better(r.metrics[primary],
+                                       best.metrics[primary]):
+                best = r
+        return best
+
+
 class GameEstimator:
     """Train GAME models over a grid of per-coordinate configurations."""
 

@@ -273,6 +273,31 @@ class TrainingMetrics:
             "photon_train_exchange_bytes_gathered_total")
         self._rounds = r.counter("photon_train_exchange_rounds_total")
         self._exch_s = r.counter("photon_train_exchange_seconds_total")
+        # pathwise fixed-effect screening (optimize/path.py): one
+        # lambdas_total tick per solved lambda; frozen/rounds/violations
+        # accumulate the screen's work split so a dashboard can tell an
+        # effective screen (high frozen, rounds ~= lambdas, violations
+        # ~= 0) from a thrashing one (violations and fallbacks climbing)
+        self._path_lambdas = r.counter(
+            "photon_train_path_lambdas_total",
+            "lambdas solved by the pathwise screened solver")
+        self._path_frozen = r.counter(
+            "photon_train_path_features_frozen_total",
+            "features frozen at zero, summed over solved lambdas")
+        self._path_rounds = r.counter(
+            "photon_train_path_kkt_rounds_total",
+            "screen->solve->certify rounds (1 per lambda when the "
+            "screen holds first try)")
+        self._path_violations = r.counter(
+            "photon_train_path_kkt_violations_total",
+            "screened coordinates re-admitted by the KKT check")
+        self._path_passes = r.counter(
+            "photon_train_path_full_grad_passes_total",
+            "full data-gradient passes paid for screening + certification")
+        self._path_fallback = r.counter(
+            "photon_train_path_fallback_total",
+            "lambdas that exhausted the KKT repair budget and fell back "
+            "to a full-width solve")
 
     def record_step(self, coordinate: str, solve_s: float, eval_s: float,
                     comm_s: float) -> None:
@@ -291,6 +316,16 @@ class TrainingMetrics:
         self._stall.inc(stall_s)
         self._decode.inc(decode_s)
         self._transfer.inc(transfer_s)
+
+    def record_path_lambda(self, frozen: int, rounds: int, violations: int,
+                           full_grad_passes: int, fallback: bool) -> None:
+        self._path_lambdas.inc(1)
+        self._path_frozen.inc(frozen)
+        self._path_rounds.inc(rounds)
+        self._path_violations.inc(violations)
+        self._path_passes.inc(full_grad_passes)
+        if fallback:
+            self._path_fallback.inc(1)
 
     def record_exchange(self, bytes_sent: int, bytes_gathered: int,
                         seconds: float) -> None:

@@ -191,6 +191,31 @@ class GLMObjective:
         return 1.0 / jnp.maximum(diag, jnp.finfo(diag.dtype).tiny)
 
 
+def kkt_residuals(w: jax.Array, g: jax.Array, lam_l1,
+                  l1_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Per-coordinate KKT stationarity residual of
+    ``min f(w) + lam_l1 * ||w * mask||_1`` given the smooth-part gradient
+    ``g`` = grad f(w):
+
+    * unpenalized coordinates (mask 0): ``|g_j|`` — plain stationarity;
+    * zero coordinates: ``max(|g_j| - lam_l1, 0)`` — the subgradient
+      condition ``|g_j| <= lam_l1``;
+    * nonzero coordinates: ``|g_j + lam_l1 * sign(w_j)|``.
+
+    The pathwise screening certificate (``optimize.path``) and its tests
+    are phrased in this residual: a solve is KKT-certified when every
+    screened-out coordinate's residual is within the certification slack
+    (``ops.regularization.kkt_slack``) and the solver's own coordinates
+    are within solver tolerance."""
+    lam = jnp.asarray(lam_l1, g.dtype)
+    mask = (jnp.ones_like(g) if l1_mask is None
+            else jnp.asarray(l1_mask, g.dtype))
+    lam_eff = lam * mask
+    at_zero = jnp.maximum(jnp.abs(g) - lam_eff, 0.0)
+    away = jnp.abs(g + lam_eff * jnp.sign(w))
+    return jnp.where(w == 0, at_zero, away)
+
+
 def make_objective(
     loss: str | PointwiseLoss,
     normalization: Optional[NormalizationContext] = None,

@@ -49,3 +49,47 @@ class RegularizationContext:
     @property
     def needs_owlqn(self) -> bool:
         return self.reg_type in (RegularizationType.L1, RegularizationType.ELASTIC_NET)
+
+
+def screening_threshold(rule: str, lam_l1: float, lam_l1_prev: float,
+                        slack: float = 0.0) -> float:
+    """Sequential screening threshold for the pathwise fixed-effect solver
+    (``optimize.path``): a feature whose data-gradient magnitude at the
+    previous lambda's solution falls BELOW the returned value is frozen at
+    zero for the restricted solve at ``lam_l1``.
+
+    * ``"strong"`` — the sequential strong rule of Tibshirani et al.
+      (the screen in distributed CD for GLMs, arxiv 1611.02101 and Snap
+      ML's hierarchy, arxiv 1803.06333): ``2*l1 - l1_prev``, i.e. the
+      unit-slope bound on how fast ``|g_j|`` can grow along the path.
+      Aggressive; can over-screen on strongly correlated designs.
+    * ``"safe"`` — double the strong rule's guard band:
+      ``l1 - 2*(l1_prev-l1)``, i.e. a slope-2 growth allowance. Keeps
+      marginal features in the candidate set, trading a larger
+      restricted problem for fewer KKT repair rounds.
+
+    Both are certified downstream: the post-solve full-gradient KKT check
+    re-admits anything either rule wrongly froze, so the rule choice only
+    moves the work split between restricted-solve size and repair rounds —
+    never the solution. ``slack`` inflates the threshold by
+    ``slack * (l1_prev - l1)`` to deliberately over-screen (adversarial
+    repair tests; 0 = the published rules). A non-positive return means
+    nothing can be screened at this step (e.g. a large lambda drop)."""
+    gap = lam_l1_prev - lam_l1
+    if rule == "strong":
+        base = lam_l1 - gap
+    elif rule == "safe":
+        base = lam_l1 - 2.0 * gap
+    else:
+        raise ValueError(f"unknown screening rule {rule!r}; "
+                         "known: strong, safe")
+    return base + slack * gap
+
+
+def kkt_slack(lam_l1: float, kkt_tol: float) -> float:
+    """Absolute slack for the screened-coordinate KKT test: a frozen
+    coordinate with ``|g_j| > lam_l1 + kkt_slack`` is a violator and
+    re-enters the candidate set. Relative in the L1 weight with a unit
+    floor so small-lambda grid tails don't demand sub-solver-tolerance
+    gradient precision."""
+    return kkt_tol * max(lam_l1, 1.0)

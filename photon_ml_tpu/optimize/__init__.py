@@ -1,6 +1,7 @@
 from photon_ml_tpu.optimize.common import (
     OptimizationResult,
     OptimizerConfig,
+    PathConfig,
     ToleranceSchedule,
     parse_tolerance_schedule,
 )
@@ -17,3 +18,14 @@ def get_optimizer(name: str):
     if key not in OPTIMIZERS:
         raise ValueError(f"unknown optimizer '{name}'; known: {sorted(OPTIMIZERS)}")
     return OPTIMIZERS[key]
+
+
+def __getattr__(name):
+    # PathSolver lives behind a lazy hook: optimize/path.py reaches into
+    # photon_ml_tpu.parallel (which itself imports this package for the
+    # optimizer registry), so importing it eagerly here would be a cycle.
+    if name in ("PathSolver", "PathLambdaStats"):
+        from photon_ml_tpu.optimize import path
+
+        return getattr(path, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -66,6 +66,51 @@ class ToleranceSchedule:
         return max(float(final_tol), self.start * self.decay ** max(step, 0))
 
 
+@dataclasses.dataclass(frozen=True)
+class PathConfig:
+    """Pathwise fixed-effect solver knobs (``optimize.path.PathSolver``) —
+    rides alongside :class:`OptimizerConfig` the way the reference's
+    per-coordinate optimizer config rides alongside its training config.
+
+    ``screen``: ``"strong"`` (sequential strong rule — aggressive,
+    occasionally over-screens, always KKT-repaired), ``"safe"`` (double
+    the strong rule's guard band — keeps marginal features on correlated
+    designs, fewer repair rounds), or ``"off"`` (warm-started full-feature
+    fits; the pre-path behavior). ``kkt_tol`` is the relative slack on the
+    L1 weight in the violation test ``|g_j| > l1 + kkt_tol*max(l1, 1)``
+    for screened coordinates. ``max_kkt_rounds`` bounds the
+    screen→solve→check repair loop before falling back to a full-feature
+    solve (which is trivially certified). ``min_bucket`` floors the
+    power-of-two restricted width so tiny candidate sets don't mint
+    single-use compilations. ``screen_slack`` inflates the screening
+    threshold by ``slack * (l1_prev - l1)`` — 0 is the published rules;
+    positive values deliberately over-screen (the KKT-repair adversarial
+    tests and aggressiveness tuning use it). ``keep_states`` retains one
+    (lambda, w, gradient) snapshot per solved lambda so out-of-order
+    solves (the GP tuner) warm-start from the nearest solved neighbor;
+    costs 2 * dim * 8 bytes per lambda."""
+
+    screen: str = "strong"
+    kkt_tol: float = 1e-6
+    max_kkt_rounds: int = 5
+    min_bucket: int = 64
+    screen_slack: float = 0.0
+    keep_states: bool = True
+
+    def __post_init__(self):
+        if self.screen not in ("strong", "safe", "off"):
+            raise ValueError(f"screen must be strong|safe|off, "
+                             f"got {self.screen!r}")
+        if not (self.kkt_tol >= 0):
+            raise ValueError(f"kkt_tol must be >= 0, got {self.kkt_tol}")
+        if self.max_kkt_rounds < 1:
+            raise ValueError(f"max_kkt_rounds must be >= 1, "
+                             f"got {self.max_kkt_rounds}")
+        if self.min_bucket < 1:
+            raise ValueError(f"min_bucket must be >= 1, "
+                             f"got {self.min_bucket}")
+
+
 def parse_tolerance_schedule(spec: str) -> "ToleranceSchedule | None":
     """Parse a ``START:DECAY`` CLI spec (e.g. ``1e-3:0.1``) into a
     :class:`ToleranceSchedule`; ``off``/``none`` disable it. Raises
@@ -100,6 +145,14 @@ class OptimizationResult(NamedTuple):
     # transfer / compute-stall seconds, chunk and pass counts). None for
     # in-memory fits; never touched inside jit.
     stream_stats: "dict | None" = None
+    # Restricted-problem geometry, attached HOST-SIDE after the solve
+    # (never inside jit): the tolerance this fit actually converged
+    # against and the width of the problem it was solved over (the
+    # screened/bucketed dimension for pathwise fits, the full feature
+    # dim otherwise). Logs, BENCH_path.json and the resume marker assert
+    # the geometry, not just the outcome.
+    solver_tolerance: "float | None" = None
+    screened_dim: "int | None" = None
 
 
 def converged_check(f_prev, f, g_norm, g0_norm, tol, f_scale=None):

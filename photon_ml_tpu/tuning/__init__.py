@@ -16,7 +16,11 @@ from photon_ml_tpu.tuning.search import (
     ParamRange,
     RandomSearch,
 )
-from photon_ml_tpu.tuning.game_tuner import resolve_tuned_coordinates, tune_game
+from photon_ml_tpu.tuning.game_tuner import (
+    resolve_tuned_coordinates,
+    tune_game,
+    tune_glm_path,
+)
 
 __all__ = [
     "GaussianProcessModel",
@@ -27,4 +31,5 @@ __all__ = [
     "matern52",
     "resolve_tuned_coordinates",
     "tune_game",
+    "tune_glm_path",
 ]

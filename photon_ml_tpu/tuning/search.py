@@ -111,15 +111,33 @@ class RandomSearch:
     def propose(self) -> Dict[str, float]:
         return self._devectorize(self.rng.random(len(self.ranges)))
 
-    def find(self, n: int) -> List[Observation]:
-        """Run ``n`` propose→evaluate rounds; returns the new observations."""
+    def propose_batch(self, k: int) -> List[Dict[str, float]]:
+        """``k`` proposals from the CURRENT posterior/state, before any of
+        them is evaluated (random search: independent draws)."""
+        return [self.propose() for _ in range(k)]
+
+    def find(self, n: int, batch: int = 1,
+             eval_order: Callable[[Dict[str, float]], float] | None = None,
+             ) -> List[Observation]:
+        """Run ``n`` propose→evaluate rounds; returns the new observations.
+
+        With ``batch > 1`` each round proposes ``batch`` configurations
+        up front and evaluates them all before re-fitting, in ascending
+        ``eval_order(params)`` order when given. The GLM path tuner
+        (``tuning.game_tuner.tune_glm_path``) orders each round by
+        DESCENDING reg weight so the round walks the regularization path
+        downward, reusing the shared path solver's warm states
+        sequentially instead of cold-starting every trial."""
         new: List[Observation] = []
         for _ in range(n):
-            params = self.propose()
-            value = float(self.evaluation_function(params))
-            obs = Observation(params, value)
-            self.observations.append(obs)
-            new.append(obs)
+            proposals = self.propose_batch(batch)
+            if eval_order is not None:
+                proposals = sorted(proposals, key=eval_order)
+            for params in proposals:
+                value = float(self.evaluation_function(params))
+                obs = Observation(params, value)
+                self.observations.append(obs)
+                new.append(obs)
         return new
 
 
@@ -152,12 +170,21 @@ class GaussianProcessSearch(RandomSearch):
         return improve * cdf + std * pdf
 
     def propose(self) -> Dict[str, float]:
+        return self.propose_batch(1)[0]
+
+    def propose_batch(self, k: int):
         if len(self.observations) < 2:
-            return super().propose()
+            return [super(GaussianProcessSearch, self).propose()
+                    for _ in range(k)]
         x = np.stack([self._vectorize(o.params) for o in self.observations])
         y = np.array([o.value for o in self.observations])
         gp = fit_gp(x, y)
         candidates = self.rng.random((self.candidate_pool, len(self.ranges)))
         mean, std = gp.predict(candidates)
         ei = self._expected_improvement(mean, std, self.best().value)
-        return self._devectorize(candidates[int(np.argmax(ei))])
+        # batched rounds take the k best-EI pool members (distinct by
+        # construction: the pool is k >> batch random candidates) from
+        # ONE posterior — a cheap q-EI stand-in that keeps each GLM-path
+        # tuning round a single downward walk of the lambda path
+        top = np.argsort(ei)[::-1][:k]
+        return [self._devectorize(candidates[int(i)]) for i in top]

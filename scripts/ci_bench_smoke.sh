@@ -23,6 +23,12 @@
 #             fraction, zero degraded with faults off, and front-door
 #             hedging holds p99 under one slow replica to <= 2x the
 #             healthy baseline
+#   path    — pathwise fixed-effect GLM with KKT-certified screening
+#             (exit 14): every lambda of a smoke-sized elastic-net grid
+#             certified, best-lambda selection identical to the
+#             unscreened walk, 0 compiles during the warmed timed walk
+#             (the <= 2x wall-clock gate needs FLOP-bound sizing and
+#             only runs in the full-size `python bench.py path`)
 #   affinity — elastic entity-affinity serving (exit 13): N owner-routed
 #             replicas hold N x one replica's page budget device-
 #             resident at flat p99, a kill + cold join mid-load keeps
@@ -36,7 +42,7 @@ cd "$(dirname "$0")/.."
 # BENCH_stream/cd with smoke-sized records)
 SNAPSHOT="$(mktemp -d)"
 for f in BENCH_stream.json BENCH_cd.json BENCH_shard.json BENCH_serving.json \
-         BENCH_degrade.json BENCH_affinity.json; do
+         BENCH_degrade.json BENCH_affinity.json BENCH_path.json; do
   cp "$f" "$SNAPSHOT/" 2>/dev/null || true
 done
 restore() {
@@ -70,6 +76,11 @@ affinity_rc=0
 JAX_PLATFORMS=cpu \
 BENCH_AFFINITY_SMOKE=1 \
 timeout -k 10 600 python bench.py affinity || affinity_rc=$?
+path_rc=0
+JAX_PLATFORMS=cpu \
+BENCH_PATH_SMOKE=1 \
+timeout -k 10 600 python bench.py path || path_rc=$?
 if [ "$serving_rc" -ne 0 ]; then exit "$serving_rc"; fi
 if [ "$degrade_rc" -ne 0 ]; then exit "$degrade_rc"; fi
-exit "$affinity_rc"
+if [ "$affinity_rc" -ne 0 ]; then exit "$affinity_rc"; fi
+exit "$path_rc"
